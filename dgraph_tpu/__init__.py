@@ -1,0 +1,30 @@
+"""dgraph_tpu — a TPU-native distributed graph-query framework.
+
+Provides the capabilities of the reference graph database (Dgraph,
+`ashishgandhi/dgraph`) — predicate-sharded posting lists, DQL multi-hop
+queries (expand / @filter / @recurse / shortest / pagination / aggregation),
+MVCC transactions, uid leasing, loaders — re-designed TPU-first:
+
+- Posting lists are predicate-sharded CSR blocks in HBM (reference:
+  `posting/list.go` + `codec/codec.go` varint blocks).
+- One query hop = one jit-compiled sparse-gather + sorted-set program over
+  the whole frontier (reference: `query.SubGraph.ProcessGraph` +
+  `algo.IntersectSorted` per-uid Go loops).
+- Cross-device movement is XLA collectives over the ICI mesh
+  (reference: inter-Alpha gRPC fan-out in `worker.ProcessTaskOverNetwork`).
+
+Layer map (see SURVEY.md §1):
+  ops/      sorted-uid algebra + hop kernels      (algo/, codec/)
+  store/    CSR posting store, schema, types, tok (posting/, schema/, types/, tok/)
+  engine/   SubGraph execution, recurse, shortest (query/)
+  dql/      lexer + DQL parser                    (lex/, gql/)
+  parallel/ mesh sharding + collective hops       (worker/ distribution)
+  cluster/  oracle: uid/ts leases, tablets        (dgraph/cmd/zero/)
+  server/   public API + task service             (edgraph/, worker/server.go)
+  loader/   RDF/JSON chunker, live/bulk, xidmap   (chunker/, dgraph/cmd/{live,bulk}/, xidmap/)
+  models/   built-in graph workload generators    (benchmarks fixtures)
+  utils/    config, metrics, logging, tracing     (x/)
+  native/   C++ host runtime (nquad parse, codec) (hot Go loops)
+"""
+
+__version__ = "0.1.0"

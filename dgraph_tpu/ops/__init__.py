@@ -1,0 +1,48 @@
+"""TPU-native sorted-UID algebra and traversal kernels.
+
+This package replaces the reference's hot inner loops (reference:
+`algo/uidlist.go` IntersectSorted/MergeSorted/Difference/ApplyFilter/IndexOf,
+`codec/codec.go` block decode) with jit-compiled, statically-shaped JAX
+programs. UID sets are sorted integer arrays padded with a sentinel so every
+op has a static output shape and XLA can fuse whole per-hop pipelines.
+"""
+
+from dgraph_tpu.ops.uidalgebra import (
+    SENTINEL32,
+    sentinel,
+    valid_mask,
+    count_valid,
+    pad_to,
+    compact,
+    compact_with_count,
+    sort_unique,
+    sort_unique_count,
+    intersect_sorted,
+    merge_sorted,
+    difference_sorted,
+    index_of,
+    contains,
+    take_page,
+)
+from dgraph_tpu.ops.hop import gather_edges, frontier_degrees, expand_frontier
+
+__all__ = [
+    "SENTINEL32",
+    "sentinel",
+    "valid_mask",
+    "count_valid",
+    "pad_to",
+    "compact",
+    "compact_with_count",
+    "sort_unique",
+    "sort_unique_count",
+    "intersect_sorted",
+    "merge_sorted",
+    "difference_sorted",
+    "index_of",
+    "contains",
+    "take_page",
+    "gather_edges",
+    "frontier_degrees",
+    "expand_frontier",
+]

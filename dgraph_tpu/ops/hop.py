@@ -1,0 +1,91 @@
+"""The hop kernel: one query level as a single jitted CSR gather program.
+
+Reference parity: the body of `query.SubGraph.ProcessGraph` →
+`worker.processTask` → `posting.List.Uids` per-uid Go loops (query/query.go,
+worker/task.go, posting/list.go). There, each frontier uid walks its posting
+list pointer-by-pointer in a goroutine; here the WHOLE frontier expands in
+one edge-parallel program:
+
+    frontier ranks → degree gather → exclusive cumsum → edge-slot
+    searchsorted → neighbour gather → (sort+unique) next frontier
+
+Shapes are static (`edge_cap`, `out_cap` are compile-time), with validity
+masks carrying the dynamic sizes — the discipline that keeps XLA from
+retracing per query.
+
+A "posting store" at this layer is just a CSR pair per (predicate,
+direction): `indptr[int32, n_nodes+1]`, `indices[int32, nnz]` in rank space
+(see store/). Values/facets ride parallel columnar arrays indexed by the
+same edge positions.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from dgraph_tpu.ops.uidalgebra import sentinel, sort_unique_count, valid_mask
+
+
+@jax.jit
+def frontier_degrees(indptr: jax.Array, frontier: jax.Array) -> jax.Array:
+    """Out-degree of each frontier rank (0 for padding). Reference: List.ApproxLen/count index."""
+    valid = valid_mask(frontier)
+    f = jnp.where(valid, frontier, 0)
+    deg = jnp.take(indptr, f + 1, mode="clip") - jnp.take(indptr, f, mode="clip")
+    return jnp.where(valid, deg, 0).astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("edge_cap",))
+def gather_edges(indptr: jax.Array, indices: jax.Array, frontier: jax.Array,
+                 edge_cap: int):
+    """Expand every frontier node's posting list into flat edge slots.
+
+    Returns (neighbors[edge_cap], seg[edge_cap], edge_pos[edge_cap],
+    valid[edge_cap], total):
+      - `seg[j]` is the frontier position that produced edge j — the
+        UidMatrix row structure the reference keeps for nested JSON
+        reconstruction (pb.Result.UidMatrix).
+      - `edge_pos[j]` is the absolute position in `indices` — used to
+        gather per-edge facet columns.
+      - `total` is the true edge count; slots ≥ total are masked. If
+        total > edge_cap the caller must re-run with a bigger bucket
+        (the host-side bucketing loop owns that policy).
+    """
+    deg = frontier_degrees(indptr, frontier)
+    offsets = jnp.cumsum(deg) - deg  # exclusive cumsum
+    total = jnp.sum(deg)
+
+    j = jnp.arange(edge_cap, dtype=jnp.int32)
+    # Which frontier slot does edge j belong to? Rightmost offset ≤ j.
+    seg = jnp.searchsorted(offsets, j, side="right").astype(jnp.int32) - 1
+    seg = jnp.clip(seg, 0, frontier.shape[0] - 1)
+    within = j - offsets[seg]
+    src_rank = jnp.where(valid_mask(frontier), frontier, 0)[seg]
+    edge_pos = jnp.take(indptr, src_rank, mode="clip") + within
+    neighbors = jnp.take(indices, edge_pos, mode="clip")
+    valid = j < total
+    snt = sentinel(indices.dtype)
+    neighbors = jnp.where(valid, neighbors, snt)
+    return neighbors, seg, edge_pos, valid, total
+
+
+@functools.partial(jax.jit, static_argnames=("edge_cap", "out_cap"))
+def expand_frontier(indptr: jax.Array, indices: jax.Array, frontier: jax.Array,
+                    edge_cap: int, out_cap: int):
+    """One full hop: gather all edges, dedupe into the next sorted frontier.
+
+    Reference: one level of ProcessGraph followed by the merge of child uid
+    lists (algo.MergeSorted) that seeds the next level / recurse iteration.
+
+    Overflow contract: `total > edge_cap` means edges were dropped;
+    `nxt_count > out_cap` means the deduped frontier was truncated. Either
+    way the host re-runs at the next bucket size — results with either
+    condition true must not be used.
+    """
+    neighbors, seg, edge_pos, valid, total = gather_edges(
+        indptr, indices, frontier, edge_cap)
+    nxt, nxt_count = sort_unique_count(neighbors, out_cap)
+    return nxt, nxt_count, neighbors, seg, edge_pos, valid, total

@@ -1,0 +1,172 @@
+"""Sorted-UID set algebra as statically-shaped JAX programs.
+
+Reference parity: `algo/uidlist.go` (IntersectSorted, MergeSorted,
+Difference, ApplyFilter, IndexOf) and the compact-list role of
+`codec/codec.go`. The reference chooses between linear scan, binary search
+and galloping per size ratio; on TPU one vectorised `searchsorted`
+membership test is the right shape for every ratio — the "algorithm
+selection" problem disappears into XLA.
+
+Representation
+--------------
+A *uid set* is a 1-D integer array, sorted ascending, padded at the tail
+with ``sentinel(dtype)`` (the dtype's max value). Real uids must be
+strictly smaller than the sentinel. The padded representation gives every
+op a static output shape — the compile-once contract jit needs — while
+`count_valid` recovers the logical length in O(log n).
+
+All ops are pure jnp (CPU/TPU agnostic) and safe to call under `jax.jit`
+with the size arguments static.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+SENTINEL32 = np.iinfo(np.int32).max
+
+
+def sentinel(dtype) -> int:
+    """Padding value for a uid dtype: the dtype's maximum."""
+    return int(jnp.iinfo(dtype).max)
+
+
+def valid_mask(a: jax.Array) -> jax.Array:
+    """Boolean mask of the non-padding elements."""
+    return a != sentinel(a.dtype)
+
+
+def count_valid(a: jax.Array) -> jax.Array:
+    """Logical length of a padded sorted uid set (scalar int32)."""
+    return jnp.searchsorted(a, jnp.asarray(sentinel(a.dtype), a.dtype)).astype(jnp.int32)
+
+
+def pad_to(a, size: int, dtype=jnp.int32) -> jax.Array:
+    """Pad (or validate) a host/device array to `size` with the sentinel."""
+    a = jnp.asarray(a, dtype)
+    n = a.shape[0]
+    if n > size:
+        raise ValueError(f"uid set of length {n} exceeds capacity {size}")
+    return jnp.concatenate([a, jnp.full((size - n,), sentinel(dtype), dtype)])
+
+
+def compact_with_count(values: jax.Array, keep: jax.Array, size: int):
+    """Stably move `values[keep]` to the front of a sentinel-padded [size] array.
+
+    The workhorse under intersect/difference/unique: a cumsum-position
+    scatter (drop-out-of-bounds), which XLA lowers to a single fused
+    scan+scatter. Preserves order, so sorted in → sorted out.
+
+    Returns `(out, kept)` where `kept` is the TRUE number of kept elements.
+    If `kept > size` the output was truncated (the tail beyond `size` is
+    dropped) — callers that can overflow must check `kept` and re-run with
+    a bigger bucket, mirroring how `gather_edges` signals via `total`.
+    """
+    snt = sentinel(values.dtype)
+    kept = jnp.sum(keep.astype(jnp.int32))
+    pos = jnp.cumsum(keep.astype(jnp.int32)) - 1
+    pos = jnp.where(keep, pos, size)  # out-of-bounds → dropped by scatter
+    out = jnp.full((size,), snt, values.dtype)
+    return out.at[pos].set(values, mode="drop"), kept
+
+
+def compact(values: jax.Array, keep: jax.Array, size: int) -> jax.Array:
+    """`compact_with_count` without the count — for callers whose `size`
+    provably cannot overflow (e.g. intersect with size=len(a))."""
+    return compact_with_count(values, keep, size)[0]
+
+
+def _member(a: jax.Array, b: jax.Array) -> jax.Array:
+    """For each element of `a`, whether it occurs in sorted padded `b`."""
+    idx = jnp.searchsorted(b, a)
+    idx = jnp.minimum(idx, b.shape[0] - 1)
+    return (b[idx] == a) & valid_mask(a)
+
+
+@functools.partial(jax.jit, static_argnames=("size",))
+def intersect_sorted(a: jax.Array, b: jax.Array, size: int | None = None) -> jax.Array:
+    """a ∩ b for sorted padded uid sets. Reference: algo.IntersectSorted."""
+    if size is None:
+        size = a.shape[0]
+    return compact(a, _member(a, b), size)
+
+
+@functools.partial(jax.jit, static_argnames=("size",))
+def difference_sorted(a: jax.Array, b: jax.Array, size: int | None = None) -> jax.Array:
+    """a \\ b for sorted padded uid sets. Reference: algo.Difference."""
+    if size is None:
+        size = a.shape[0]
+    return compact(a, valid_mask(a) & ~_member(a, b), size)
+
+
+@functools.partial(jax.jit, static_argnames=("size",))
+def sort_unique_count(x: jax.Array, size: int):
+    """Sort an arbitrary padded array, drop duplicates (and padding).
+
+    The dedupe step of frontier construction: reference merges per-uid
+    result lists via a k-way heap (`algo.MergeSorted`); on TPU a single
+    bitonic sort + neighbour-compare + compaction is one fused program.
+
+    Returns `(out[size], n_unique)`; `n_unique > size` means the output
+    was truncated and the caller must re-run with a larger bucket.
+    """
+    s = jnp.sort(x)
+    keep = valid_mask(s) & jnp.concatenate(
+        [jnp.ones((1,), jnp.bool_), s[1:] != s[:-1]]
+    )
+    return compact_with_count(s, keep, size)
+
+
+def sort_unique(x: jax.Array, size: int) -> jax.Array:
+    """`sort_unique_count` without the count — only safe when
+    `size >= x.shape[0]` (cannot truncate)."""
+    return sort_unique_count(x, size)[0]
+
+
+@functools.partial(jax.jit, static_argnames=("size",))
+def merge_sorted(a: jax.Array, b: jax.Array, size: int | None = None) -> jax.Array:
+    """Deduplicating union of two sorted padded uid sets. Reference: algo.MergeSorted."""
+    if size is None:
+        size = a.shape[0] + b.shape[0]
+    return sort_unique(jnp.concatenate([a, b]), size)
+
+
+@jax.jit
+def index_of(a: jax.Array, v) -> jax.Array:
+    """Position of uid `v` in sorted padded `a`, or -1. Reference: algo.IndexOf."""
+    v = jnp.asarray(v, a.dtype)
+    idx = jnp.searchsorted(a, v)
+    idx_c = jnp.minimum(idx, a.shape[0] - 1)
+    return jnp.where(a[idx_c] == v, idx_c.astype(jnp.int32), jnp.int32(-1))
+
+
+@jax.jit
+def contains(a: jax.Array, v) -> jax.Array:
+    """Whether sorted padded `a` contains uid `v` (scalar bool)."""
+    return index_of(a, v) >= 0
+
+
+@functools.partial(jax.jit, static_argnames=("size",))
+def take_page(a: jax.Array, offset, first, size: int) -> jax.Array:
+    """Pagination window over a sorted padded uid set.
+
+    Reference: `first:`/`offset:` args applied to posting lists
+    (query/query.go pagination). Negative `first` means "last |first|"
+    as in the reference. `offset`/`first` are traced scalars so one
+    compiled program serves every page.
+    """
+    n = count_valid(a)
+    offset = jnp.asarray(offset, jnp.int32)
+    first = jnp.asarray(first, jnp.int32)
+    start = jnp.where(first < 0, jnp.maximum(n + first - offset, 0), offset)
+    cnt = jnp.where(first < 0, jnp.minimum(-first, n - start),
+                    jnp.where(first == 0, n - start, jnp.minimum(first, n - start)))
+    cnt = jnp.maximum(cnt, 0)
+    i = jnp.arange(a.shape[0], dtype=jnp.int32)
+    src = jnp.minimum(i + start, a.shape[0] - 1)
+    vals = a[src]
+    return jnp.where(i < cnt, vals, sentinel(a.dtype))

@@ -1,0 +1,20 @@
+"""Test harness: force an 8-device virtual CPU mesh.
+
+Plays the role docker-compose plays in the reference's systest/ (SURVEY §4):
+multi-"node" behavior on one machine. Must run before jax is imported
+anywhere in the test process.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402  (imported here so the flags above bind first)
+
+# The session's TPU plugin re-asserts itself over JAX_PLATFORMS env, so force
+# the platform through jax.config (must happen before first backend init).
+jax.config.update("jax_platforms", "cpu")
+
+assert jax.device_count() >= 8, "virtual device mesh failed to initialise"

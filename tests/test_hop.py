@@ -1,0 +1,104 @@
+"""Hop-kernel tests vs a numpy CSR oracle (SURVEY §7 step 2)."""
+
+import numpy as np
+import pytest
+
+from dgraph_tpu import ops
+
+S = ops.SENTINEL32
+
+
+def make_csr(rng, n_nodes, avg_deg):
+    deg = rng.poisson(avg_deg, size=n_nodes).astype(np.int32)
+    indptr = np.zeros(n_nodes + 1, np.int32)
+    indptr[1:] = np.cumsum(deg)
+    indices = rng.integers(0, n_nodes, size=indptr[-1]).astype(np.int32)
+    # posting lists are sorted per source (reference invariant)
+    for u in range(n_nodes):
+        indices[indptr[u]:indptr[u + 1]].sort()
+    return indptr, indices
+
+
+def oracle_expand(indptr, indices, frontier):
+    nbrs, segs = [], []
+    for i, u in enumerate(frontier):
+        for v in indices[indptr[u]:indptr[u + 1]]:
+            nbrs.append(v)
+            segs.append(i)
+    return np.array(nbrs, np.int32), np.array(segs, np.int32)
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(7)
+
+
+def test_frontier_degrees(rng):
+    indptr, indices = make_csr(rng, 100, 4)
+    frontier = ops.pad_to(np.array([0, 5, 99], np.int32), 8)
+    deg = np.asarray(ops.frontier_degrees(np.asarray(indptr), frontier))
+    expect = indptr[1:] - indptr[:-1]
+    np.testing.assert_array_equal(deg[:3], expect[[0, 5, 99]])
+    np.testing.assert_array_equal(deg[3:], 0)
+
+
+@pytest.mark.parametrize("n_frontier", [1, 7, 64])
+def test_gather_edges_matches_oracle(rng, n_frontier):
+    indptr, indices = make_csr(rng, 500, 5)
+    f = np.sort(rng.choice(500, size=n_frontier, replace=False)).astype(np.int32)
+    frontier = ops.pad_to(f, 64)
+    nbrs, seg, edge_pos, valid, total = ops.gather_edges(
+        np.asarray(indptr), np.asarray(indices), frontier, edge_cap=1024)
+    nbrs, seg, valid = map(np.asarray, (nbrs, seg, valid))
+    exp_nbrs, exp_segs = oracle_expand(indptr, indices, f)
+    assert int(total) == len(exp_nbrs)
+    np.testing.assert_array_equal(nbrs[valid], exp_nbrs)
+    np.testing.assert_array_equal(seg[valid], exp_segs)
+    assert (nbrs[~valid] == S).all()
+    # edge_pos addresses the right slots of `indices`
+    np.testing.assert_array_equal(indices[np.asarray(edge_pos)[valid]], exp_nbrs)
+
+
+def test_expand_frontier_dedupes(rng):
+    indptr, indices = make_csr(rng, 200, 6)
+    f = np.sort(rng.choice(200, size=20, replace=False)).astype(np.int32)
+    nxt, nxt_count, nbrs, seg, edge_pos, valid, total = ops.expand_frontier(
+        np.asarray(indptr), np.asarray(indices), ops.pad_to(f, 32),
+        edge_cap=512, out_cap=256)
+    exp_nbrs, _ = oracle_expand(indptr, indices, f)
+    got = np.asarray(nxt)
+    got = got[got != S]
+    np.testing.assert_array_equal(got, np.unique(exp_nbrs))
+    assert int(nxt_count) == len(np.unique(exp_nbrs))
+
+
+def test_expand_frontier_overflow_is_signalled(rng):
+    """out_cap too small → nxt_count > out_cap (silent-truncation guard)."""
+    indptr, indices = make_csr(rng, 200, 6)
+    f = np.sort(rng.choice(200, size=40, replace=False)).astype(np.int32)
+    nxt, nxt_count, *_, total = ops.expand_frontier(
+        np.asarray(indptr), np.asarray(indices), ops.pad_to(f, 64),
+        edge_cap=512, out_cap=8)
+    exp_nbrs, _ = oracle_expand(indptr, indices, f)
+    assert int(nxt_count) == len(np.unique(exp_nbrs)) > 8
+
+
+def test_empty_frontier(rng):
+    indptr, indices = make_csr(rng, 50, 3)
+    empty = ops.pad_to(np.array([], np.int32), 16)
+    nxt, nxt_count, *_, total = ops.expand_frontier(
+        np.asarray(indptr), np.asarray(indices), empty, edge_cap=64, out_cap=64)
+    assert int(total) == 0
+    assert int(nxt_count) == 0
+    assert (np.asarray(nxt) == S).all()
+
+
+def test_zero_degree_nodes(rng):
+    indptr = np.array([0, 0, 2, 2], np.int32)  # nodes 0,2 have no edges
+    indices = np.array([1, 3], np.int32)
+    frontier = ops.pad_to(np.array([0, 1, 2], np.int32), 4)
+    nbrs, seg, _, valid, total = ops.gather_edges(
+        np.asarray(indptr), np.asarray(indices), frontier, edge_cap=8)
+    assert int(total) == 2
+    np.testing.assert_array_equal(np.asarray(nbrs)[np.asarray(valid)], [1, 3])
+    np.testing.assert_array_equal(np.asarray(seg)[np.asarray(valid)], [1, 1])
