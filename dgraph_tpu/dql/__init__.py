@@ -1,0 +1,6 @@
+"""DQL lexing + parsing (reference: lex/, gql/)."""
+
+from dgraph_tpu.dql.lexer import LexError, Token, tokenize
+from dgraph_tpu.dql.parser import ParseError, parse
+
+__all__ = ["tokenize", "Token", "LexError", "parse", "ParseError"]

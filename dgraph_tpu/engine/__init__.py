@@ -1,0 +1,43 @@
+"""Query engine: IR, executor, recurse/shortest/groupby/math, JSON output.
+
+Reference parity: `query/` package. `Engine` is the query-side facade the
+server layer (edgraph analog) calls.
+"""
+
+from __future__ import annotations
+
+from dgraph_tpu.engine.execute import Executor, LevelNode
+from dgraph_tpu.engine.ir import (
+    FilterNode, FuncNode, Order, RecurseArgs, ShortestArgs, SubGraph,
+)
+from dgraph_tpu.engine.outputnode import to_json
+
+
+class Engine:
+    """Parse + execute + render DQL queries over a Store snapshot.
+
+    Reference: the read path of edgraph.Server.Query →
+    query.Request.ProcessQuery → outputnode (SURVEY §3.1).
+    """
+
+    def __init__(self, store, device_threshold: int = 512):
+        self.store = store
+        self.device_threshold = device_threshold
+
+    def query(self, q: str, variables: dict | None = None) -> dict:
+        from dgraph_tpu.dql.parser import parse
+        from dgraph_tpu.engine.varorder import execution_order
+
+        blocks = parse(q, variables)
+        ex = Executor(self.store, device_threshold=self.device_threshold)
+        results: dict[int, LevelNode] = {}
+        for i in execution_order(blocks):
+            results[i] = ex.run_block(blocks[i])
+        roots = [results[i] for i in range(len(blocks))]  # textual order out
+        return to_json(ex, roots)
+
+
+__all__ = [
+    "Engine", "Executor", "LevelNode", "SubGraph", "FuncNode", "FilterNode",
+    "Order", "RecurseArgs", "ShortestArgs", "to_json",
+]

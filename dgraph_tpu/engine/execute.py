@@ -1,0 +1,360 @@
+"""SubGraph execution: ProcessGraph the TPU-native way.
+
+Reference parity: `query/query.go` (SubGraph.ProcessGraph — recursive
+per-level expansion, filter application, pagination/order), `worker/task.go`
+(processTask) and `query/outputnode.go` (JSON assembly lives in
+outputnode.py).
+
+Execution model (SURVEY §7): each level's expansion is ONE batched CSR
+gather over the whole frontier — device path through `ops.expand_frontier`
+(jitted, static bucket sizes) for large frontiers, numpy path for small
+ones; both produce identical (neighbors, seg) pairs. Per-uid goroutines and
+per-child RPC fan-out from the reference collapse into array programs.
+
+A level's result is a `LevelNode`:
+  nodes        sorted unique ranks at this level (the next frontier)
+  matrix_seg   edge → position in parent.nodes   (pb.Result.UidMatrix rows)
+  matrix_child edge → child rank (row-ordered: order/pagination applied)
+Content is computed once per unique uid (as the reference does), while the
+matrix preserves per-parent row structure for nested JSON.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from dgraph_tpu import ops
+from dgraph_tpu.engine.funcs import EMPTY, eval_func
+from dgraph_tpu.engine.ir import FilterNode, FuncNode, Order, SubGraph
+from dgraph_tpu.store.store import Store
+from dgraph_tpu.store.types import Kind
+
+
+@dataclass
+class LevelNode:
+    sg: SubGraph
+    nodes: np.ndarray                      # sorted unique int32 ranks
+    matrix_seg: np.ndarray = field(default_factory=lambda: EMPTY)
+    matrix_child: np.ndarray = field(default_factory=lambda: EMPTY)
+    display: np.ndarray | None = None      # root blocks: ordered rank list
+    children: list["LevelNode"] = field(default_factory=list)
+    leaf_sgs: list[SubGraph] = field(default_factory=list)
+    recurse_data: object | None = None     # engine.recurse.RecurseData
+    path_data: object | None = None        # engine.shortest.PathData
+    groups: object | None = None           # engine.groupby.GroupResult
+
+
+def _bucket(n: int, lo: int = 64) -> int:
+    b = lo
+    while b < n:
+        b <<= 1
+    return b
+
+
+class Executor:
+    """Executes SubGraph trees against a Store snapshot.
+
+    `device_threshold`: frontiers at least this large expand via the jitted
+    TPU kernel; smaller ones via numpy (dispatch overhead dominates tiny
+    frontiers). Set to 0 to force the device path (tests do).
+    """
+
+    def __init__(self, store: Store, device_threshold: int = 512):
+        self.store = store
+        self.device_threshold = device_threshold
+        # variable environments (reference: query var propagation)
+        self.uid_vars: dict[str, np.ndarray] = {}
+        self.val_vars: dict[str, dict[int, object]] = {}
+
+    # -- frontier expansion (the hot op) ------------------------------------
+    def expand(self, pred: str, reverse: bool, frontier: np.ndarray):
+        """Whole-frontier CSR expansion → (neighbors, seg) host arrays."""
+        rel = self.store.rel(pred, reverse)
+        if len(frontier) == 0 or rel.nnz == 0:
+            return EMPTY, EMPTY
+        if len(frontier) >= self.device_threshold:
+            return self._expand_device(pred, reverse, frontier)
+        starts = rel.indptr[frontier]
+        deg = rel.indptr[frontier + 1] - starts
+        total = int(deg.sum())
+        if total == 0:
+            return EMPTY, EMPTY
+        seg = np.repeat(np.arange(len(frontier), dtype=np.int32), deg)
+        base = np.repeat(np.cumsum(deg) - deg, deg)
+        pos = np.repeat(starts, deg) + (np.arange(total, dtype=np.int64) - base)
+        return rel.indices[pos], seg
+
+    def _expand_device(self, pred: str, reverse: bool, frontier: np.ndarray):
+        indptr, indices = self.store.device_rel(pred, reverse)
+        fcap = _bucket(len(frontier))
+        fr = ops.pad_to(frontier, fcap)
+        deg = self.store.rel(pred, reverse).degree(frontier)
+        ecap = _bucket(max(int(deg.sum()), 1))
+        nbrs, seg, _pos, valid, total = ops.gather_edges(indptr, indices, fr, ecap)
+        valid = np.asarray(valid)
+        return np.asarray(nbrs)[valid], np.asarray(seg)[valid]
+
+    # -- filters ------------------------------------------------------------
+    def apply_filter(self, tree: FilterNode | None, universe: np.ndarray) -> np.ndarray:
+        """Evaluate a filter tree restricted to `universe` (sorted ranks).
+        Reference: filter SubGraphs + algo.IntersectSorted/Difference."""
+        if tree is None:
+            return universe
+        if tree.op == "leaf":
+            return np.intersect1d(universe, self._leaf_set(tree.func, universe))
+        if tree.op == "not":
+            return np.setdiff1d(universe, self.apply_filter(tree.children[0], universe))
+        parts = [self.apply_filter(c, universe) for c in tree.children]
+        out = parts[0]
+        for p in parts[1:]:
+            out = np.intersect1d(out, p) if tree.op == "and" else np.union1d(out, p)
+        return out.astype(np.int32)
+
+    def _var_ranks(self, name: str) -> np.ndarray:
+        """uid(x): a uid var's ranks, or a val var's uid domain."""
+        if name in self.uid_vars:
+            return self.uid_vars[name]
+        if name in self.val_vars:
+            return np.array(sorted(self.val_vars[name]), np.int32)
+        return EMPTY
+
+    def filter_edges(self, filters: FilterNode | None, nbrs: np.ndarray,
+                     seg: np.ndarray):
+        """Apply a filter tree to a flattened edge list, re-masking rows.
+        Shared by plain expansion, @recurse, and shortest-path hops."""
+        if filters is None or not len(nbrs):
+            return nbrs, seg
+        allowed = self.apply_filter(filters, np.unique(nbrs).astype(np.int32))
+        keep = np.isin(nbrs, allowed)
+        return nbrs[keep], seg[keep]
+
+    def _leaf_set(self, f: FuncNode, universe: np.ndarray) -> np.ndarray:
+        if f.name == "uid" and (f.args or not f.uids):
+            # mixed literals and variables: union both
+            parts = [self._var_ranks(a) for a in f.args]
+            if f.uids:
+                r = self.store.rank_of(np.array(f.uids, np.int64))
+                parts.append(r[r >= 0].astype(np.int32))
+            return (np.unique(np.concatenate(parts)).astype(np.int32)
+                    if parts else EMPTY)
+        return eval_func(self.store, f, self.val_vars)
+
+    # -- root evaluation ----------------------------------------------------
+    def root_ranks(self, sg: SubGraph) -> np.ndarray:
+        f = sg.func
+        if f is None:
+            return EMPTY
+        return self._leaf_set(f, EMPTY)
+
+    # -- ordering / pagination ----------------------------------------------
+    def _value_keys(self, ranks: np.ndarray, order: Order):
+        """Sort keys for ranks by a value predicate or val-var. Missing
+        values get a placeholder key (they sort last via the has-key)."""
+        if order.is_val_var:
+            var = self.val_vars.get(order.attr, {})
+            vals = [var.get(int(r)) for r in ranks]
+        elif not order.lang and (col := self.store.value_col(order.attr)) is not None:
+            # vectorised first-value lookup on the sorted columnar pair
+            ranks_arr = np.asarray(ranks, np.int32)
+            idx = np.searchsorted(col.subj, ranks_arr)
+            idx_c = np.minimum(idx, max(len(col.subj) - 1, 0))
+            hit = (len(col.subj) > 0) & (col.subj[idx_c] == ranks_arr)
+            vals = [col.vals[i] if h else None
+                    for i, h in zip(idx_c.tolist(), np.atleast_1d(hit).tolist())]
+        else:
+            vals = []
+            for r in ranks:
+                vs = self.store.values_for(order.attr, int(r), order.lang)
+                vals.append(vs[0] if vs else None)
+        has = np.array([v is not None for v in vals], bool)
+        present = [_orderable(v) for v in vals if v is not None]
+        placeholder = present[0] if present else 0
+        keys = np.array([_orderable(v) if v is not None else placeholder
+                         for v in vals])
+        return keys, has
+
+    def order_ranks(self, ranks: np.ndarray, orders: list[Order],
+                    seg: np.ndarray | None = None):
+        """Stable multi-key ordering, optionally within segments (rows).
+        lexsort priority: seg (row) > first order > ... > uid tiebreak."""
+        if not orders:
+            return np.arange(len(ranks))
+        keys = [np.asarray(ranks)]  # lowest priority: uid tiebreak
+        for o in reversed(orders):
+            k, has = self._value_keys(ranks, o)
+            if o.desc:
+                k = _negate_key(k)
+            keys.append(k)
+            keys.append(~has)  # missing values last, asc or desc
+        if seg is not None:
+            keys.append(seg)
+        return np.lexsort(tuple(keys))
+
+    def paginate(self, arr_len: int, sg: SubGraph, ranks: np.ndarray) -> np.ndarray:
+        """Row slice per first/offset/after → index array into the row."""
+        idx = np.arange(arr_len)
+        if sg.after:
+            after_rank = self.store.rank_of(np.array([sg.after], np.int64))[0]
+            idx = idx[ranks > after_rank] if after_rank >= 0 else idx
+        if sg.offset:
+            idx = idx[sg.offset:]
+        if sg.first > 0:
+            idx = idx[:sg.first]
+        elif sg.first < 0:
+            idx = idx[sg.first:]
+        return idx
+
+    # -- block execution ----------------------------------------------------
+    def run_block(self, sg: SubGraph) -> LevelNode:
+        """Execute one root block (reference: Request.ProcessQuery per block)."""
+        if sg.shortest is not None:
+            from dgraph_tpu.engine.shortest import shortest_path
+            data = shortest_path(self, sg)
+            node = LevelNode(sg=sg, nodes=data.nodes, path_data=data)
+            if sg.var_name:
+                self.uid_vars[sg.var_name] = data.nodes
+            return node
+        ranks = self.root_ranks(sg)
+        ranks = self.apply_filter(sg.filters, ranks)
+        order_idx = (self.order_ranks(ranks, sg.orders)
+                     if sg.orders else np.arange(len(ranks)))
+        display = ranks[order_idx]
+        page = self.paginate(len(display), sg, display)
+        display = display[page]
+        nodes = np.unique(display).astype(np.int32)
+        node = LevelNode(sg=sg, nodes=nodes, display=display.astype(np.int32))
+        if sg.var_name:
+            self.uid_vars[sg.var_name] = nodes
+        if sg.groupby:
+            from dgraph_tpu.engine.groupby import process_groupby
+            node.groups = process_groupby(self, node)
+            return node
+        self._descend(node)
+        return node
+
+    def _descend(self, parent: LevelNode) -> None:
+        from dgraph_tpu.engine.recurse import expand_recurse
+        if parent.sg.recurse is not None:
+            expand_recurse(self, parent)
+            return
+        for child_sg in self._concrete_children(parent):
+            if self._expands(child_sg):
+                parent.children.append(self.run_child(child_sg, parent.nodes))
+            else:
+                parent.leaf_sgs.append(child_sg)
+                self._record_leaf_vars(child_sg, parent)
+
+    def run_child(self, sg: SubGraph, frontier: np.ndarray) -> LevelNode:
+        """Expand one uid-predicate child level below `frontier`."""
+        nbrs, seg = self.expand(sg.attr, sg.is_reverse, frontier)
+        nbrs, seg = self.filter_edges(sg.filters, nbrs, seg)
+        # row-internal ordering (default: uid order, which CSR already gives)
+        if sg.orders:
+            order_idx = self.order_ranks(nbrs, sg.orders, seg=seg)
+            nbrs, seg = nbrs[order_idx], seg[order_idx]
+        # per-row pagination (seg is nondecreasing: CSR construction order,
+        # preserved by masking, and lexsort uses seg as the primary key)
+        if sg.first or sg.offset or sg.after:
+            rows = np.unique(seg)
+            starts = np.searchsorted(seg, rows)
+            ends = np.searchsorted(seg, rows, "right")
+            keep_idx = []
+            for s, e in zip(starts.tolist(), ends.tolist()):
+                row_idx = np.arange(s, e)
+                keep_idx.append(
+                    row_idx[self.paginate(e - s, sg, nbrs[row_idx])])
+            if keep_idx:
+                keep_idx = np.sort(np.concatenate(keep_idx))
+                nbrs, seg = nbrs[keep_idx], seg[keep_idx]
+        nodes = np.unique(nbrs).astype(np.int32)
+        node = LevelNode(sg=sg, nodes=nodes,
+                         matrix_seg=seg.astype(np.int32),
+                         matrix_child=nbrs.astype(np.int32))
+        if sg.var_name:
+            self.uid_vars[sg.var_name] = nodes
+        if sg.groupby:
+            from dgraph_tpu.engine.groupby import process_groupby_rows
+            node.groups = process_groupby_rows(self, node)
+            return node
+        self._descend(node)
+        return node
+
+    # -- leaves, vars, expand(_all_) ----------------------------------------
+    def _concrete_children(self, parent: LevelNode) -> list[SubGraph]:
+        """Resolve expand(_all_)/expand(Type) into concrete child blocks.
+        Reference: query/expand.go semantics via type system."""
+        out: list[SubGraph] = []
+        for c in parent.sg.children:
+            if not c.is_expand_all:
+                out.append(c)
+                continue
+            if c.expand_arg and c.expand_arg != "_all_":
+                preds = self.store.predicates_of_types([c.expand_arg])
+            else:
+                type_names: set[str] = set()
+                for r in parent.nodes:
+                    type_names.update(
+                        self.store.values_for("dgraph.type", int(r)))
+                preds = self.store.predicates_of_types(sorted(type_names))
+            for p in preds:
+                ps = self.store.schema.peek(p)
+                if ps and ps.kind == Kind.UID:
+                    out.append(SubGraph(attr=p, children=list(c.children)))
+                else:
+                    out.append(SubGraph(attr=p))
+        return out
+
+    def _expands(self, sg: SubGraph) -> bool:
+        """Whether a child block triggers uid expansion (vs a value leaf).
+        Schema-driven, as the reference routes by tablet type."""
+        if (sg.is_count or sg.is_uid_leaf or sg.is_agg or sg.is_val_leaf
+                or sg.math_expr is not None):
+            return False
+        if sg.is_reverse or sg.children or sg.recurse or sg.shortest:
+            return True
+        ps = self.store.schema.peek(sg.attr)
+        return bool(ps and ps.kind == Kind.UID)
+
+    def _record_leaf_vars(self, sg: SubGraph, parent: LevelNode) -> None:
+        """Bind value/count vars declared on leaves (a as age, c as count(p))."""
+        if not sg.var_name:
+            return
+        if sg.is_count:
+            rel = self.store.rel(sg.attr, sg.is_reverse)
+            deg = rel.degree(parent.nodes)
+            self.val_vars[sg.var_name] = {
+                int(r): int(d) for r, d in zip(parent.nodes, deg)}
+        elif sg.math_expr is not None:
+            from dgraph_tpu.engine.mathexpr import eval_math
+            self.val_vars[sg.var_name] = eval_math(
+                sg.math_expr, parent.nodes, self.val_vars)
+        elif sg.is_val_leaf:
+            src = self.val_vars.get(sg.attr, {})
+            self.val_vars[sg.var_name] = {
+                int(r): src[int(r)] for r in parent.nodes if int(r) in src}
+        else:
+            env: dict[int, object] = {}
+            for r in parent.nodes:
+                vs = self.store.values_for(sg.attr, int(r), sg.lang)
+                if vs:
+                    env[int(r)] = vs[0]
+            self.val_vars[sg.var_name] = env
+
+
+def _orderable(v):
+    import numpy as _np
+    if isinstance(v, _np.datetime64):
+        return v.astype("datetime64[us]").astype("int64")
+    if isinstance(v, (bool, _np.bool_)):
+        return int(v)
+    return v
+
+
+def _negate_key(k: np.ndarray) -> np.ndarray:
+    if k.dtype.kind in "if":
+        return -k
+    # strings: lexsort can't negate; invert via rank mapping
+    uniq, inv = np.unique(k, return_inverse=True)
+    return (len(uniq) - 1 - inv).astype(np.int64)

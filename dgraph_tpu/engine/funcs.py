@@ -1,0 +1,246 @@
+"""Root/filter function evaluation against the Store.
+
+Reference parity: the func dispatch inside `worker/task.go processTask`
+(handleUidPostings / handleValuePostings / handleCompareFunction /
+handleRegexFunction / handleHasFunction) — evaluated host-side over columnar
+value arrays and inverted indexes, producing sorted rank sets that feed the
+device-side traversal. Index-answerable funcs are O(lookup); the rest are
+vectorised numpy scans over the predicate's value column.
+"""
+
+from __future__ import annotations
+
+import re
+
+import numpy as np
+
+from dgraph_tpu.engine.ir import FuncNode
+from dgraph_tpu.store.store import TYPE_PRED, Store
+from dgraph_tpu.store.tok import fulltext_tokens, term_tokens
+from dgraph_tpu.store.types import Kind, convert
+
+EMPTY = np.zeros(0, np.int32)
+
+
+def eval_func(store: Store, f: FuncNode, val_env: dict | None = None) -> np.ndarray:
+    """Evaluate a function → sorted unique int32 rank array."""
+    name = f.name.lower()
+    if f.is_count:
+        return _count_compare(store, f, name)
+    if f.is_val_var:
+        return _val_var_compare(f, name, val_env or {})
+    if name == "uid":
+        ranks = store.rank_of(np.array(f.uids or [0], np.int64))
+        return np.unique(ranks[ranks >= 0]).astype(np.int32)
+    if name == "has":
+        return store.has_ranks(f.attr)
+    if name == "type":
+        return store.index_lookup(TYPE_PRED, "exact", str(f.args[0]))
+    if name == "uid_in":
+        return _uid_in(store, f)
+    if name == "eq":
+        return _eq(store, f)
+    if name in ("le", "lt", "ge", "gt", "between"):
+        return _compare(store, f, name)
+    if name in ("anyofterms", "allofterms"):
+        return _terms(store, f, any_=(name == "anyofterms"))
+    if name in ("anyoftext", "alloftext"):
+        return _text(store, f, any_=(name == "anyoftext"))
+    if name == "regexp":
+        return _regexp(store, f)
+    if name == "match":
+        return _match(store, f)
+    raise ValueError(f"unknown function {f.name!r}")
+
+
+# -- helpers ----------------------------------------------------------------
+
+def _schema_kind(store: Store, attr: str) -> Kind:
+    ps = store.schema.peek(attr)
+    kind = ps.kind if ps else Kind.DEFAULT
+    return Kind.STRING if kind == Kind.DEFAULT else kind
+
+
+def _columns(store: Store, f: FuncNode):
+    """Value columns to scan: the lang-tagged one if requested, else all."""
+    p = store.preds.get(f.attr)
+    if not p:
+        return []
+    if f.lang:
+        col = p.vals.get(f.lang)
+        return [col] if col is not None else []
+    return list(p.vals.values())
+
+
+def _scan(store: Store, f: FuncNode, predicate_fn) -> np.ndarray:
+    """Apply a vectorised predicate over all value columns → rank set."""
+    hits = [col.subj[predicate_fn(col.vals)] for col in _columns(store, f)]
+    if not hits:
+        return EMPTY
+    return np.unique(np.concatenate(hits)).astype(np.int32)
+
+
+def _cmp_arrays(vals: np.ndarray, kind: Kind):
+    if kind in (Kind.STRING, Kind.DEFAULT, Kind.PASSWORD):
+        return vals.astype(str)
+    return vals
+
+
+def _eq(store: Store, f: FuncNode) -> np.ndarray:
+    kind = _schema_kind(store, f.attr)
+    ps = store.schema.peek(f.attr)
+    toks = ps.index_tokenizers if ps else ()
+    # index-answerable eq for string-ish kinds; the inverted index merges
+    # all language columns, so lang-tagged eq must take the scan path
+    if not f.lang and kind in (Kind.STRING, Kind.DEFAULT) and \
+            ("exact" in toks or "hash" in toks):
+        tk = "exact" if "exact" in toks else "hash"
+        hits = [store.index_lookup(f.attr, tk, str(a)) for a in f.args]
+        return np.unique(np.concatenate(hits)).astype(np.int32) if hits else EMPTY
+    targets = [convert(a, kind) for a in f.args]
+    if kind == Kind.DATETIME:
+        targets = np.array(targets, "datetime64[us]")
+    return _scan(store, f, lambda vals: np.isin(_cmp_arrays(vals, kind),
+                                                np.array(targets)))
+
+
+def _compare(store: Store, f: FuncNode, op: str) -> np.ndarray:
+    kind = _schema_kind(store, f.attr)
+    args = [convert(a, kind) for a in f.args]
+
+    def pred(vals):
+        v = _cmp_arrays(vals, kind)
+        a0 = args[0]
+        if op == "le":
+            return v <= a0
+        if op == "lt":
+            return v < a0
+        if op == "ge":
+            return v >= a0
+        if op == "gt":
+            return v > a0
+        return (v >= a0) & (v <= args[1])  # between
+
+    return _scan(store, f, pred)
+
+
+def _count_compare(store: Store, f: FuncNode, op: str) -> np.ndarray:
+    """eq/le/lt/ge/gt(count(pred), N). Reference: count index path."""
+    rel = store.rel(f.attr.lstrip("~"), reverse=f.attr.startswith("~"))
+    deg = (rel.indptr[1:] - rel.indptr[:-1]).astype(np.int64)
+    n = int(f.args[0])
+    if op == "eq":
+        mask = deg == n
+    elif op == "le":
+        mask = deg <= n
+    elif op == "lt":
+        mask = deg < n
+    elif op == "ge":
+        mask = deg >= n
+    elif op == "gt":
+        mask = deg > n
+    elif op == "between":
+        mask = (deg >= n) & (deg <= int(f.args[1]))
+    else:
+        raise ValueError(f"bad count comparison {op}")
+    return np.nonzero(mask)[0].astype(np.int32)
+
+
+def _val_var_compare(f: FuncNode, op: str, val_env: dict) -> np.ndarray:
+    """eq/le/../gt(val(x), N) over a value-variable map (rank → value)."""
+    var = val_env.get(f.attr)
+    if not var:
+        return EMPTY
+    ranks = np.fromiter(var.keys(), np.int32, len(var))
+    vals = np.array(list(var.values()))
+    a0 = vals.dtype.type(f.args[0])
+    if op == "eq":
+        mask = np.isin(vals, np.array([vals.dtype.type(a) for a in f.args]))
+    elif op == "le":
+        mask = vals <= a0
+    elif op == "lt":
+        mask = vals < a0
+    elif op == "ge":
+        mask = vals >= a0
+    elif op == "gt":
+        mask = vals > a0
+    elif op == "between":
+        mask = (vals >= a0) & (vals <= vals.dtype.type(f.args[1]))
+    else:
+        raise ValueError(f"bad val comparison {op}")
+    return np.unique(ranks[mask]).astype(np.int32)
+
+
+def _uid_in(store: Store, f: FuncNode) -> np.ndarray:
+    """uid_in(pred, uid): subjects with an edge pred → uid."""
+    targets = store.rank_of(np.array(f.uids, np.int64))
+    targets = targets[targets >= 0]
+    if not len(targets):
+        return EMPTY
+    attr = f.attr.lstrip("~")
+    reverse = f.attr.startswith("~")
+    ps = store.schema.peek(attr)
+    if ps and ps.reverse and not reverse:
+        rows = [store.rel(attr, reverse=True).row(int(t)) for t in targets]
+        return np.unique(np.concatenate(rows)).astype(np.int32)
+    # no reverse index: scan the forward CSR (vectorised membership)
+    rel = store.rel(attr, reverse=reverse)
+    hit_edges = np.isin(rel.indices, targets)
+    srcs = np.searchsorted(rel.indptr, np.nonzero(hit_edges)[0], side="right") - 1
+    return np.unique(srcs).astype(np.int32)
+
+
+def _terms(store: Store, f: FuncNode, any_: bool) -> np.ndarray:
+    toks = term_tokens(" ".join(str(a) for a in f.args))
+    return _token_combine(store, f.attr, "term", toks, any_)
+
+
+def _text(store: Store, f: FuncNode, any_: bool) -> np.ndarray:
+    toks = fulltext_tokens(" ".join(str(a) for a in f.args))
+    return _token_combine(store, f.attr, "fulltext", toks, any_)
+
+
+def _token_combine(store: Store, attr: str, tokenizer: str, toks, any_: bool) -> np.ndarray:
+    if not toks:
+        return EMPTY
+    lists = [store.index_lookup(attr, tokenizer, t) for t in toks]
+    if any_:
+        return np.unique(np.concatenate(lists)).astype(np.int32)
+    out = lists[0]
+    for l in lists[1:]:
+        out = np.intersect1d(out, l)
+    return out.astype(np.int32)
+
+
+def _regexp(store: Store, f: FuncNode) -> np.ndarray:
+    pat = str(f.args[0])
+    flags = 0
+    if len(f.args) > 1 and "i" in str(f.args[1]):
+        flags |= re.IGNORECASE
+    rx = re.compile(pat, flags)
+    return _scan(store, f, lambda vals: np.array(
+        [bool(rx.search(str(v))) for v in vals], bool))
+
+
+def _match(store: Store, f: FuncNode) -> np.ndarray:
+    """match(attr, term, maxdistance): fuzzy match via Levenshtein bound."""
+    term = str(f.args[0]).lower()
+    maxd = int(f.args[1]) if len(f.args) > 1 else 2
+
+    def lev_ok(s: str) -> bool:
+        s = s.lower()
+        if abs(len(s) - len(term)) > maxd:
+            return False
+        prev = list(range(len(term) + 1))
+        for i, c in enumerate(s, 1):
+            cur = [i]
+            for j, t in enumerate(term, 1):
+                cur.append(min(prev[j] + 1, cur[j - 1] + 1,
+                               prev[j - 1] + (c != t)))
+            if min(cur) > maxd:
+                return False
+            prev = cur
+        return prev[-1] <= maxd
+
+    return _scan(store, f, lambda vals: np.array(
+        [any(lev_ok(w) for w in str(v).split()) for v in vals], bool))

@@ -1,0 +1,321 @@
+"""JSON result assembly from executed LevelNode trees.
+
+Reference parity: `query/outputnode.go` (fastJsonNode → JSON). Differences
+in mechanism, not shape: the reference builds a byte-tree during traversal;
+here the matrices (seg, child) ARE the tree, and rendering groups rows per
+parent position with one stable argsort per level.
+
+Conventions matched to the reference's JSON:
+  uids           "0x%x" strings
+  datetimes      RFC3339 (UTC, "Z")
+  uid edges      lists of objects; empty lists omitted
+  @normalize     flat objects, cartesian product across nested lists
+  aggregates     separate objects appended to the block list
+  shortest       "_path_" block of nested path objects
+  @groupby       {"@groupby": [...]} wrapper objects
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from dgraph_tpu.engine.execute import LevelNode
+from dgraph_tpu.engine.groupby import _aggregate
+from dgraph_tpu.store.types import Kind
+
+
+def to_json(ex, roots: list[LevelNode]) -> dict:
+    r = _Renderer(ex)
+    out: dict = {}
+    for node in roots:
+        if node.sg.is_internal:
+            continue
+        name = node.sg.alias or node.sg.attr or "q"
+        if node.sg.shortest is not None:
+            out.setdefault("_path_", []).extend(r.render_paths(node))
+            continue
+        out[name] = r.render_block(node)
+    return out
+
+
+class _Renderer:
+    def __init__(self, ex):
+        self.ex = ex
+        self.store = ex.store
+        self._row_maps: dict[int, dict[int, np.ndarray]] = {}
+
+    # -- blocks -------------------------------------------------------------
+    def render_block(self, node: LevelNode) -> list:
+        objs = []
+        if node.groups is not None:
+            return [{"@groupby": self._groups_json(node)}]
+        display = node.display if node.display is not None else node.nodes
+        for rank in display.tolist():
+            obj = self.node_obj(node, int(rank), aliased_only=node.sg.normalize)
+            if obj:
+                objs.append(obj)
+        objs.extend(self.block_level_entries(node))
+        if node.sg.normalize:
+            flat = []
+            for o in objs:
+                flat.extend(_normalize(o))
+            return flat
+        return objs
+
+    def block_level_entries(self, node: LevelNode) -> list:
+        """Aggregates and count(uid) render as standalone list entries."""
+        entries = []
+        for leaf in node.leaf_sgs:
+            if leaf.is_agg:
+                var = self.ex.val_vars.get(leaf.attr, {})
+                vals = [var[int(r)] for r in node.nodes.tolist() if int(r) in var]
+                v = _aggregate(leaf.agg_func, vals)
+                if v is not None:
+                    name = leaf.alias or f"{leaf.agg_func}(val({leaf.attr}))"
+                    entries.append({name: _json_val(v)})
+            elif leaf.is_count and leaf.is_uid_leaf:
+                entries.append({leaf.alias or "count": int(len(node.nodes))})
+        return entries
+
+    # -- nodes --------------------------------------------------------------
+    def node_obj(self, level: LevelNode, rank: int,
+                 aliased_only: bool = False) -> dict | None:
+        obj: dict = {}
+        for leaf in level.leaf_sgs:
+            self._render_leaf(leaf, rank, obj, aliased_only)
+        if level.recurse_data is not None:
+            self._render_recurse_children(level.recurse_data, rank, obj,
+                                          depth=0)
+        for child in level.children:
+            self._render_edge(child, level, rank, obj, aliased_only)
+        if level.sg.cascade and not _cascade_ok(level, obj):
+            return None
+        return obj
+
+    def _render_leaf(self, leaf, rank: int, obj: dict,
+                     aliased_only: bool = False) -> None:
+        if leaf.is_agg or (leaf.is_count and leaf.is_uid_leaf):
+            return  # block-level entries
+        if aliased_only and not leaf.alias and not leaf.is_uid_leaf:
+            return  # @normalize: only aliased predicates survive
+        if leaf.is_uid_leaf:
+            obj[leaf.alias or "uid"] = _uid_str(self.store.uid_of(rank))
+            return
+        if leaf.is_count:
+            rel = self.store.rel(leaf.attr, leaf.is_reverse)
+            name = leaf.alias or f"count({'~' if leaf.is_reverse else ''}{leaf.attr})"
+            obj[name] = int(rel.degree(np.array([rank]))[0])
+            return
+        if leaf.is_val_leaf:
+            var = self.ex.val_vars.get(leaf.attr, {})
+            if rank in var:
+                obj[leaf.alias or f"val({leaf.attr})"] = _json_val(var[rank])
+            return
+        if leaf.math_expr is not None:
+            var = self.ex.val_vars.get(leaf.var_name or leaf.alias or "", {})
+            if rank in var:
+                if leaf.alias:
+                    obj[leaf.alias] = _json_val(var[rank])
+            elif leaf.alias:
+                from dgraph_tpu.engine.mathexpr import eval_math
+                v = eval_math(leaf.math_expr, [rank], self.ex.val_vars)
+                if rank in v:
+                    obj[leaf.alias] = _json_val(v[rank])
+            return
+        # plain value predicate
+        vs = self.store.values_for(leaf.attr, rank, leaf.lang)
+        if not vs:
+            return
+        name = leaf.alias or (f"{leaf.attr}@{leaf.lang}" if leaf.lang else leaf.attr)
+        ps = self.store.schema.peek(leaf.attr)
+        if (ps and ps.is_list) or len(vs) > 1:
+            obj[name] = [_json_val(v) for v in vs]
+        else:
+            obj[name] = _json_val(vs[0])
+
+    def _render_edge(self, child: LevelNode, parent: LevelNode, rank: int,
+                     obj: dict, aliased_only: bool = False) -> None:
+        rows = self._rows(child, parent, rank)
+        name = child.sg.alias or (
+            f"~{child.sg.attr}" if child.sg.is_reverse else child.sg.attr)
+        if child.groups is not None:
+            pos = int(np.searchsorted(parent.nodes, rank))
+            g = child.groups.get(pos)
+            if g is not None and g.groups:
+                obj[name] = [{"@groupby": self._groups_list(g)}]
+            return
+        lst = []
+        for cr in rows.tolist():
+            o = self.node_obj(child, int(cr), aliased_only)
+            if o:
+                lst.append(o)
+        lst.extend(self._row_level_entries(child, rows))
+        if lst:
+            obj[name] = lst
+
+    def _row_level_entries(self, child: LevelNode, rows: np.ndarray) -> list:
+        """Nested aggregates/count(uid): evaluated over THIS parent's row
+        members (reference: evalLevelAgg per parent)."""
+        entries = []
+        for leaf in child.leaf_sgs:
+            if leaf.is_agg:
+                var = self.ex.val_vars.get(leaf.attr, {})
+                members = np.unique(rows)
+                vals = [var[int(r)] for r in members.tolist() if int(r) in var]
+                v = _aggregate(leaf.agg_func, vals)
+                if v is not None:
+                    name = leaf.alias or f"{leaf.agg_func}(val({leaf.attr}))"
+                    entries.append({name: _json_val(v)})
+            elif leaf.is_count and leaf.is_uid_leaf:
+                entries.append({leaf.alias or "count": int(len(np.unique(rows)))})
+        return entries
+
+    def _rows(self, child: LevelNode, parent: LevelNode, rank: int) -> np.ndarray:
+        """Matrix row of `rank`: child ranks in row order."""
+        m = self._row_maps.get(id(child))
+        if m is None:
+            m = {}
+            seg = child.matrix_seg
+            order = np.argsort(seg, kind="stable")
+            sseg = seg[order]
+            starts = np.searchsorted(sseg, np.arange(len(parent.nodes)))
+            ends = np.searchsorted(sseg, np.arange(len(parent.nodes)), "right")
+            for pos in range(len(parent.nodes)):
+                if ends[pos] > starts[pos]:
+                    m[pos] = child.matrix_child[order[starts[pos]:ends[pos]]]
+            self._row_maps[id(child)] = m
+        pos = int(np.searchsorted(parent.nodes, rank))
+        return m.get(pos, np.zeros(0, np.int32))
+
+    # -- recurse ------------------------------------------------------------
+    def _render_recurse_children(self, data, rank: int, obj: dict,
+                                 depth: int) -> None:
+        for leaf in data.leaf_sgs:
+            self._render_leaf(leaf, rank, obj)
+        if data.loop:
+            if depth >= len(data.by_depth):
+                return
+            level = data.by_depth[depth]
+            for i, esg in enumerate(data.edge_sgs):
+                if i not in level:
+                    continue
+                parents, children = level[i]
+                rows = children[parents == rank]
+                self._emit_recurse_rows(data, esg, rows, obj, depth + 1)
+        else:
+            for i, esg in enumerate(data.edge_sgs):
+                if i not in data.edges:
+                    continue
+                parents, children = data.edges[i]
+                rows = children[parents == rank]
+                self._emit_recurse_rows(data, esg, rows, obj, depth + 1)
+
+    def _emit_recurse_rows(self, data, esg, rows, obj: dict, depth: int) -> None:
+        if not len(rows):
+            return
+        name = esg.alias or (f"~{esg.attr}" if esg.is_reverse else esg.attr)
+        lst = []
+        for cr in rows.tolist():
+            o: dict = {}
+            self._render_recurse_children(data, int(cr), o, depth)
+            if o:
+                lst.append(o)
+        if lst:
+            obj[name] = lst
+
+    # -- groupby ------------------------------------------------------------
+    def _groups_json(self, node: LevelNode) -> list:
+        return self._groups_list(node.groups)
+
+    def _groups_list(self, gr) -> list:
+        out = []
+        for key, aggs, _members in gr.groups:
+            g = {a: _json_val(v) for a, v in key.items()}
+            g.update({k: _json_val(v) for k, v in aggs.items()})
+            out.append(g)
+        return out
+
+    # -- shortest -----------------------------------------------------------
+    def render_paths(self, node: LevelNode) -> list:
+        data = node.path_data
+        if data is None or not data.paths:
+            return []
+        out = []
+        for path in data.paths:
+            cur: dict | None = None
+            for rank, pred_i in reversed(path):
+                o = {"uid": _uid_str(self.store.uid_of(rank))}
+                if cur is not None:
+                    esg = data.edge_sgs[next_pred_i]
+                    name = esg.alias or (
+                        f"~{esg.attr}" if esg.is_reverse else esg.attr)
+                    o[name] = cur
+                cur = o
+                next_pred_i = pred_i
+            out.append(cur)
+        return out
+
+
+# -- helpers ----------------------------------------------------------------
+
+def _uid_str(uid) -> str:
+    return f"0x{int(uid):x}"
+
+
+def _json_val(v):
+    if isinstance(v, np.datetime64):
+        s = np.datetime_as_string(v, unit="us")
+        if s.endswith(".000000"):
+            s = s[:-7]
+        return s + "Z"
+    if isinstance(v, (np.bool_, bool)):
+        return bool(v)
+    if isinstance(v, (np.integer, int)):
+        return int(v)
+    if isinstance(v, (np.floating, float)):
+        return float(v)
+    return str(v)
+
+
+def _cascade_ok(level: LevelNode, obj: dict) -> bool:
+    """@cascade: require the listed fields (or every queried field)."""
+    fields = level.sg.cascade
+    if fields and fields != ["__all__"]:
+        required = fields
+    else:
+        required = []
+        for leaf in level.leaf_sgs:
+            if leaf.is_uid_leaf or leaf.is_agg:
+                continue
+            required.append(leaf.alias or (
+                f"count({leaf.attr})" if leaf.is_count else
+                (f"val({leaf.attr})" if leaf.is_val_leaf else
+                 (f"{leaf.attr}@{leaf.lang}" if leaf.lang else leaf.attr))))
+        for child in level.children:
+            required.append(child.sg.alias or (
+                f"~{child.sg.attr}" if child.sg.is_reverse else child.sg.attr))
+    return all(f in obj for f in required)
+
+
+def _normalize(obj: dict) -> list[dict]:
+    """Cartesian flatten for @normalize (aliased scalars only survive —
+    matching the reference's 'only aliased predicates are returned')."""
+    base: dict = {}
+    list_parts: list[list[dict]] = []
+    for k, v in obj.items():
+        if isinstance(v, list) and v and isinstance(v[0], dict):
+            flats: list[dict] = []
+            for o in v:
+                flats.extend(_normalize(o))
+            if flats:
+                list_parts.append(flats)
+        elif isinstance(v, dict):
+            flats = _normalize(v)
+            if flats:
+                list_parts.append(flats)
+        else:
+            base[k] = v
+    results = [base]
+    for part in list_parts:
+        results = [dict(r, **p) for r in results for p in part]
+    return results

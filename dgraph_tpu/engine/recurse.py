@@ -1,0 +1,110 @@
+"""@recurse: iterative whole-frontier re-expansion until fixpoint/depth.
+
+Reference parity: `query/recurse.go` (expandRecurse) — THE north-star
+workload. The reference re-seeds the SubGraph with each hop's result and
+re-runs ProcessGraph; here each depth is one batched expansion per followed
+predicate over the union frontier, with the seen-set subtraction
+(`loop: false`) done with sorted-set difference.
+
+Semantics (documented, since the reference tree is unavailable to consult —
+SURVEY provenance warning): with `loop: false` a node is expanded at most
+once (its first visit); later appearances render without children. With
+`loop: true`, expansion repeats up to `depth` regardless of revisits
+(depth is required in that case to terminate).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from dgraph_tpu.engine.ir import SubGraph
+
+MAX_RECURSE_DEPTH = 64  # guard when depth: 0 (fixpoint mode)
+
+
+@dataclass
+class RecurseData:
+    """Per-predicate edge lists accumulated over all depths.
+
+    `edges[pred_key]` = (parents, children) rank arrays; every parent rank
+    appears in at most one depth (loop=false), so rows are unambiguous.
+    For loop=true, per-depth matrices are kept separate.
+    """
+
+    edge_sgs: list[SubGraph] = field(default_factory=list)
+    leaf_sgs: list[SubGraph] = field(default_factory=list)
+    # loop=false: one global matrix per predicate
+    edges: dict[int, tuple[np.ndarray, np.ndarray]] = field(default_factory=dict)
+    # loop=true: per-depth list of matrices keyed by (depth, pred index)
+    by_depth: list[dict[int, tuple[np.ndarray, np.ndarray]]] = field(default_factory=list)
+    loop: bool = False
+    all_nodes: np.ndarray = field(default_factory=lambda: np.zeros(0, np.int32))
+
+
+def expand_recurse(ex, root) -> None:
+    """Run the recurse loop below an already-evaluated root LevelNode."""
+    from dgraph_tpu.engine.execute import LevelNode  # noqa: F401 (doc)
+
+    sg = root.sg
+    args = sg.recurse
+    depth = args.depth or MAX_RECURSE_DEPTH
+    if args.loop and not args.depth:
+        raise ValueError("@recurse(loop: true) requires depth")
+
+    data = RecurseData(loop=args.loop)
+    for c in root.sg.children:
+        (data.edge_sgs if ex._expands(c) else data.leaf_sgs).append(c)
+
+    frontier = root.nodes
+    seen = root.nodes.copy()
+    for _d in range(depth):
+        if len(frontier) == 0:
+            break
+        level: dict[int, tuple[np.ndarray, np.ndarray]] = {}
+        new_parts = []
+        for i, esg in enumerate(data.edge_sgs):
+            nbrs, seg = ex.expand(esg.attr, esg.is_reverse, frontier)
+            nbrs, seg = ex.filter_edges(esg.filters, nbrs, seg)
+            if not args.loop and len(nbrs):
+                # visit-once: drop edges to already-seen nodes so the result
+                # graph is a DAG by depth (first-visit tree semantics)
+                keep = ~np.isin(nbrs, seen)
+                nbrs, seg = nbrs[keep], seg[keep]
+            if not len(nbrs):
+                continue
+            parents = frontier[seg]
+            if data.loop:
+                level[i] = (parents, nbrs)
+            else:
+                if i in data.edges:
+                    p0, c0 = data.edges[i]
+                    data.edges[i] = (np.concatenate([p0, parents]),
+                                     np.concatenate([c0, nbrs]))
+                else:
+                    data.edges[i] = (parents, nbrs)
+            new_parts.append(nbrs)
+        if data.loop:
+            data.by_depth.append(level)
+        if not new_parts:
+            break
+        nxt = np.unique(np.concatenate(new_parts)).astype(np.int32)
+        if not args.loop:
+            nxt = np.setdiff1d(nxt, seen).astype(np.int32)
+            seen = np.union1d(seen, nxt).astype(np.int32)
+        frontier = nxt
+
+    data.all_nodes = seen if not args.loop else np.unique(np.concatenate(
+        [root.nodes] + [c for lv in data.by_depth for (_p, c) in lv.values()]
+    )).astype(np.int32)
+    # leaf vars (value leaves inside recurse) bind over every visited node
+    for leaf in data.leaf_sgs:
+        if leaf.var_name:
+            saved_nodes = root.nodes
+            root.nodes = data.all_nodes
+            ex._record_leaf_vars(leaf, root)
+            root.nodes = saved_nodes
+    if sg.var_name:
+        ex.uid_vars[sg.var_name] = data.all_nodes
+    root.recurse_data = data

@@ -1,0 +1,21 @@
+"""Posting store, schema, types, tokenizers.
+
+Reference parity: posting/ (lists, MVCC, indexes), schema/, types/, tok/.
+"""
+
+from dgraph_tpu.store.schema import PredicateSchema, Schema, TypeDef, parse_schema
+from dgraph_tpu.store.store import (
+    TYPE_PRED,
+    EdgeRel,
+    PredicateData,
+    Store,
+    StoreBuilder,
+    ValueColumn,
+)
+from dgraph_tpu.store.types import Kind, convert, parse_datetime
+
+__all__ = [
+    "PredicateSchema", "Schema", "TypeDef", "parse_schema",
+    "TYPE_PRED", "EdgeRel", "PredicateData", "Store", "StoreBuilder",
+    "ValueColumn", "Kind", "convert", "parse_datetime",
+]

@@ -1,0 +1,98 @@
+"""Index tokenizers.
+
+Reference parity: `tok/tok.go` — the Tokenizer interface and the built-in
+family (exact, hash, term, fulltext, trigram, int/float/datetime buckets).
+Tokens key inverted indexes (token → sorted uid-rank posting list) used to
+answer root functions (`eq`, `anyofterms`, `alloftext`, `regexp`, ...).
+
+Numeric/datetime *comparisons* (le/ge/lt/gt/between) do NOT use tokens in
+this build: the store keeps sorted value columns and answers ranges with
+vectorised numpy/searchsorted — strictly better on this architecture than
+the reference's ordered token walk.
+"""
+
+from __future__ import annotations
+
+import re
+import unicodedata
+
+# ~Top English stopwords (the reference's fulltext tokenizer uses bleve's
+# english stopword list; this is the standard short list).
+STOPWORDS = frozenset(
+    """a an and are as at be but by for if in into is it no not of on or such
+    that the their then there these they this to was will with""".split()
+)
+
+_TERM_SPLIT = re.compile(r"[^\w]+", re.UNICODE)
+
+
+def _fold(s: str) -> str:
+    """Lowercase + strip diacritics (unicode normalisation)."""
+    s = unicodedata.normalize("NFKD", s.lower())
+    return "".join(c for c in s if not unicodedata.combining(c))
+
+
+def _stem(w: str) -> str:
+    """Tiny English suffix-stripper standing in for the reference's porter
+    stemmer — enough for fulltext matching symmetry (query and data pass
+    through the same function, so matching is consistent)."""
+    for suf in ("ational", "iveness", "fulness", "ousness", "ization",
+                "ations", "ingly", "ation", "ness", "ment", "ies", "ing",
+                "ed", "es", "ly", "s"):
+        if w.endswith(suf) and len(w) - len(suf) >= 3:
+            return w[: -len(suf)]
+    return w
+
+
+def exact_tokens(value) -> list[str]:
+    """`exact` index: the value itself, one token."""
+    return [str(value)]
+
+
+def hash_tokens(value) -> list[str]:
+    """`hash` index: same as exact for eq purposes (we key dicts by the
+    string itself; a real hash adds nothing host-side)."""
+    return [str(value)]
+
+
+def term_tokens(value) -> list[str]:
+    """`term` index: folded alphanumeric words, deduped."""
+    return sorted({w for w in _TERM_SPLIT.split(_fold(str(value))) if w})
+
+
+def fulltext_tokens(value) -> list[str]:
+    """`fulltext` index: term tokens minus stopwords, stemmed."""
+    return sorted({_stem(w) for w in _TERM_SPLIT.split(_fold(str(value)))
+                   if w and w not in STOPWORDS})
+
+
+def trigram_tokens(value) -> list[str]:
+    """`trigram` index (regexp support): all 3-grams of the raw string."""
+    s = str(value)
+    return sorted({s[i:i + 3] for i in range(len(s) - 2)}) if len(s) >= 3 else []
+
+
+TOKENIZERS = {
+    "exact": exact_tokens,
+    "hash": hash_tokens,
+    "term": term_tokens,
+    "fulltext": fulltext_tokens,
+    "trigram": trigram_tokens,
+    # numeric/datetime/bool "indexes" are satisfied by sorted value columns;
+    # registered as identity so schema validation accepts them.
+    "int": exact_tokens,
+    "float": exact_tokens,
+    "bool": exact_tokens,
+    "datetime": exact_tokens,
+    "year": exact_tokens,
+    "month": exact_tokens,
+    "day": exact_tokens,
+    "hour": exact_tokens,
+}
+
+
+def tokens_for(tokenizer: str, value) -> list[str]:
+    try:
+        return TOKENIZERS[tokenizer](value)
+    except KeyError:
+        raise ValueError(f"unknown tokenizer {tokenizer!r}") from None

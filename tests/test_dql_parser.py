@@ -1,0 +1,197 @@
+"""DQL parser unit tests (reference: gql/parser_test.go table-driven cases)."""
+
+import pytest
+
+from dgraph_tpu.dql import ParseError, parse, tokenize
+
+
+def first(src, **kw):
+    return parse(src, **kw)[0]
+
+
+def test_basic_block():
+    sg = first('{ me(func: eq(name, "Alice")) { name } }')
+    assert sg.alias == "me"
+    assert sg.func.name == "eq"
+    assert sg.func.attr == "name"
+    assert sg.func.args == ["Alice"]
+    assert sg.children[0].attr == "name"
+
+
+def test_unquoted_and_numeric_args():
+    sg = first("{ me(func: eq(age, 33)) { uid } }")
+    assert sg.func.args == [33]
+    assert sg.children[0].is_uid_leaf
+
+
+def test_uid_func_literals():
+    sg = first("{ me(func: uid(0x1, 2, 0xff)) { uid } }")
+    assert sg.func.uids == [1, 2, 255]
+
+
+def test_uid_func_var():
+    sg = parse("{ var(func: has(name)) { f as friend } q(func: uid(f)) { uid } }")[1]
+    assert sg.func.args == ["f"]
+
+
+def test_count_func_root():
+    sg = first("{ me(func: ge(count(friend), 2)) { uid } }")
+    assert sg.func.is_count and sg.func.attr == "friend" and sg.func.args == [2]
+
+
+def test_val_func_root():
+    sg = first("{ me(func: gt(val(score), 1.5)) { uid } }")
+    assert sg.func.is_val_var and sg.func.attr == "score"
+    assert sg.func.args == [1.5]
+
+
+def test_filter_tree_precedence():
+    sg = first("""{ me(func: has(name))
+        @filter(eq(a, 1) OR eq(b, 2) AND NOT eq(c, 3)) { uid } }""")
+    t = sg.filters
+    assert t.op == "or"
+    assert t.children[0].func.attr == "a"
+    assert t.children[1].op == "and"
+    assert t.children[1].children[1].op == "not"
+
+
+def test_filter_parens():
+    sg = first("""{ me(func: has(name))
+        @filter((eq(a, 1) OR eq(b, 2)) AND eq(c, 3)) { uid } }""")
+    assert sg.filters.op == "and"
+    assert sg.filters.children[0].op == "or"
+
+
+def test_pagination_and_order():
+    sg = first("{ me(func: has(name), first: 5, offset: 2, after: 0x10, orderasc: age) { uid } }")
+    assert (sg.first, sg.offset, sg.after) == (5, 2, 16)
+    assert sg.orders[0].attr == "age" and not sg.orders[0].desc
+
+
+def test_order_val_var():
+    sg = first("{ me(func: uid(1), orderdesc: val(x)) { uid } }")
+    assert sg.orders[0].is_val_var and sg.orders[0].desc
+
+
+def test_child_args_and_filter():
+    sg = first("""{ me(func: uid(1)) {
+        friend (first: 3, orderdesc: age) @filter(has(name)) { uid } } }""")
+    c = sg.children[0]
+    assert c.attr == "friend" and c.first == 3 and c.filters is not None
+
+
+def test_alias_and_var_fields():
+    sg = first("{ me(func: uid(1)) { buddy: friend { uid } x as age } }")
+    assert sg.children[0].alias == "buddy"
+    assert sg.children[1].var_name == "x" and sg.children[1].attr == "age"
+
+
+def test_reverse_and_lang():
+    sg = first("{ me(func: uid(1)) { ~starring { uid } name@en name@fr:. } }")
+    assert sg.children[0].is_reverse and sg.children[0].attr == "starring"
+    assert sg.children[1].lang == "en"
+    assert sg.children[2].lang == "fr:."
+
+
+def test_count_leaves():
+    sg = first("{ me(func: uid(1)) { count(friend) count(uid) c: count(~boss) } }")
+    assert sg.children[0].is_count and sg.children[0].attr == "friend"
+    assert sg.children[1].is_count and sg.children[1].is_uid_leaf
+    assert sg.children[2].is_reverse and sg.children[2].alias == "c"
+
+
+def test_aggregates_and_val():
+    sg = first("{ q(func: uid(1)) { min(val(a)) s: sum(val(b)) val(c) } }")
+    assert sg.children[0].is_agg and sg.children[0].agg_func == "min"
+    assert sg.children[1].alias == "s"
+    assert sg.children[2].is_val_leaf and sg.children[2].attr == "c"
+
+
+def test_math_expr_precedence():
+    sg = first("{ q(func: uid(1)) { m: math(a + b * 2 - c / d) } }")
+    t = sg.children[0].math_expr
+    assert t.op == "-"
+    assert t.children[0].op == "+"
+
+
+def test_math_funcs():
+    sg = first("{ q(func: uid(1)) { m: math(cond(a > 1, max(a, b), sqrt(c))) } }")
+    assert sg.children[0].math_expr.op == "cond"
+
+
+def test_recurse_args():
+    sg = first("{ q(func: uid(1)) @recurse(depth: 5, loop: true) { friend } }")
+    assert sg.recurse.depth == 5 and sg.recurse.loop
+
+
+def test_recurse_bare():
+    sg = first("{ q(func: uid(1)) @recurse { friend } }")
+    assert sg.recurse is not None and sg.recurse.depth == 0
+
+
+def test_shortest_block():
+    sg = first("{ path as shortest(from: 0x1, to: 0x6, numpaths: 2, depth: 9) { friend } }")
+    assert sg.shortest.from_uid == 1 and sg.shortest.to_uid == 6
+    assert sg.shortest.numpaths == 2 and sg.var_name == "path"
+
+
+def test_directives():
+    sg = first("{ q(func: uid(1)) @cascade @normalize { n: name } }")
+    assert sg.cascade == ["__all__"] and sg.normalize
+
+
+def test_groupby():
+    sg = first("{ q(func: uid(1)) { friend @groupby(age) { count(uid) } } }")
+    assert sg.children[0].groupby == ["age"]
+
+
+def test_expand():
+    sg = first("{ q(func: uid(1)) { expand(_all_) { expand(_all_) } } }")
+    c = sg.children[0]
+    assert c.is_expand_all and c.expand_arg == "_all_"
+    assert c.children[0].is_expand_all
+
+
+def test_regexp_arg():
+    sg = first("{ q(func: regexp(name, /^Bla.*de$/i)) { uid } }")
+    assert sg.func.args == ["^Bla.*de$", "i"]
+
+
+def test_query_vars_default_and_override():
+    src = 'query t($n: string = "Bob", $k: int = 3) { q(func: eq(name, $n), first: $k) { uid } }'
+    sg = first(src)
+    assert sg.func.args == ["Bob"] and sg.first == 3
+    sg = first(src, variables={"$n": "Eve", "$k": "7"})
+    assert sg.func.args == ["Eve"] and sg.first == 7
+
+
+def test_iri_names():
+    sg = first("{ q(func: has(<http://example.org/p>)) { <http://example.org/p> } }")
+    assert sg.func.attr == "http://example.org/p"
+
+
+def test_comments_ignored():
+    sg = first("{ # hello\n q(func: uid(1)) { uid # trailing\n } }")
+    assert sg.alias == "q"
+
+
+@pytest.mark.parametrize("bad", [
+    "{ q(func: eq(name, 1) { uid } }",      # missing paren
+    "{ q(func: bogus(name)) { uid } }",      # unknown func is parse-ok but...
+    "{ q(func: eq(name, 1)) { uid }",        # missing brace
+    "{ q(first: 1) { uid } ",                # unclosed
+    "{ q(func: uid(1)) @baddir { uid } }",   # unknown directive
+    "{ q(func: uid(1), wat: 3) { uid } }",   # unknown root arg
+])
+def test_parse_errors(bad):
+    if "bogus" in bad:
+        pytest.skip("unknown funcs are rejected at execution, like the reference")
+    with pytest.raises((ParseError, ValueError)):
+        parse(bad)
+
+
+def test_tokenize_division_vs_regex():
+    toks = tokenize("math(a / b)")
+    assert any(t.text == "/" and t.kind == "op" for t in toks)
+    toks2 = tokenize("regexp(name, /ab c/)")
+    assert any(t.kind == "regex" for t in toks2)

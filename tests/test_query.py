@@ -1,0 +1,423 @@
+"""DQL → JSON behavioral spec (reference: query/query_test.go — hundreds of
+table-driven query→JSON assertions over a fixture graph; SURVEY §4 calls
+this the single most valuable asset to replicate)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from dgraph_tpu.engine import Engine
+from dgraph_tpu.store import StoreBuilder, parse_schema
+
+SCHEMA = """
+name: string @index(exact, term, trigram) @lang .
+age: int @index(int) .
+height: float .
+alive: bool .
+dob: datetime @index(datetime) .
+friend: [uid] @reverse @count .
+boss: uid .
+starring: [uid] @reverse .
+genre: [uid] .
+nickname: string .
+type Person { name age friend }
+type Film  { name starring genre }
+"""
+
+# A small movie-ish fixture: people 1-6, films 100-102, genres 200-201.
+PEOPLE = {
+    1: ("Michonne", 38, 1.67, True, "1981-01-29"),
+    2: ("King Lear", 77, 1.70, False, "1926-01-02"),
+    3: ("Margaret", 31, 1.55, True, "1988-05-05"),
+    4: ("Leonard", 45, 1.85, True, "1978-12-25"),
+    5: ("Garfield", 5, 0.40, True, "2015-06-01"),
+    6: ("Bear", 12, 1.10, False, "2010-03-03"),
+}
+FRIENDS = [(1, 2), (1, 3), (1, 4), (2, 3), (3, 4), (4, 5), (5, 6)]
+FILMS = {100: "The Wire", 101: "Blade Runner", 102: "Blade Trinity"}
+STARRING = [(100, 1), (100, 2), (101, 3), (101, 1), (102, 3)]
+GENRES = {200: "Drama", 201: "SciFi"}
+FILM_GENRE = [(100, 200), (101, 201), (102, 201)]
+
+
+def build_store():
+    b = StoreBuilder(parse_schema(SCHEMA))
+    for uid, (name, age, height, alive, dob) in PEOPLE.items():
+        b.add_value(uid, "name", name)
+        b.add_value(uid, "age", age)
+        b.add_value(uid, "height", height)
+        b.add_value(uid, "alive", alive)
+        b.add_value(uid, "dob", dob)
+        b.add_type(uid, "Person")
+    b.add_value(1, "name", "Michonne-fr", lang="fr")
+    b.add_value(2, "nickname", "The King")
+    for s, o in FRIENDS:
+        b.add_edge(s, "friend", o)
+    b.add_edge(2, "boss", 1)
+    b.add_edge(3, "boss", 1)
+    for uid, name in FILMS.items():
+        b.add_value(uid, "name", name)
+        b.add_type(uid, "Film")
+    for s, o in STARRING:
+        b.add_edge(s, "starring", o)
+    for uid, name in GENRES.items():
+        b.add_value(uid, "name", name)
+    for s, o in FILM_GENRE:
+        b.add_edge(s, "genre", o)
+    return b.finalize()
+
+
+@pytest.fixture(scope="module", params=["host", "device"])
+def engine(request):
+    store = build_store()
+    # host: pure-numpy expansion; device: force every hop through the
+    # jitted kernel (threshold 0 → device path even for tiny frontiers)
+    thresh = 10**9 if request.param == "host" else 0
+    return Engine(store, device_threshold=thresh)
+
+
+def q(engine, text, variables=None):
+    return engine.query(text, variables)
+
+
+# ---- golden table ---------------------------------------------------------
+# (name, query, expected JSON) — executed against both expansion paths.
+CASES = [
+    ("eq_root_with_expand", """
+     { me(func: eq(name, "Michonne")) { name age friend { name } } }""",
+     {"me": [{"name": "Michonne", "age": 38,
+              "friend": [{"name": "King Lear"}, {"name": "Margaret"},
+                         {"name": "Leonard"}]}]}),
+
+    ("uid_root", """
+     { me(func: uid(0x1, 0x3)) { name } }""",
+     {"me": [{"name": "Michonne"}, {"name": "Margaret"}]}),
+
+    ("has_root", """
+     { me(func: has(nickname)) { name nickname } }""",
+     {"me": [{"name": "King Lear", "nickname": "The King"}]}),
+
+    ("type_root", """
+     { me(func: type(Film)) { name } }""",
+     {"me": [{"name": "The Wire"}, {"name": "Blade Runner"},
+             {"name": "Blade Trinity"}]}),
+
+    ("le_root", """
+     { young(func: le(age, 12)) { name age } }""",
+     {"young": [{"name": "Garfield", "age": 5}, {"name": "Bear", "age": 12}]}),
+
+    ("between_root", """
+     { mid(func: between(age, 30, 45)) { name } }""",
+     {"mid": [{"name": "Michonne"}, {"name": "Margaret"}, {"name": "Leonard"}]}),
+
+    ("anyofterms_root", """
+     { blade(func: anyofterms(name, "blade wire")) { name } }""",
+     {"blade": [{"name": "The Wire"}, {"name": "Blade Runner"},
+                {"name": "Blade Trinity"}]}),
+
+    ("allofterms_root", """
+     { blade(func: allofterms(name, "blade runner")) { name } }""",
+     {"blade": [{"name": "Blade Runner"}]}),
+
+    ("regexp_root", """
+     { re(func: regexp(name, /^Bla.*$/)) { name } }""",
+     {"re": [{"name": "Blade Runner"}, {"name": "Blade Trinity"}]}),
+
+    ("filter_and_not", """
+     { me(func: type(Person)) @filter(ge(age, 30) AND NOT eq(name, "King Lear"))
+       { name } }""",
+     {"me": [{"name": "Michonne"}, {"name": "Margaret"}, {"name": "Leonard"}]}),
+
+    ("filter_or", """
+     { me(func: type(Person)) @filter(eq(name, "Bear") OR eq(name, "Garfield"))
+       { name } }""",
+     {"me": [{"name": "Garfield"}, {"name": "Bear"}]}),
+
+    ("child_filter", """
+     { me(func: uid(1)) { name friend @filter(gt(age, 40)) { name } } }""",
+     {"me": [{"name": "Michonne",
+              "friend": [{"name": "King Lear"}, {"name": "Leonard"}]}]}),
+
+    ("reverse_edge", """
+     { lear(func: eq(name, "King Lear")) { name ~friend { name } } }""",
+     {"lear": [{"name": "King Lear", "~friend": [{"name": "Michonne"}]}]}),
+
+    ("reverse_alias", """
+     { m(func: uid(1)) { fans: ~starring { name } } }""",
+     {"m": [{"fans": [{"name": "The Wire"}, {"name": "Blade Runner"}]}]}),
+
+    ("count_leaf", """
+     { me(func: uid(1, 2)) { name count(friend) } }""",
+     {"me": [{"name": "Michonne", "count(friend)": 3},
+             {"name": "King Lear", "count(friend)": 1}]}),
+
+    ("count_uid_root", """
+     { total(func: type(Person)) { count(uid) } }""",
+     {"total": [{"count": 6}]}),
+
+    ("count_filter_root", """
+     { popular(func: ge(count(friend), 2)) { name } }""",
+     {"popular": [{"name": "Michonne"}]}),
+
+    ("pagination_first_offset", """
+     { me(func: type(Person), orderasc: age, first: 2, offset: 1) { name age } }""",
+     {"me": [{"name": "Bear", "age": 12}, {"name": "Margaret", "age": 31}]}),
+
+    ("order_desc", """
+     { me(func: type(Person), orderdesc: age, first: 2) { name } }""",
+     {"me": [{"name": "King Lear"}, {"name": "Leonard"}]}),
+
+    ("child_pagination", """
+     { me(func: uid(1)) { friend (first: 2) { name } } }""",
+     {"me": [{"friend": [{"name": "King Lear"}, {"name": "Margaret"}]}]}),
+
+    ("child_order", """
+     { me(func: uid(1)) { friend (orderdesc: age, first: 1) { name age } } }""",
+     {"me": [{"friend": [{"name": "King Lear", "age": 77}]}]}),
+
+    ("uid_leaf_format", """
+     { me(func: uid(5)) { uid name } }""",
+     {"me": [{"uid": "0x5", "name": "Garfield"}]}),
+
+    ("lang_tag", """
+     { me(func: uid(1)) { name@fr } }""",
+     {"me": [{"name@fr": "Michonne-fr"}]}),
+
+    ("alias_fields", """
+     { me(func: uid(2)) { fullname: name years: age } }""",
+     {"me": [{"fullname": "King Lear", "years": 77}]}),
+
+    ("two_blocks", """
+     { a(func: uid(5)) { name } b(func: uid(6)) { name } }""",
+     {"a": [{"name": "Garfield"}], "b": [{"name": "Bear"}]}),
+
+    ("uid_var_between_blocks", """
+     { var(func: eq(name, "Michonne")) { f as friend }
+       them(func: uid(f), orderasc: age) { name } }""",
+     {"them": [{"name": "Margaret"}, {"name": "Leonard"},
+               {"name": "King Lear"}]}),
+
+    ("val_var_agg", """
+     { var(func: type(Person)) { a as age }
+       stats(func: uid(a)) { min(val(a)) max(val(a)) sum(val(a)) } }""",
+     {"stats": [{"min(val(a))": 5}, {"max(val(a))": 77},
+                {"sum(val(a))": 208}]}),
+
+    ("val_var_reading", """
+     { var(func: uid(1)) { friend { a as age } }
+       f(func: uid(a), orderasc: val(a)) { name val(a) } }""",
+     {"f": [{"name": "Margaret", "val(a)": 31},
+            {"name": "Leonard", "val(a)": 45},
+            {"name": "King Lear", "val(a)": 77}]}),
+
+    ("math_expr", """
+     { var(func: uid(1, 2)) { a as age }
+       q(func: uid(a), orderasc: val(a)) { name double: math(a * 2) } }""",
+     {"q": [{"name": "Michonne", "double": 76},
+            {"name": "King Lear", "double": 154}]}),
+
+    ("filter_on_val_var", """
+     { var(func: type(Person)) { a as age }
+       old(func: uid(a)) @filter(gt(val(a), 40)) { name } }""",
+     {"old": [{"name": "King Lear"}, {"name": "Leonard"}]}),
+
+    # visit-once semantics: depth-2 edges to nodes already visited at
+    # depth 1 (2→3, 3→4) are dropped; only 4→5 introduces a new node
+    ("recurse_basic", """
+     { r(func: uid(1)) @recurse(depth: 2) { name friend } }""",
+     {"r": [{"name": "Michonne",
+             "friend": [{"name": "King Lear"},
+                        {"name": "Margaret"},
+                        {"name": "Leonard", "friend": [{"name": "Garfield"}]}]}]}),
+
+    ("recurse_fixpoint", """
+     { r(func: uid(4)) @recurse { name friend } }""",
+     {"r": [{"name": "Leonard",
+             "friend": [{"name": "Garfield",
+                         "friend": [{"name": "Bear"}]}]}]}),
+
+    ("shortest_path", """
+     { path as shortest(from: 0x1, to: 0x6) { friend } }""",
+     {"_path_": [{"uid": "0x1", "friend": {
+         "uid": "0x4", "friend": {
+             "uid": "0x5", "friend": {"uid": "0x6"}}}}]}),
+
+    ("shortest_with_names", """
+     { path as shortest(from: 0x1, to: 0x5) { friend }
+       names(func: uid(path), orderasc: uid) { name } }""",
+     {"_path_": [{"uid": "0x1", "friend": {"uid": "0x4",
+                                           "friend": {"uid": "0x5"}}}],
+      "names": [{"name": "Michonne"}, {"name": "Leonard"},
+                {"name": "Garfield"}]}),
+
+    ("cascade", """
+     { me(func: type(Person)) @cascade { name nickname } }""",
+     {"me": [{"name": "King Lear", "nickname": "The King"}]}),
+
+    ("normalize", """
+     { me(func: uid(1)) @normalize { n: name friend { fn: name } } }""",
+     {"me": [{"n": "Michonne", "fn": "King Lear"},
+             {"n": "Michonne", "fn": "Margaret"},
+             {"n": "Michonne", "fn": "Leonard"}]}),
+
+    ("groupby_count", """
+     { people(func: type(Person)) @groupby(alive) { count(uid) } }""",
+     {"people": [{"@groupby": [{"alive": False, "count": 2},
+                               {"alive": True, "count": 4}]}]}),
+
+    ("expand_all_type", """
+     { me(func: uid(5)) { expand(Person) } }""",
+     {"me": [{"name": "Garfield", "age": 5}]}),
+
+    ("uid_in", """
+     { subs(func: uid_in(boss, 0x1), orderasc: uid) { name } }""",
+     {"subs": [{"name": "King Lear"}, {"name": "Margaret"}]}),
+
+    ("dob_filter", """
+     { old(func: le(dob, "1950-01-01")) { name } }""",
+     {"old": [{"name": "King Lear"}]}),
+
+    ("multi_hop_3", """
+     { m(func: uid(2)) { friend { friend { friend { name } } } } }""",
+     {"m": [{"friend": [{"friend": [{"friend": [{"name": "Garfield"}]}]}]}]}),
+
+    ("empty_result", """
+     { none(func: eq(name, "Nobody")) { name } }""",
+     {"none": []}),
+
+    ("query_vars", """
+     query test($who: string = "Bear") { me(func: eq(name, $who)) { age } }""",
+     {"me": [{"age": 12}]}),
+
+    ("bool_filter", """
+     { dead(func: type(Person)) @filter(eq(alive, false)) { name } }""",
+     {"dead": [{"name": "King Lear"}, {"name": "Bear"}]}),
+]
+
+
+@pytest.mark.parametrize("name,query,expected", CASES,
+                         ids=[c[0] for c in CASES])
+def test_golden(engine, name, query, expected):
+    got = q(engine, query)
+    assert got == expected, (
+        f"\nquery: {query}\ngot:      {json.dumps(got, sort_keys=True)}"
+        f"\nexpected: {json.dumps(expected, sort_keys=True)}")
+
+
+# ---- regression tests from code review ------------------------------------
+
+def test_filter_uid_mixed_var_and_literal(engine):
+    """uid(v, 0x1) in a filter must union the var with the literal."""
+    out = q(engine, """
+      { v as var(func: uid(0x2)) { uid }
+        q(func: uid(0x1, 0x2, 0x3)) @filter(uid(v, 0x1)) { uid } }""")
+    assert out["q"] == [{"uid": "0x1"}, {"uid": "0x2"}]
+
+
+def test_child_groupby_is_per_parent(engine):
+    """@groupby on a child groups each parent's own edge list."""
+    out = q(engine, """
+      { p(func: uid(1, 2)) { name friend @groupby(alive) { count(uid) } } }""")
+    michonne, lear = out["p"]
+    # Michonne's friends: King Lear(dead), Margaret, Leonard (alive)
+    assert michonne["friend"] == [{"@groupby": [
+        {"alive": False, "count": 1}, {"alive": True, "count": 2}]}]
+    # King Lear's friends: Margaret (alive)
+    assert lear["friend"] == [{"@groupby": [{"alive": True, "count": 1}]}]
+
+
+def test_numpaths_enumerates_shortest_dag(engine):
+    """two equal-length paths 1→3→4 and 1→4 … use a diamond: 1→2→3, 1→3."""
+    out = q(engine, """
+      { path as shortest(from: 0x2, to: 0x4, numpaths: 4) { friend } }""")
+    # 2→3→4 is the only shortest path in the fixture
+    assert len(out["_path_"]) == 1
+    out2 = q(engine, """
+      { path as shortest(from: 0x1, to: 0x3, numpaths: 4) { friend } }""")
+    assert out2["_path_"] == [{"uid": "0x1", "friend": {"uid": "0x3"}}]
+
+
+def test_duplicate_value_set_semantics():
+    """Re-adding the same (subj, pred, value) must not duplicate it."""
+    b = StoreBuilder(parse_schema("name: string ."))
+    b.add_value(1, "name", "alice")
+    b.add_value(1, "name", "alice")
+    e = Engine(b.finalize())
+    assert e.query("{ q(func: uid(1)) { name } }") == {
+        "q": [{"name": "alice"}]}
+
+
+def test_math_unspaced_minus(engine):
+    out = q(engine, """
+      { var(func: uid(1)) { a as age }
+        q(func: uid(a)) { m: math(a-8) } }""")
+    assert out["q"] == [{"m": 30}]
+
+
+def test_string_escape_roundtrip(engine):
+    from dgraph_tpu.dql.parser import parse as p
+    sg = p(r'{ q(func: eq(name, "C:\\new\tx")) { uid } }')[0]
+    assert sg.func.args == ["C:\\new\tx"]
+
+
+def test_eq_lang_tagged_uses_lang_column(engine):
+    """eq(name@fr, ...) must not hit the merged (lang-less) index."""
+    out = q(engine, '{ q(func: eq(name@fr, "Michonne")) { uid } }')
+    assert out == {"q": []}
+    out2 = q(engine, '{ q(func: eq(name@fr, "Michonne-fr")) { uid } }')
+    assert out2 == {"q": [{"uid": "0x1"}]}
+
+
+def test_has_reverse(engine):
+    out = q(engine, "{ q(func: has(~friend)) { name } }")
+    assert out == {"q": [{"name": "King Lear"}, {"name": "Margaret"},
+                         {"name": "Leonard"}, {"name": "Garfield"},
+                         {"name": "Bear"}]}
+
+
+def test_nested_aggregate(engine):
+    out = q(engine, """
+      { var(func: type(Person)) { a as age }
+        q(func: uid(1)) { name friend { min(val(a)) cnt: count(uid) } } }""")
+    assert out == {"q": [{"name": "Michonne",
+                          "friend": [{"min(val(a))": 31}, {"cnt": 3}]}]}
+
+
+def test_parser_unterminated_raises_fast(engine):
+    import time
+    from dgraph_tpu.dql import ParseError, parse as p
+    t0 = time.time()
+    for bad in ["{ q(func: uid(0x1", "{ q(func: eq(name,", "{ q(func: uid(1)) {"]:
+        with pytest.raises(ParseError):
+            p(bad)
+    assert time.time() - t0 < 2
+
+
+def test_duplicate_block_names_rejected(engine):
+    from dgraph_tpu.dql import ParseError, parse as p
+    with pytest.raises(ParseError):
+        p('{ q(func: uid(1)) { uid } q(func: uid(2)) { uid } }')
+    # var blocks may repeat
+    p('{ var(func: uid(1)) { uid } var(func: uid(2)) { uid } }')
+
+
+def test_blocks_execute_in_dependency_order(engine):
+    out = q(engine, """
+      { them(func: uid(f), orderasc: age) { name }
+        var(func: eq(name, "Michonne")) { f as friend } }""")
+    assert out["them"] == [{"name": "Margaret"}, {"name": "Leonard"},
+                          {"name": "King Lear"}]
+
+
+def test_groupby_uid_predicate(engine):
+    out = q(engine, """
+      { films(func: type(Film)) @groupby(genre) { count(uid) } }""")
+    assert out == {"films": [{"@groupby": [
+        {"genre": "0xc8", "count": 1}, {"genre": "0xc9", "count": 2}]}]}
+
+
+def test_iri_reverse_and_aliased_uid(engine):
+    out = q(engine, '{ lear(func: eq(name, "King Lear")) { myid: uid ~<friend> { name } } }')
+    assert out == {"lear": [{"myid": "0x2",
+                             "~friend": [{"name": "Michonne"}]}]}

@@ -1,0 +1,191 @@
+"""Store/schema/types/tok tests (reference: posting/list_test.go,
+schema parse tests, tok tests — SURVEY §4)."""
+
+import numpy as np
+import pytest
+
+from dgraph_tpu.store import (
+    Kind, Schema, Store, StoreBuilder, parse_schema,
+)
+from dgraph_tpu.store import tok
+
+
+# -- schema parser ----------------------------------------------------------
+
+def test_parse_schema_basic():
+    sch = parse_schema("""
+        # movie graph
+        name: string @index(exact, term) @lang .
+        age: int @index(int) .
+        friend: [uid] @reverse @count .
+        score: float .
+        alive: bool .
+        born: datetime @index(year) .
+        type Person {
+          name
+          age
+          friend
+        }
+    """)
+    assert sch.predicates["name"].kind == Kind.STRING
+    assert sch.predicates["name"].index_tokenizers == ("exact", "term")
+    assert sch.predicates["name"].lang
+    assert sch.predicates["friend"].is_list and sch.predicates["friend"].reverse
+    assert sch.predicates["friend"].count
+    assert sch.predicates["friend"].kind == Kind.UID
+    assert sch.types["Person"].fields == ("name", "age", "friend")
+
+
+@pytest.mark.parametrize("bad", [
+    "name string .",                 # missing colon
+    "name: string @index .",         # index w/o tokenizers
+    "name: string @index(bogus) .",  # unknown tokenizer
+    "friend: uid @index(exact) .",   # index on uid
+    "name: string @reverse .",       # reverse on scalar
+    "x: [int .",                     # unbalanced list
+    "x: widget .",                   # unknown type
+])
+def test_parse_schema_rejects(bad):
+    with pytest.raises(ValueError):
+        parse_schema(bad)
+
+
+def test_schema_roundtrip():
+    src = "name: string @index(exact) @lang .\nfriend: [uid] @reverse ."
+    sch = parse_schema(src)
+    again = parse_schema(sch.to_text())
+    assert again.predicates.keys() == sch.predicates.keys()
+    assert again.predicates["friend"].reverse
+
+
+# -- tokenizers -------------------------------------------------------------
+
+def test_term_tokens_fold():
+    assert tok.term_tokens("Hello, WORLD—café!") == ["cafe", "hello", "world"]
+
+
+def test_fulltext_stopwords_and_stem():
+    toks = tok.fulltext_tokens("The running dogs are jumping")
+    assert "the" not in toks and "are" not in toks
+    assert "runn" in toks or "running" in toks  # stemmed consistently
+    assert tok.fulltext_tokens("running") == tok.fulltext_tokens("RUNNING")
+
+
+def test_trigram_tokens():
+    assert tok.trigram_tokens("abcd") == ["abc", "bcd"]
+    assert tok.trigram_tokens("ab") == []
+
+
+# -- store build ------------------------------------------------------------
+
+@pytest.fixture
+def movie_store():
+    sch = parse_schema("""
+        name: string @index(exact, term) .
+        age: int .
+        friend: [uid] @reverse .
+        starring: [uid] .
+    """)
+    b = StoreBuilder(sch)
+    # uids deliberately sparse/non-contiguous
+    b.add_value(1000, "name", "Alice")
+    b.add_value(2000, "name", "Bob")
+    b.add_value(3000, "name", "Carol the boss")
+    b.add_value(1000, "age", 33)
+    b.add_edge(1000, "friend", 2000)
+    b.add_edge(1000, "friend", 3000)
+    b.add_edge(2000, "friend", 3000)
+    b.add_edge(5000, "starring", 1000)
+    b.add_type(1000, "Person")
+    b.add_type(5000, "Film")
+    return b.finalize()
+
+
+def test_uid_rank_roundtrip(movie_store):
+    s = movie_store
+    assert s.n_nodes == 4
+    ranks = s.rank_of([1000, 2000, 3000, 5000])
+    np.testing.assert_array_equal(ranks, [0, 1, 2, 3])
+    np.testing.assert_array_equal(s.uid_of(ranks), [1000, 2000, 3000, 5000])
+    assert s.rank_of([999])[0] == -1
+    assert s.rank_of([99999])[0] == -1
+
+
+def test_csr_rows_sorted_dedup(movie_store):
+    s = movie_store
+    rel = s.rel("friend")
+    r1000 = s.rank_of([1000])[0]
+    row = rel.row(r1000)
+    np.testing.assert_array_equal(s.uid_of(row), [2000, 3000])
+    # reverse edges
+    rrev = s.rel("friend", reverse=True)
+    r3000 = s.rank_of([3000])[0]
+    np.testing.assert_array_equal(s.uid_of(rrev.row(r3000)), [1000, 2000])
+
+
+def test_missing_predicate_is_empty(movie_store):
+    rel = movie_store.rel("nonexistent")
+    assert rel.nnz == 0
+    assert rel.indptr.shape == (movie_store.n_nodes + 1,)
+
+
+def test_values_and_index(movie_store):
+    s = movie_store
+    r = int(s.rank_of([3000])[0])
+    assert s.values_for("name", r) == ["Carol the boss"]
+    # exact index
+    hit = s.index_lookup("name", "exact", "Alice")
+    np.testing.assert_array_equal(s.uid_of(hit), [1000])
+    # term index folds
+    hit2 = s.index_lookup("name", "term", "boss")
+    np.testing.assert_array_equal(s.uid_of(hit2), [3000])
+    assert len(s.index_lookup("name", "exact", "nobody")) == 0
+
+
+def test_has_ranks(movie_store):
+    s = movie_store
+    np.testing.assert_array_equal(s.uid_of(s.has_ranks("friend")), [1000, 2000])
+    np.testing.assert_array_equal(s.uid_of(s.has_ranks("name")), [1000, 2000, 3000])
+    assert len(s.has_ranks("nope")) == 0
+
+
+def test_type_pred_and_expand_all(movie_store):
+    s = movie_store
+    hit = s.index_lookup("dgraph.type", "exact", "Person")
+    np.testing.assert_array_equal(s.uid_of(hit), [1000])
+
+
+def test_type_conflict_raises():
+    b = StoreBuilder()
+    b.add_value(1, "p", "str")
+    with pytest.raises(ValueError):
+        b.add_edge(1, "p", 2)
+
+
+def test_duplicate_edges_dedup():
+    b = StoreBuilder()
+    for _ in range(3):
+        b.add_edge(1, "e", 2)
+    s = b.finalize()
+    assert s.rel("e").nnz == 1
+
+
+def test_device_rel_cached(movie_store):
+    s = movie_store
+    a1 = s.device_rel("friend")
+    a2 = s.device_rel("friend")
+    assert a1[0] is a2[0]
+
+
+def test_hop_over_store(movie_store):
+    """Store CSR feeds the ops hop kernel end-to-end."""
+    from dgraph_tpu import ops
+    s = movie_store
+    indptr, indices = s.device_rel("friend")
+    frontier = ops.pad_to(s.rank_of([1000, 2000]), 8)
+    nxt, nxt_count, *_, total = ops.expand_frontier(
+        indptr, indices, frontier, edge_cap=16, out_cap=16)
+    assert int(total) == 3
+    got = np.asarray(nxt)
+    got = got[got != ops.SENTINEL32]
+    np.testing.assert_array_equal(s.uid_of(got), [2000, 3000])
