@@ -1,0 +1,42 @@
+"""Device mesh construction for distributed query execution.
+
+Reference parity: `conn/` + `worker/groups.go` establish the cluster
+topology (which Alpha serves which tablet, gRPC pools between them). On
+TPU the topology is a `jax.sharding.Mesh`: one named axis, ``"shard"``,
+over which posting-store rows are partitioned and across which the hop
+kernel's collectives (all_gather / psum / ppermute) run on ICI.
+
+Multi-host scaling rides the same mesh: `jax.distributed.initialize()`
+extends `jax.devices()` across hosts over DCN and everything below is
+unchanged — the moral equivalent of adding Alphas to a Raft group without
+touching query code.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+SHARD_AXIS = "shard"
+
+
+def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
+    """A 1-D mesh over the first `n_devices` devices (default: all)."""
+    if devices is None:
+        devices = jax.devices()
+        if n_devices is not None:
+            if len(devices) < n_devices:
+                raise ValueError(
+                    f"requested {n_devices} devices, have {len(devices)}")
+            devices = devices[:n_devices]
+    return Mesh(np.array(devices), (SHARD_AXIS,))
+
+
+def shard_leading(mesh: Mesh) -> NamedSharding:
+    """Sharding that splits an array's leading axis over the mesh."""
+    return NamedSharding(mesh, P(SHARD_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
