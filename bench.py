@@ -1,0 +1,139 @@
+"""North-star benchmark: edges traversed/sec on 3-hop @recurse.
+
+Reference parity: BASELINE.json's north star — the 3-hop @recurse traversal
+(query/recurse.go expandRecurse) whose CPU cost in the reference is per-uid
+posting-list walks (posting/list.go List.Uids) + sorted merges
+(algo.MergeSorted). No published reference numbers exist in this
+environment (SURVEY §6), so the baseline denominator is measured here: the
+same traversal as a tight vectorised-numpy CPU program (a *stronger*
+baseline than the Go per-uid loops it stands in for). The TPU numerator is
+the fused `ops.recurse.recurse_frontier` kernel — the whole depth-3
+traversal as one XLA program.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": ..., "unit": "edges/s", "vs_baseline": ...}
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+N_NODES = 1 << 20          # ~1M nodes
+AVG_DEG = 16.0             # ~16M directed edges
+N_SEEDS = 4096
+DEPTH = 3
+CPU_REPS = 3
+DEV_REPS = 10
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def cpu_recurse(indptr, indices, seeds, depth):
+    """Vectorised numpy loop=false recurse; returns (seen, edges, hop stats)."""
+    frontier = np.unique(seeds).astype(np.int64)
+    seen = frontier.copy()
+    edges = 0
+    max_edges = max_front = 0
+    for _ in range(depth):
+        if not len(frontier):
+            break
+        starts = indptr[frontier].astype(np.int64)
+        deg = (indptr[frontier + 1] - indptr[frontier]).astype(np.int64)
+        total = int(deg.sum())
+        base = np.repeat(np.cumsum(deg) - deg, deg)
+        pos = np.repeat(starts, deg) + (np.arange(total) - base)
+        nbrs = indices[pos]
+        edges += total
+        max_edges = max(max_edges, total)
+        uniq = np.unique(nbrs)
+        # the kernel's frontier buffer must hold the merged uniques
+        # BEFORE seen-subtraction
+        max_front = max(max_front, len(uniq))
+        nxt = np.setdiff1d(uniq, seen)
+        seen = np.union1d(seen, nxt)
+        frontier = nxt
+    return seen, edges, max_edges, max_front
+
+
+def pow2(n: int) -> int:
+    b = 1
+    while b < n:
+        b <<= 1
+    return b
+
+
+def main():
+    import jax
+
+    from dgraph_tpu.models.synthetic import powerlaw_rel
+    from dgraph_tpu.ops.recurse import recurse_frontier
+    from dgraph_tpu.ops.uidalgebra import pad_to
+
+    log(f"building graph: {N_NODES} nodes, avg_deg {AVG_DEG} ...")
+    rel = powerlaw_rel(N_NODES, AVG_DEG, seed=42)
+    log(f"graph: {rel.nnz} edges")
+
+    rng = np.random.default_rng(7)
+    seeds = np.unique(rng.integers(0, N_NODES, N_SEEDS)).astype(np.int32)
+
+    # -- CPU baseline (the reference Alpha's role) --------------------------
+    seen, edges, max_edges, max_front = cpu_recurse(
+        rel.indptr, rel.indices, seeds, DEPTH)
+    t = []
+    for _ in range(CPU_REPS):
+        t0 = time.perf_counter()
+        cpu_recurse(rel.indptr, rel.indices, seeds, DEPTH)
+        t.append(time.perf_counter() - t0)
+    cpu_s = min(t)
+    cpu_eps = edges / cpu_s
+    log(f"cpu: {edges} edges in {cpu_s:.3f}s = {cpu_eps:,.0f} edges/s "
+        f"(reached {len(seen)} nodes)")
+
+    # -- TPU fused kernel ---------------------------------------------------
+    edge_cap = pow2(max_edges)
+    out_cap = pow2(max(max_front, len(seeds)))
+    seen_cap = pow2(len(seen))
+    log(f"device: {jax.devices()[0].platform}, caps: edge={edge_cap} "
+        f"out={out_cap} seen={seen_cap}")
+
+    indptr_d = jax.device_put(rel.indptr)
+    indices_d = jax.device_put(rel.indices)
+    frontier = jax.device_put(pad_to(seeds, out_cap))
+
+    def run():
+        return recurse_frontier(indptr_d, indices_d, frontier,
+                                edge_cap=edge_cap, out_cap=out_cap,
+                                seen_cap=seen_cap, depth=DEPTH)
+
+    t0 = time.perf_counter()
+    last, seen_d, edges_d, needs = jax.block_until_ready(run())
+    log(f"compile+first run: {time.perf_counter() - t0:.1f}s")
+    needs = np.asarray(needs)
+    assert np.all(needs <= [out_cap, seen_cap, edge_cap]), needs
+    assert int(edges_d) == edges, (int(edges_d), edges)
+
+    t = []
+    for _ in range(DEV_REPS):
+        t0 = time.perf_counter()
+        jax.block_until_ready(run())
+        t.append(time.perf_counter() - t0)
+    dev_s = min(t)
+    dev_eps = edges / dev_s
+    log(f"tpu: {edges} edges in {dev_s * 1e3:.1f}ms = {dev_eps:,.0f} edges/s")
+
+    print(json.dumps({
+        "metric": "edges_traversed_per_sec_3hop_recurse",
+        "value": round(dev_eps),
+        "unit": "edges/s",
+        "vs_baseline": round(dev_eps / cpu_eps, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

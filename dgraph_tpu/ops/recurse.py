@@ -1,0 +1,64 @@
+"""Fused multi-hop @recurse as ONE compiled single-device program.
+
+Reference parity: `query/recurse.go` (expandRecurse) — the north-star
+workload. The reference's outer Python-equivalent loop (re-seed SubGraph,
+re-run ProcessGraph per depth) becomes a `lax.scan` over hops, so an entire
+depth-k traversal is a single XLA program with zero host round-trips: each
+hop is gather → sort-unique → seen-set difference, all fused.
+
+The multi-device version (shard_map + collectives) lives in
+`parallel/dhop.py::recurse_fused`; this is its single-chip core, and the
+kernel `bench.py` times on real TPU hardware.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from dgraph_tpu.ops.hop import gather_edges
+from dgraph_tpu.ops.uidalgebra import difference_sorted, sort_unique_count
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("edge_cap", "out_cap", "seen_cap", "depth"))
+def recurse_frontier(indptr: jax.Array, indices: jax.Array,
+                     frontier: jax.Array, edge_cap: int, out_cap: int,
+                     seen_cap: int, depth: int):
+    """Depth-bounded loop-free @recurse over one CSR relation, fully fused.
+
+    `frontier` must be sorted, sentinel-padded to exactly `out_cap` (it is
+    the per-hop frontier buffer carried through the scan). Returns
+    `(last_frontier[out_cap], seen[seen_cap], edges_traversed, needs[3])`
+    with `needs = [max frontier slots, max seen slots, max edge slots]` any
+    hop required. Results are valid only if `needs <= [out_cap, seen_cap,
+    edge_cap]` elementwise; otherwise re-run with the caps `needs` asks for
+    (the same overflow contract as ops.hop.expand_frontier).
+    """
+    if frontier.shape[0] != out_cap:
+        raise ValueError(
+            f"frontier buffer {frontier.shape[0]} != out_cap {out_cap}")
+
+    def hop(carry, _):
+        fr, seen, edges, need_out, need_seen, need_edge = carry
+        nbrs, _seg, _pos, _valid, total = gather_edges(
+            indptr, indices, fr, edge_cap)
+        merged, mcnt = sort_unique_count(nbrs, out_cap)
+        # loop=false semantics: a node expands at most once (first visit).
+        fresh = difference_sorted(merged, seen)
+        seen, scnt = sort_unique_count(
+            jnp.concatenate([seen, fresh]), seen_cap)
+        return (fresh, seen, edges + total,
+                jnp.maximum(need_out, mcnt),
+                jnp.maximum(need_seen, scnt),
+                jnp.maximum(need_edge, total)), None
+
+    seen0, scnt0 = sort_unique_count(frontier, seen_cap)
+    (last, seen, edges, need_out, need_seen, need_edge), _ = lax.scan(
+        hop,
+        (frontier, seen0, jnp.int32(0), jnp.int32(0), scnt0, jnp.int32(0)),
+        None, length=depth)
+    return last, seen, edges, jnp.stack([need_out, need_seen, need_edge])
