@@ -190,12 +190,26 @@ def _uid_in(store: Store, f: FuncNode) -> np.ndarray:
     return np.unique(srcs).astype(np.int32)
 
 
+def _require_index(store: Store, attr: str, tokenizer: str, func: str) -> None:
+    """Reference: tokenizer-backed funcs error without the matching
+    @index (worker/task.go: "Attribute X is not indexed with type Y")."""
+    ps = store.schema.peek(attr)
+    if ps is None or tokenizer not in ps.index_tokenizers:
+        raise ValueError(
+            f"attribute {attr!r} is not indexed with tokenizer "
+            f"{tokenizer!r} (required by {func})")
+
+
 def _terms(store: Store, f: FuncNode, any_: bool) -> np.ndarray:
+    _require_index(store, f.attr, "term",
+                   "anyofterms" if any_ else "allofterms")
     toks = term_tokens(" ".join(str(a) for a in f.args))
     return _token_combine(store, f.attr, "term", toks, any_)
 
 
 def _text(store: Store, f: FuncNode, any_: bool) -> np.ndarray:
+    _require_index(store, f.attr, "fulltext",
+                   "anyoftext" if any_ else "alloftext")
     toks = fulltext_tokens(" ".join(str(a) for a in f.args))
     return _token_combine(store, f.attr, "fulltext", toks, any_)
 

@@ -1,0 +1,120 @@
+"""Mutation input parsing: RDF N-Quads and JSON → NQuad batches.
+
+Reference parity: `chunker/` (`ParseRDF` n-quad lexing into `api.NQuad`,
+`ParseJSON` nested-object flattening with blank-node generation). The
+subset covers what the reference's live/bulk loaders and mutation API
+accept day-to-day: uid/blank subjects, string objects with language tags
+and `^^` type hints, star deletion, facets omitted (tracked in schema as a
+later layer).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from dataclasses import dataclass
+
+_TYPE_MAP = {
+    "xs:int": int, "xs:integer": int,
+    "xs:float": float, "xs:double": float,
+    "xs:boolean": lambda s: s.lower() == "true",
+    "xs:string": str, "xs:dateTime": str,
+}
+for _k in list(_TYPE_MAP):
+    _TYPE_MAP[f"http://www.w3.org/2001/XMLSchema#{_k.split(':')[1]}"] = _TYPE_MAP[_k]
+
+
+@dataclass
+class NQuad:
+    """One parsed statement (reference: api.NQuad)."""
+
+    subject: str                 # "0x1" | "_:blank" | "uid(v)"
+    predicate: str
+    object_id: str | None = None   # uid-valued object
+    object_value: object = None    # scalar-valued object
+    lang: str = ""
+    is_star: bool = False          # object "*" (delete-all)
+
+
+_NQUAD_RE = re.compile(
+    r'^\s*'
+    r'(?:<([^>]*)>|(_:[A-Za-z0-9._-]+)|(uid\([^)]*\)))\s+'      # subject
+    r'<([^>]*)>\s+'                                             # predicate
+    r'(?:'
+    r'<([^>]*)>|(_:[A-Za-z0-9._-]+)|(uid\([^)]*\))|(\*)|'       # object id/*
+    r'"((?:[^"\\]|\\.)*)"'                                      # literal
+    r'(?:@([A-Za-z-]+)|\^\^<([^>]*)>)?'
+    r')\s*\.\s*$')
+
+
+def parse_rdf(text: str) -> list[NQuad]:
+    """Parse N-Quad lines (reference: chunker/rdf parsing)."""
+    out: list[NQuad] = []
+    for lineno, line in enumerate(text.splitlines(), 1):
+        s = line.strip()
+        if not s or s.startswith("#"):
+            continue
+        m = _NQUAD_RE.match(s)
+        if not m:
+            raise ValueError(f"bad N-Quad at line {lineno}: {line!r}")
+        (s_iri, s_blank, s_var, pred, o_iri, o_blank, o_var, star,
+         lit, lang, typ) = m.groups()
+        subject = s_iri or s_blank or s_var
+        nq = NQuad(subject=subject, predicate=pred)
+        if star:
+            nq.is_star = True
+        elif lit is not None:
+            v: object = re.sub(r'\\(.)', r'\1', lit)
+            if typ:
+                conv = _TYPE_MAP.get(typ)
+                if conv is None:
+                    raise ValueError(f"unknown datatype {typ!r} line {lineno}")
+                v = conv(v)
+            nq.object_value = v
+            nq.lang = lang or ""
+        else:
+            nq.object_id = o_iri or o_blank or o_var
+        out.append(nq)
+    return out
+
+
+def parse_json(obj, _counter: list | None = None) -> list[NQuad]:
+    """Flatten a JSON mutation object (reference: chunker/json.go).
+
+    Nested objects without "uid" become blank nodes; lists fan out; keys
+    "uid" and "dgraph.type" follow reference semantics.
+    """
+    if isinstance(obj, (str, bytes)):
+        obj = json.loads(obj)
+    counter = _counter if _counter is not None else [0]
+    out: list[NQuad] = []
+    items = obj if isinstance(obj, list) else [obj]
+    for it in items:
+        _flatten(it, counter, out)
+    return out
+
+
+def _node_ref(it: dict, counter: list) -> str:
+    uid = it.get("uid")
+    if uid is None:
+        counter[0] += 1
+        uid = f"_:json.{counter[0]}"
+        it["uid"] = uid
+    return str(uid)
+
+
+def _flatten(it: dict, counter: list, out: list[NQuad]) -> None:
+    subj = _node_ref(it, counter)
+    for k, v in list(it.items()):
+        if k == "uid":
+            continue
+        vals = v if isinstance(v, list) else [v]
+        for one in vals:
+            if isinstance(one, dict):
+                ref = _node_ref(one, counter)
+                out.append(NQuad(subject=subj, predicate=k, object_id=ref))
+                _flatten(one, counter, out)
+            elif one is None:
+                out.append(NQuad(subject=subj, predicate=k, is_star=True))
+            else:
+                out.append(NQuad(subject=subj, predicate=k, object_value=one))

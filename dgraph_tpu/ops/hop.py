@@ -25,6 +25,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 from dgraph_tpu.ops.uidalgebra import sentinel, sort_unique_count, valid_mask
 
@@ -59,12 +60,21 @@ def gather_edges(indptr: jax.Array, indices: jax.Array, frontier: jax.Array,
     total = jnp.sum(deg)
 
     j = jnp.arange(edge_cap, dtype=jnp.int32)
-    # Which frontier slot does edge j belong to? Rightmost offset ≤ j.
-    seg = jnp.searchsorted(offsets, j, side="right").astype(jnp.int32) - 1
-    seg = jnp.clip(seg, 0, frontier.shape[0] - 1)
-    within = j - offsets[seg]
-    src_rank = jnp.where(valid_mask(frontier), frontier, 0)[seg]
-    edge_pos = jnp.take(indptr, src_rank, mode="clip") + within
+    # Which frontier slot does edge j belong to? Scatter each non-empty
+    # row's index at its start offset, then cummax-propagate. (TPU note:
+    # searchsorted here lowers to ~log2(f_cap) serial gather rounds —
+    # measured 50× slower than this scatter+scan form.)
+    nonempty = deg > 0
+    starts = jnp.where(nonempty, offsets, edge_cap)  # empty rows: dropped
+    row_idx = jnp.arange(frontier.shape[0], dtype=jnp.int32)
+    seg_marks = jnp.zeros((edge_cap,), jnp.int32).at[starts].max(
+        row_idx, mode="drop")
+    seg = lax.cummax(seg_marks)
+    # Edge j's absolute position in `indices`: its row's indptr start plus
+    # the within-row offset — one fused gather of (start - offset) per row.
+    src_rank = jnp.where(valid_mask(frontier), frontier, 0)
+    base = jnp.take(indptr, src_rank, mode="clip") - offsets  # [f_cap]
+    edge_pos = base[seg] + j
     neighbors = jnp.take(indices, edge_pos, mode="clip")
     valid = j < total
     snt = sentinel(indices.dtype)

@@ -1,13 +1,19 @@
 """Fused multi-hop @recurse as ONE compiled single-device program.
 
 Reference parity: `query/recurse.go` (expandRecurse) — the north-star
-workload. The reference's outer Python-equivalent loop (re-seed SubGraph,
-re-run ProcessGraph per depth) becomes a `lax.scan` over hops, so an entire
-depth-k traversal is a single XLA program with zero host round-trips: each
-hop is gather → sort-unique → seen-set difference, all fused.
+workload. The reference's outer loop (re-seed SubGraph, re-run ProcessGraph
+per depth) becomes a `lax.scan` over hops, so an entire depth-k traversal is
+a single XLA program with zero host round-trips: each hop is gather →
+sort-unique → seen-set subtraction, all fused.
+
+TPU design note: the seen set is a dense int8 bitmap over rank space, not a
+sorted list — membership is one vectorised gather instead of the
+log2(n)-round binary search a sorted-set difference costs on TPU (measured
+~50× slower). The sorted-list form (`uidalgebra.difference_sorted`) remains
+for the small host-side paths.
 
 The multi-device version (shard_map + collectives) lives in
-`parallel/dhop.py::recurse_fused`; this is its single-chip core, and the
+`parallel/dhop.py::recurse_fused`; this is its single-chip core and the
 kernel `bench.py` times on real TPU hardware.
 """
 
@@ -20,7 +26,8 @@ import jax.numpy as jnp
 from jax import lax
 
 from dgraph_tpu.ops.hop import gather_edges
-from dgraph_tpu.ops.uidalgebra import difference_sorted, sort_unique_count
+from dgraph_tpu.ops.uidalgebra import (
+    compact_with_count, sort_unique_count, valid_mask)
 
 
 @functools.partial(jax.jit,
@@ -33,32 +40,42 @@ def recurse_frontier(indptr: jax.Array, indices: jax.Array,
     `frontier` must be sorted, sentinel-padded to exactly `out_cap` (it is
     the per-hop frontier buffer carried through the scan). Returns
     `(last_frontier[out_cap], seen[seen_cap], edges_traversed, needs[3])`
-    with `needs = [max frontier slots, max seen slots, max edge slots]` any
-    hop required. Results are valid only if `needs <= [out_cap, seen_cap,
-    edge_cap]` elementwise; otherwise re-run with the caps `needs` asks for
-    (the same overflow contract as ops.hop.expand_frontier).
+    with `needs = [max frontier slots, n visited, max edge slots]` — results
+    are valid only if `needs <= [out_cap, seen_cap, edge_cap]` elementwise;
+    otherwise re-run with the caps `needs` asks for (the same overflow
+    contract as ops.hop.expand_frontier).
     """
     if frontier.shape[0] != out_cap:
         raise ValueError(
             f"frontier buffer {frontier.shape[0]} != out_cap {out_cap}")
+    n_nodes = indptr.shape[0] - 1
+
+    def mark(mask, uids):
+        # sentinel padding >= n_nodes, so mode="drop" discards it
+        return mask.at[uids].set(jnp.int8(1), mode="drop")
 
     def hop(carry, _):
-        fr, seen, edges, need_out, need_seen, need_edge = carry
+        fr, seen_mask, edges, need_out, need_edge = carry
         nbrs, _seg, _pos, _valid, total = gather_edges(
             indptr, indices, fr, edge_cap)
         merged, mcnt = sort_unique_count(nbrs, out_cap)
-        # loop=false semantics: a node expands at most once (first visit).
-        fresh = difference_sorted(merged, seen)
-        seen, scnt = sort_unique_count(
-            jnp.concatenate([seen, fresh]), seen_cap)
-        return (fresh, seen, edges + total,
+        # loop=false: a node expands at most once — bitmap membership test
+        visited = jnp.take(seen_mask, jnp.clip(merged, 0, n_nodes - 1),
+                           mode="clip") > 0
+        keep = valid_mask(merged) & ~visited
+        fresh, _ = compact_with_count(merged, keep, out_cap)
+        seen_mask = mark(seen_mask, fresh)
+        return (fresh, seen_mask, edges + total,
                 jnp.maximum(need_out, mcnt),
-                jnp.maximum(need_seen, scnt),
                 jnp.maximum(need_edge, total)), None
 
-    seen0, scnt0 = sort_unique_count(frontier, seen_cap)
-    (last, seen, edges, need_out, need_seen, need_edge), _ = lax.scan(
-        hop,
-        (frontier, seen0, jnp.int32(0), jnp.int32(0), scnt0, jnp.int32(0)),
+    seen0 = mark(jnp.zeros((n_nodes,), jnp.int8), frontier)
+    (last, seen_mask, edges, need_out, need_edge), _ = lax.scan(
+        hop, (frontier, seen0, jnp.int32(0), jnp.int32(0), jnp.int32(0)),
         None, length=depth)
-    return last, seen, edges, jnp.stack([need_out, need_seen, need_edge])
+
+    # materialise the visited set as a sorted padded uid list — iota is
+    # already ascending, so compaction alone suffices (no sort)
+    iota = jnp.arange(n_nodes, dtype=frontier.dtype)
+    seen, n_seen = compact_with_count(iota, seen_mask > 0, seen_cap)
+    return last, seen, edges, jnp.stack([need_out, n_seen, need_edge])

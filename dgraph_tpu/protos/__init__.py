@@ -1,0 +1,4 @@
+"""Generated protobuf messages (see task.proto). Regenerate with:
+protoc --python_out=. dgraph_tpu/protos/task.proto
+"""
+from dgraph_tpu.protos import task_pb2

@@ -1,0 +1,197 @@
+"""Alpha: the public API server facade (in-process form).
+
+Reference parity: `edgraph/server.go` — `Server.Query`, `Server.Mutate`,
+`Server.Alter`, `Server.CommitOrAbort` implementing the `api.Dgraph`
+service — plus the worker-side mutation application those call into
+(`worker/mutation.go` MutateOverNetwork → posting layer). Network
+transports (HTTP/gRPC) wrap this object in `server/http.py` /
+`server/task.py`; the query path itself runs the TPU engine.
+
+Transactions follow the reference's client model: `txn = alpha.new_txn()`,
+any number of `txn.query` / `txn.mutate` calls, then `txn.commit()` (Zero
+arbitration; raises `TxnAborted` on conflict) or `txn.discard()`.
+`commit_now=True` mutations are single-shot transactions.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from dgraph_tpu.cluster.oracle import Oracle, TxnAborted
+from dgraph_tpu.engine import Engine
+from dgraph_tpu.loader.chunker import NQuad, parse_json, parse_rdf
+from dgraph_tpu.loader.xidmap import XidMap
+from dgraph_tpu.store.mvcc import MVCCStore, Mutation
+from dgraph_tpu.store.schema import parse_schema
+from dgraph_tpu.store.store import Store
+from dgraph_tpu.store.types import Kind
+
+__all__ = ["Alpha", "Txn", "TxnAborted"]
+
+
+class Alpha:
+    """Single-process data server: oracle + MVCC store + query engine."""
+
+    def __init__(self, base: Store | None = None,
+                 device_threshold: int = 512):
+        self.oracle = Oracle()
+        self.mvcc = MVCCStore(base=base)
+        self.xidmap = XidMap(self.oracle)
+        self.device_threshold = device_threshold
+        self._apply_lock = threading.Lock()
+        if base is not None and base.n_nodes:
+            self.oracle.bump_uid(int(base.uids[-1]))
+
+    # -- public api surface (api.Dgraph analog) -----------------------------
+    def new_txn(self) -> "Txn":
+        return Txn(self)
+
+    def query(self, dql: str, variables: dict | None = None,
+              read_ts: int | None = None) -> dict:
+        """Read-only query at a snapshot (reference: Server.Query with
+        best-effort/read-only txn)."""
+        ts = self.oracle.read_ts() if read_ts is None else read_ts
+        store = self.mvcc.read_view(ts)
+        return Engine(store, device_threshold=self.device_threshold).query(
+            dql, variables)
+
+    def mutate(self, *, set_nquads: str | None = None,
+               del_nquads: str | None = None,
+               set_json=None, del_json=None,
+               commit_now: bool = True) -> dict:
+        """One-shot mutation transaction (reference: Server.Mutate with
+        CommitNow)."""
+        txn = self.new_txn()
+        try:
+            uids = txn.mutate(set_nquads=set_nquads, del_nquads=del_nquads,
+                              set_json=set_json, del_json=del_json)
+            if commit_now:
+                txn.commit()
+            return {"uids": uids,
+                    "txn": {"start_ts": txn.start_ts,
+                            "commit_ts": txn.commit_ts}}
+        except Exception:
+            txn.discard()
+            raise
+
+    def alter(self, schema_text: str) -> None:
+        """Schema mutation + index rebuild (reference: Server.Alter →
+        schema.Update + posting.RebuildIndex)."""
+        new = parse_schema(schema_text)
+        with self._apply_lock:
+            self.mvcc.schema.update(new)
+            # rebuild the base snapshot under the new schema: recreates
+            # reverse CSR blocks and inverted indexes
+            self.mvcc.rollup()
+            self.mvcc._views.clear()
+
+    def drop_all(self) -> None:
+        """reference: api.Operation{DropAll}."""
+        with self._apply_lock:
+            self.mvcc.__init__()
+
+    # -- commit path (worker/draft.go applyMutations analog) ----------------
+    def _commit(self, txn: "Txn") -> int:
+        with self._apply_lock:
+            commit_ts = self.oracle.commit(
+                txn.start_ts, txn.mutation.conflict_keys())
+            self.mvcc.apply(txn.mutation, commit_ts)
+            return commit_ts
+
+
+@dataclass
+class Txn:
+    """Client-side transaction bookkeeping (reference: dgo txn / edgraph
+    txn context): buffered mutations, blank-node uid map, commit state."""
+
+    alpha: Alpha
+    start_ts: int = 0
+    commit_ts: int = 0
+    mutation: Mutation = field(default_factory=Mutation)
+    _blank: dict[str, int] = field(default_factory=dict)
+    _done: bool = False
+
+    def __post_init__(self):
+        self.start_ts = self.alpha.oracle.read_ts()
+
+    # -- reads --------------------------------------------------------------
+    def query(self, dql: str, variables: dict | None = None) -> dict:
+        if self._done:
+            raise TxnAborted("txn finished")
+        return self.alpha.query(dql, variables, read_ts=self.start_ts)
+
+    # -- writes -------------------------------------------------------------
+    def mutate(self, *, set_nquads: str | None = None,
+               del_nquads: str | None = None,
+               set_json=None, del_json=None) -> dict:
+        """Buffer mutations; returns blank-node → uid assignments."""
+        if self._done:
+            raise TxnAborted("txn finished")
+        sets: list[NQuad] = []
+        dels: list[NQuad] = []
+        if set_nquads:
+            sets += parse_rdf(set_nquads)
+        if set_json is not None:
+            sets += parse_json(set_json)
+        if del_nquads:
+            dels += parse_rdf(del_nquads)
+        if del_json is not None:
+            dels += parse_json(del_json)
+        for nq in sets:
+            self._apply_nquad(nq, delete=False)
+        for nq in dels:
+            self._apply_nquad(nq, delete=True)
+        return {b: f"0x{u:x}" for b, u in self._blank.items()}
+
+    def _resolve(self, ref: str) -> int:
+        if ref.startswith("_:"):
+            uid = self._blank.get(ref)
+            if uid is None:
+                uid = self.alpha.xidmap.resolve(ref + f"@{self.start_ts}")
+                self._blank[ref] = uid
+            return uid
+        return self.alpha.xidmap.resolve(ref)
+
+    def _apply_nquad(self, nq: NQuad, delete: bool) -> None:
+        s = self._resolve(nq.subject)
+        m = self.mutation
+        schema = self.alpha.mvcc.schema
+        if nq.is_star:
+            if not delete:
+                raise ValueError('object "*" only valid in delete')
+            ps = schema.peek(nq.predicate)
+            if ps is not None and ps.kind == Kind.UID:
+                m.edge_dels.append((s, nq.predicate, None))
+            else:
+                m.val_dels.append((s, nq.predicate, None, ""))
+                if ps is not None and ps.lang:
+                    # star delete covers every language column
+                    m.val_dels.append((s, nq.predicate, None, "*"))
+        elif nq.object_id is not None:
+            o = self._resolve(nq.object_id)
+            (m.edge_dels if delete else m.edge_sets).append(
+                (s, nq.predicate, o))
+        else:
+            if delete:
+                m.val_dels.append((s, nq.predicate, None, nq.lang))
+            else:
+                m.val_sets.append((s, nq.predicate, nq.object_value, nq.lang))
+
+    # -- outcome ------------------------------------------------------------
+    def commit(self) -> int:
+        if self._done:
+            raise TxnAborted("txn finished")
+        self._done = True
+        if self.mutation.is_empty():
+            self.alpha.oracle.abort(self.start_ts)
+            return 0
+        self.commit_ts = self.alpha._commit(self)
+        return self.commit_ts
+
+    def discard(self) -> None:
+        if not self._done:
+            self._done = True
+            self.alpha.oracle.abort(self.start_ts)

@@ -1,0 +1,126 @@
+"""HTTP API: the Alpha's REST surface.
+
+Reference parity: `dgraph/cmd/alpha/run.go` HTTP handlers — POST /query,
+/mutate, /alter, /commit; GET /health, /state (cluster topology JSON) and
+/debug/prometheus_metrics (metrics endpoint, utils/metrics.py). stdlib
+ThreadingHTTPServer: one Alpha process serves both transports, as the
+reference serves 8080 (HTTP) beside 9080 (gRPC).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from dgraph_tpu.server.api import Alpha, TxnAborted
+from dgraph_tpu.utils.metrics import METRICS
+
+
+def make_http_server(alpha: Alpha, addr: str = "127.0.0.1",
+                     port: int = 0) -> ThreadingHTTPServer:
+    start_time = time.time()
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):  # quiet (x.Logger role is utils.logging)
+            pass
+
+        def _send(self, code: int, body: dict | str,
+                  ctype: str = "application/json"):
+            data = (json.dumps(body) if not isinstance(body, str)
+                    else body).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def _body(self) -> bytes:
+            n = int(self.headers.get("Content-Length") or 0)
+            return self.rfile.read(n)
+
+        def do_GET(self):
+            if self.path == "/health":
+                self._send(200, [{"status": "healthy",
+                                  "uptime": int(time.time() - start_time)}])
+            elif self.path == "/state":
+                st = {"counter": alpha.oracle.max_assigned,
+                      "groups": {"1": {"members": {"1": {
+                          "id": "1", "addr": f"{addr}:{port}",
+                          "leader": True}},
+                          "tablets": {p: {"predicate": p}
+                                      for p in alpha.mvcc.schema.predicates}}},
+                      "maxUID": alpha.oracle._next_uid - 1,
+                      "maxTxnTs": alpha.oracle.max_assigned}
+                self._send(200, st)
+            elif self.path == "/debug/prometheus_metrics":
+                self._send(200, METRICS.render(), "text/plain")
+            else:
+                self._send(404, {"errors": [{"message": "not found"}]})
+
+        def do_POST(self):
+            t0 = time.perf_counter()
+            try:
+                if self.path.startswith("/query"):
+                    body = self._body().decode()
+                    if "application/json" in (
+                            self.headers.get("Content-Type") or ""):
+                        req = json.loads(body)
+                        q, variables = req["query"], req.get("variables")
+                    else:
+                        q, variables = body, None
+                    out = alpha.query(q, variables)
+                    METRICS.observe("query_latency_us",
+                                    (time.perf_counter() - t0) * 1e6)
+                    self._send(200, {
+                        "data": out,
+                        "extensions": {"server_latency": {
+                            "total_us":
+                                int((time.perf_counter() - t0) * 1e6)}}})
+                elif self.path.startswith("/mutate"):
+                    commit_now = "commitNow=true" in self.path or \
+                        (self.headers.get("X-Dgraph-CommitNow") == "true")
+                    ctype = self.headers.get("Content-Type") or ""
+                    body = self._body().decode()
+                    if "application/json" in ctype:
+                        req = json.loads(body)
+                        res = alpha.mutate(
+                            set_json=req.get("set"),
+                            del_json=req.get("delete"),
+                            commit_now=commit_now or req.get("commitNow",
+                                                             True))
+                    else:
+                        res = alpha.mutate(set_nquads=body,
+                                           commit_now=True)
+                    self._send(200, {"data": res})
+                elif self.path.startswith("/alter"):
+                    body = self._body().decode()
+                    if body.strip().startswith("{"):
+                        op = json.loads(body)
+                        if op.get("drop_all"):
+                            alpha.drop_all()
+                        else:
+                            alpha.alter(op.get("schema", ""))
+                    else:
+                        alpha.alter(body)
+                    self._send(200, {"data": {"code": "Success"}})
+                else:
+                    self._send(404, {"errors": [{"message": "not found"}]})
+            except TxnAborted as e:
+                self._send(409, {"errors": [{"message": str(e),
+                                             "code": "Aborted"}]})
+            except Exception as e:  # surface parse/exec errors as the
+                # reference does: 200-with-errors JSON is api-breaking,
+                # use 400 + errors list
+                self._send(400, {"errors": [{"message": str(e)}]})
+
+    srv = ThreadingHTTPServer((addr, port), Handler)
+    port = srv.server_address[1]
+    return srv
+
+
+def serve_background(srv: ThreadingHTTPServer) -> threading.Thread:
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    return t

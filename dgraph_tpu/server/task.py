@@ -1,0 +1,186 @@
+"""gRPC services: the public Dgraph API and the Worker task seam.
+
+Reference parity: `worker/server.go` (grpc `pb.Worker` service —
+`ServeTask` is the boundary the north star names: an Alpha offloads
+per-hop expansion to this service) and `edgraph/server.go` exposed as the
+public `api.Dgraph` service (Query/Mutate/Alter/CommitOrAbort).
+
+grpc-python service stubs normally come from grpcio-tools, which this
+image lacks; services are registered through grpc's generic-handler API
+against the protoc-generated messages instead — same wire behavior,
+no codegen dependency.
+"""
+
+from __future__ import annotations
+
+import time
+from concurrent import futures
+
+import grpc
+import numpy as np
+
+from dgraph_tpu.engine.execute import Executor
+from dgraph_tpu.protos import task_pb2 as pb
+from dgraph_tpu.server.api import Alpha, TxnAborted
+
+SERVICE_DGRAPH = "dgraph_tpu.Dgraph"
+SERVICE_WORKER = "dgraph_tpu.Worker"
+
+
+class DgraphService:
+    """Public API service (api.Dgraph analog)."""
+
+    def __init__(self, alpha: Alpha):
+        self.alpha = alpha
+
+    def Query(self, req: pb.Request, ctx) -> pb.Response:
+        import json
+        t0 = time.perf_counter()
+        start_ts = req.start_ts or None
+        out = self.alpha.query(req.query, dict(req.vars) or None,
+                               read_ts=start_ts)
+        return pb.Response(
+            json=json.dumps(out).encode(),
+            txn=pb.TxnContext(start_ts=start_ts or 0),
+            latency_us=int((time.perf_counter() - t0) * 1e6))
+
+    def Mutate(self, req: pb.MutationReq, ctx) -> pb.MutationResp:
+        try:
+            res = self.alpha.mutate(
+                set_nquads=req.set_nquads or None,
+                del_nquads=req.del_nquads or None,
+                set_json=req.set_json or None,
+                del_json=req.del_json or None,
+                commit_now=req.commit_now)
+        except TxnAborted as e:
+            ctx.abort(grpc.StatusCode.ABORTED, str(e))
+        return pb.MutationResp(
+            uids=res["uids"],
+            txn=pb.TxnContext(start_ts=res["txn"]["start_ts"],
+                              commit_ts=res["txn"]["commit_ts"]))
+
+    def Alter(self, req: pb.Operation, ctx) -> pb.Payload:
+        if req.drop_all:
+            self.alpha.drop_all()
+        elif req.schema:
+            self.alpha.alter(req.schema)
+        return pb.Payload(data=b"ok")
+
+    def AssignUids(self, req: pb.AssignRequest, ctx) -> pb.AssignedIds:
+        r = self.alpha.oracle.assign_uids(int(req.num))
+        return pb.AssignedIds(start_id=r.start, end_id=r.stop - 1)
+
+
+class WorkerService:
+    """The task seam: one-hop expansion requests (worker.ServeTask)."""
+
+    def __init__(self, alpha: Alpha):
+        self.alpha = alpha
+
+    def ServeTask(self, req: pb.TaskQuery, ctx) -> pb.TaskResult:
+        ts = req.read_ts or self.alpha.oracle.read_ts()
+        store = self.alpha.mvcc.read_view(ts)
+        ex = Executor(store,
+                      device_threshold=self.alpha.device_threshold)
+        if req.func_name:
+            from dgraph_tpu.engine.ir import FuncNode
+            from dgraph_tpu.engine.funcs import eval_func
+            ranks = eval_func(store, FuncNode(
+                name=req.func_name, attr=req.attr,
+                args=list(req.func_args), lang=req.lang))
+            flat_uids = store.uid_of(ranks).astype(np.uint64)
+            return pb.TaskResult(
+                flat=pb.UidList(uids=flat_uids.tolist()))
+        frontier_uids = np.array(list(req.frontier.uids), np.int64)
+        ranks = store.rank_of(frontier_uids)
+        known = ranks >= 0
+        nbrs, seg = ex.expand(req.attr, req.reverse,
+                              ranks[known].astype(np.int32))
+        rows = []
+        kept_pos = np.nonzero(known)[0]
+        for i in range(len(frontier_uids)):
+            rows.append(pb.UidList())
+        if len(nbrs):
+            order = np.argsort(seg, kind="stable")
+            nbrs, seg = nbrs[order], seg[order]
+            bounds = np.searchsorted(seg, np.arange(len(kept_pos) + 1))
+            for local, pos in enumerate(kept_pos):
+                lo, hi = bounds[local], bounds[local + 1]
+                row = nbrs[lo:hi]
+                if req.offset:
+                    row = row[req.offset:]
+                if req.first:
+                    row = row[:req.first]
+                rows[pos] = pb.UidList(
+                    uids=store.uid_of(row).astype(np.uint64).tolist())
+        flat = (np.unique(nbrs) if len(nbrs)
+                else np.zeros(0, np.int32))
+        return pb.TaskResult(
+            matrix=pb.UidMatrix(rows=rows),
+            flat=pb.UidList(
+                uids=store.uid_of(flat).astype(np.uint64).tolist()),
+            edges_traversed=int(len(nbrs)))
+
+
+def _unary(fn, req_cls):
+    return grpc.unary_unary_rpc_method_handler(
+        fn, request_deserializer=req_cls.FromString,
+        response_serializer=lambda m: m.SerializeToString())
+
+
+def make_server(alpha: Alpha, addr: str = "127.0.0.1:0",
+                max_workers: int = 8):
+    """Build (grpc server, bound port). Reference: worker/server.go
+    grpc setup in alpha run()."""
+    d, w = DgraphService(alpha), WorkerService(alpha)
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+    server.add_generic_rpc_handlers((
+        grpc.method_handlers_generic_handler(SERVICE_DGRAPH, {
+            "Query": _unary(d.Query, pb.Request),
+            "Mutate": _unary(d.Mutate, pb.MutationReq),
+            "Alter": _unary(d.Alter, pb.Operation),
+            "AssignUids": _unary(d.AssignUids, pb.AssignRequest),
+        }),
+        grpc.method_handlers_generic_handler(SERVICE_WORKER, {
+            "ServeTask": _unary(w.ServeTask, pb.TaskQuery),
+        }),
+    ))
+    port = server.add_insecure_port(addr)
+    return server, port
+
+
+class Client:
+    """Minimal client over the same generic method paths (dgo analog)."""
+
+    def __init__(self, target: str):
+        self.channel = grpc.insecure_channel(target)
+
+    def _call(self, service: str, method: str, req, resp_cls):
+        rpc = self.channel.unary_unary(
+            f"/{service}/{method}",
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=resp_cls.FromString)
+        return rpc(req)
+
+    def query(self, dql: str, start_ts: int = 0) -> dict:
+        import json
+        resp = self._call(SERVICE_DGRAPH, "Query",
+                          pb.Request(query=dql, start_ts=start_ts),
+                          pb.Response)
+        return json.loads(resp.json)
+
+    def mutate(self, **kw) -> pb.MutationResp:
+        return self._call(SERVICE_DGRAPH, "Mutate",
+                          pb.MutationReq(**kw), pb.MutationResp)
+
+    def alter(self, schema: str = "", drop_all: bool = False) -> None:
+        self._call(SERVICE_DGRAPH, "Alter",
+                   pb.Operation(schema=schema, drop_all=drop_all),
+                   pb.Payload)
+
+    def serve_task(self, **kw) -> pb.TaskResult:
+        return self._call(SERVICE_WORKER, "ServeTask",
+                          pb.TaskQuery(**kw), pb.TaskResult)
+
+    def close(self):
+        self.channel.close()

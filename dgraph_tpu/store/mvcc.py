@@ -1,0 +1,182 @@
+"""MVCC layering over immutable Store snapshots.
+
+Reference parity: `posting/mvcc.go` + `posting/list.go` — each posting list
+is an immutable Badger layer plus an in-memory mutable delta layer keyed by
+commit timestamp; readers at `read_ts` see base ∪ {deltas with commit_ts ≤
+read_ts}; `Rollup` folds deltas into a new immutable layer.
+
+TPU-first shape: the immutable layer here is the whole CSR `Store` snapshot
+(what lives in HBM); deltas are small host-side edge/value logs per commit.
+A read view materialises base+visible-deltas into a fresh Store (cached per
+visible-set), and `rollup()` promotes the current view to the new base —
+the moral analog of posting-list rollups plus Badger compaction, with HBM
+as a cache over host state (SURVEY §5 checkpoint model: device memory is
+never the source of truth).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+
+from dgraph_tpu.store.schema import Schema
+from dgraph_tpu.store.store import TYPE_PRED, Store, StoreBuilder
+from dgraph_tpu.store.types import Kind
+
+
+@dataclass
+class Mutation:
+    """One txn's buffered edits (reference: pb.Mutations / DirectedEdge).
+
+    `*_DEL` entries use object/value None to mean "delete all postings of
+    (subject, predicate)" (reference: S P * deletion).
+    """
+
+    edge_sets: list = field(default_factory=list)   # (s, pred, o)
+    edge_dels: list = field(default_factory=list)   # (s, pred, o|None)
+    val_sets: list = field(default_factory=list)    # (s, pred, value, lang)
+    val_dels: list = field(default_factory=list)    # (s, pred, None, lang)
+
+    def conflict_keys(self):
+        """Keys Zero arbitrates on: (pred, subject) per touched list
+        (reference: posting key fingerprints sent in pb.TxnContext)."""
+        keys = set()
+        for s, p, _ in self.edge_sets + self.edge_dels:
+            keys.add((p, s))
+        for s, p, *_ in self.val_sets + self.val_dels:
+            keys.add((p, s))
+        return keys
+
+    def is_empty(self) -> bool:
+        return not (self.edge_sets or self.edge_dels
+                    or self.val_sets or self.val_dels)
+
+
+@dataclass
+class _Layer:
+    commit_ts: int
+    mut: Mutation
+
+
+class MVCCStore:
+    """Versioned posting store: base snapshot + committed delta layers."""
+
+    def __init__(self, base: Store | None = None, base_ts: int = 0):
+        self._lock = threading.Lock()
+        self.base = base if base is not None else StoreBuilder().finalize()
+        self.base_ts = base_ts
+        self.layers: list[_Layer] = []       # sorted by commit_ts
+        self._views: dict[tuple, Store] = {}
+
+    @property
+    def schema(self) -> Schema:
+        return self.base.schema
+
+    # -- write path ---------------------------------------------------------
+    def apply(self, mut: Mutation, commit_ts: int) -> None:
+        """Install a committed delta layer (reference: oracle watermark
+        moving a txn's mutable layer to committed at commit_ts)."""
+        with self._lock:
+            if self.layers and commit_ts <= self.layers[-1].commit_ts:
+                raise ValueError("commit_ts must be monotonic")
+            if commit_ts <= self.base_ts:
+                raise ValueError("commit_ts below base snapshot")
+            self.layers.append(_Layer(commit_ts, mut))
+
+    # -- read path ----------------------------------------------------------
+    def read_view(self, read_ts: int) -> Store:
+        """Store snapshot visible at `read_ts` (base ∪ deltas ≤ read_ts)."""
+        with self._lock:
+            visible = tuple(l.commit_ts for l in self.layers
+                            if l.commit_ts <= read_ts)
+            if not visible:
+                return self.base
+            view = self._views.get(visible)
+            if view is None:
+                view = self._materialize(
+                    [l for l in self.layers if l.commit_ts <= read_ts])
+                self._views[visible] = view
+            return view
+
+    def rollup(self, upto_ts: int | None = None) -> Store:
+        """Fold layers ≤ upto_ts into a new base (reference: List.Rollup +
+        snapshot compaction). Returns the new base snapshot."""
+        with self._lock:
+            if upto_ts is None:
+                upto_ts = self.layers[-1].commit_ts if self.layers else self.base_ts
+            folded = [l for l in self.layers if l.commit_ts <= upto_ts]
+            if folded:
+                self.base = self._materialize(folded)
+                self.base_ts = folded[-1].commit_ts
+                self.layers = [l for l in self.layers
+                               if l.commit_ts > upto_ts]
+                self._views.clear()
+            return self.base
+
+    # -- merge --------------------------------------------------------------
+    def _materialize(self, layers: list[_Layer]) -> Store:
+        """Rebuild a Store from base + deltas (host-side; the new CSR blocks
+        re-enter HBM via Store.device_rel on first use)."""
+        base = self.base
+        b = StoreBuilder(schema=base.schema.clone())
+
+        # live edges/values from base, as dicts for delete application
+        import numpy as np
+        edges: dict[str, set] = {}
+        for pred, pd in base.preds.items():
+            if pd.fwd is not None and pd.fwd.nnz:
+                deg = pd.fwd.indptr[1:] - pd.fwd.indptr[:-1]
+                src_r = np.repeat(np.arange(base.n_nodes), deg)
+                s_uid = base.uids[src_r]
+                o_uid = base.uids[pd.fwd.indices]
+                edges[pred] = set(zip(s_uid.tolist(), o_uid.tolist()))
+        vals: dict[tuple, dict] = {}
+        for pred, pd in base.preds.items():
+            for lang, col in pd.vals.items():
+                d = vals.setdefault((pred, lang), {})
+                for s, v in zip(col.subj, col.vals):
+                    d.setdefault(int(base.uids[s]), []).append(v)
+
+        for layer in layers:
+            m = layer.mut
+            for s, p, o in m.edge_dels:
+                if o is None:
+                    edges[p] = {e for e in edges.get(p, set())
+                                if e[0] != s}
+                else:
+                    edges.get(p, set()).discard((s, o))
+            for s, p, o in m.edge_sets:
+                edges.setdefault(p, set()).add((s, o))
+            for s, p, _v, lang in m.val_dels:
+                if lang == "*":  # delete across every language column
+                    for (vp, _vl), d in vals.items():
+                        if vp == p:
+                            d.pop(s, None)
+                else:
+                    vals.get((p, lang), {}).pop(s, None)
+            for s, p, v, lang in m.val_sets:
+                ps = base.schema.peek(p)
+                if ps is not None and ps.is_list:
+                    vals.setdefault((p, lang), {}).setdefault(s, []).append(v)
+                else:
+                    vals.setdefault((p, lang), {})[s] = [v]
+
+        for pred, es in edges.items():
+            for s, o in sorted(es):
+                b.add_edge(s, pred, o)
+        for (pred, lang), d in vals.items():
+            for s, vlist in sorted(d.items()):
+                for v in vlist:
+                    if pred == TYPE_PRED:
+                        b.add_type(s, str(v))
+                    else:
+                        b.add_value(s, pred, _to_py(v), lang)
+        return b.finalize()
+
+
+def _to_py(v):
+    """numpy scalar → python for StoreBuilder.add_value re-ingestion."""
+    import numpy as np
+    if isinstance(v, np.generic) and not isinstance(v, np.datetime64):
+        return v.item()
+    return v

@@ -67,6 +67,12 @@ class Schema:
     def peek(self, pred: str) -> PredicateSchema | None:
         return self.predicates.get(pred)
 
+    def clone(self) -> "Schema":
+        """Deep copy, so a new Store snapshot's schema can evolve without
+        mutating the one frozen into the previous snapshot."""
+        import copy
+        return copy.deepcopy(self)
+
     def update(self, other: "Schema") -> None:
         """Merge an Alter's schema into the live state (reference:
         Schema.Update — later declarations replace earlier per predicate)."""

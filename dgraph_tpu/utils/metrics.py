@@ -1,0 +1,78 @@
+"""Prometheus-text metrics registry.
+
+Reference parity: `x/metrics.go` + the `/debug/prometheus_metrics`
+endpoint — query latency histograms, pending txns, and (our north-star
+first-class counter, per BASELINE.json) edges traversed. No client
+library dependency: counters/gauges/histograms rendered in Prometheus
+text exposition format directly.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+
+_BUCKETS = (100, 1_000, 10_000, 100_000, 1_000_000, 10_000_000)
+
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: dict[str, float] = defaultdict(float)
+        self._gauges: dict[str, float] = {}
+        self._hists: dict[str, list] = {}
+
+    def inc(self, name: str, value: float = 1.0) -> None:
+        with self._lock:
+            self._counters[name] += value
+
+    def set_gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            self._gauges[name] = value
+
+    def observe(self, name: str, value: float) -> None:
+        """Histogram observation (µs-scale buckets)."""
+        with self._lock:
+            h = self._hists.get(name)
+            if h is None:
+                h = self._hists[name] = [[0] * (len(_BUCKETS) + 1), 0.0, 0]
+            counts, _sum, _n = h
+            for i, b in enumerate(_BUCKETS):
+                if value <= b:
+                    counts[i] += 1
+                    break
+            else:
+                counts[-1] += 1
+            h[1] += value
+            h[2] += 1
+
+    def render(self) -> str:
+        """Prometheus text exposition format."""
+        out = []
+        with self._lock:
+            for k, v in sorted(self._counters.items()):
+                out.append(f"# TYPE dgraph_tpu_{k} counter")
+                out.append(f"dgraph_tpu_{k} {v}")
+            for k, v in sorted(self._gauges.items()):
+                out.append(f"# TYPE dgraph_tpu_{k} gauge")
+                out.append(f"dgraph_tpu_{k} {v}")
+            for k, (counts, s, n) in sorted(self._hists.items()):
+                out.append(f"# TYPE dgraph_tpu_{k} histogram")
+                acc = 0
+                for b, c in zip(_BUCKETS, counts):
+                    acc += c
+                    out.append(
+                        f'dgraph_tpu_{k}_bucket{{le="{b}"}} {acc}')
+                out.append(
+                    f'dgraph_tpu_{k}_bucket{{le="+Inf"}} {n}')
+                out.append(f"dgraph_tpu_{k}_sum {s}")
+                out.append(f"dgraph_tpu_{k}_count {n}")
+        return "\n".join(out) + "\n"
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"counters": dict(self._counters),
+                    "gauges": dict(self._gauges)}
+
+
+METRICS = Registry()

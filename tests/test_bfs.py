@@ -1,0 +1,73 @@
+"""Batched bitmap BFS vs per-query numpy oracle.
+
+Reference parity model: the behavior under test is expandRecurse's
+loop=false frontier evolution (query/recurse.go), applied to B independent
+queries at once (SURVEY §4: property-style random-graph checks as in
+algo/uidlist_test.go).
+"""
+
+import numpy as np
+import pytest
+
+from dgraph_tpu.models.synthetic import powerlaw_rel, uniform_rel
+from dgraph_tpu.ops.bfs import (
+    bitmap_hop, bitmap_recurse, bitmap_to_ranks, ranks_to_bitmap)
+
+
+def coo_of(rel):
+    n = rel.indptr.shape[0] - 1
+    deg = (rel.indptr[1:] - rel.indptr[:-1]).astype(np.int64)
+    src = np.repeat(np.arange(n, dtype=np.int32), deg)
+    return src, rel.indices.astype(np.int32), (rel.indptr[1:] - rel.indptr[:-1]).astype(np.int32)
+
+
+def oracle_recurse(rel, seeds, depth):
+    frontier = np.unique(seeds)
+    seen = frontier.copy()
+    edges = 0
+    for _ in range(depth):
+        if not len(frontier):
+            break
+        parts = [rel.row(int(r)) for r in frontier]
+        edges += sum(len(p) for p in parts)
+        nxt = np.unique(np.concatenate(parts)) if parts else np.zeros(0, np.int64)
+        frontier = np.setdiff1d(nxt, seen)
+        seen = np.union1d(seen, frontier)
+    return frontier, seen, edges
+
+
+@pytest.mark.parametrize("maker,n,deg", [(powerlaw_rel, 300, 3.0),
+                                         (uniform_rel, 200, 4)])
+def test_bitmap_recurse_matches_oracle(maker, n, deg):
+    rel = maker(n, deg, 3)
+    src, dst, degv = coo_of(rel)
+    rng = np.random.default_rng(0)
+    B = 8
+    seed_lists = [rng.integers(0, n, rng.integers(1, 6)) for _ in range(B)]
+    mask0 = ranks_to_bitmap(seed_lists, n)
+
+    last, seen, edges = bitmap_recurse(src, dst, degv, mask0, depth=3)
+    last_l, seen_l = bitmap_to_ranks(last), bitmap_to_ranks(seen)
+    for q in range(B):
+        of, os_, oe = oracle_recurse(rel, seed_lists[q], 3)
+        assert np.array_equal(last_l[q], of), f"query {q} frontier"
+        assert np.array_equal(seen_l[q], os_), f"query {q} seen"
+        assert int(edges[q]) == oe, f"query {q} edges"
+
+
+def test_bitmap_hop_single():
+    rel = uniform_rel(64, 2, 1)
+    src, dst, _ = coo_of(rel)
+    mask0 = ranks_to_bitmap([[0, 5]], 64)
+    nxt = np.asarray(bitmap_hop(src, dst, mask0))
+    want = np.unique(np.concatenate([rel.row(0), rel.row(5)]))
+    assert np.array_equal(np.nonzero(nxt[:, 0])[0], want)
+
+
+def test_empty_seed_lane():
+    rel = uniform_rel(32, 2, 5)
+    src, dst, degv = coo_of(rel)
+    mask0 = ranks_to_bitmap([[], [3]], 32)
+    last, seen, edges = bitmap_recurse(src, dst, degv, mask0, depth=2)
+    assert int(edges[0]) == 0
+    assert not np.asarray(seen)[:, 0].any()

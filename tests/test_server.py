@@ -1,0 +1,118 @@
+"""Transport-layer integration: gRPC services + HTTP endpoints.
+
+Reference parity model: systest/-style tests against a real running server
+on one machine (SURVEY §4 — "no mocked fake backend"); here a real grpc
+server + ThreadingHTTPServer in-process.
+"""
+
+import json
+import urllib.request
+
+import pytest
+
+from dgraph_tpu.server.api import Alpha
+from dgraph_tpu.server.http import make_http_server, serve_background
+from dgraph_tpu.server.task import Client, make_server
+
+
+@pytest.fixture()
+def alpha():
+    a = Alpha(device_threshold=10**9)
+    a.alter("name: string @index(exact) .\nfriend: [uid] @reverse .")
+    a.mutate(set_nquads="""
+        _:a <name> "alice" .
+        _:b <name> "bob" .
+        _:c <name> "carol" .
+        _:a <friend> _:b .
+        _:a <friend> _:c .
+        _:b <friend> _:c .
+    """)
+    return a
+
+
+def test_grpc_query_mutate_alter(alpha):
+    server, port = make_server(alpha)
+    server.start()
+    try:
+        c = Client(f"127.0.0.1:{port}")
+        out = c.query('{ q(func: eq(name, "alice")) { name friend { name } } }')
+        assert out["q"][0]["name"] == "alice"
+        assert len(out["q"][0]["friend"]) == 2
+
+        resp = c.mutate(set_nquads='_:d <name> "dan" .', commit_now=True)
+        assert resp.txn.commit_ts > 0
+        out = c.query('{ q(func: eq(name, "dan")) { name } }')
+        assert out == {"q": [{"name": "dan"}]}
+        c.close()
+    finally:
+        server.stop(0)
+
+
+def test_grpc_serve_task_seam(alpha):
+    """The worker.Task boundary: frontier in → UidMatrix out."""
+    server, port = make_server(alpha)
+    server.start()
+    try:
+        c = Client(f"127.0.0.1:{port}")
+        root = c.serve_task(func_name="eq", attr="name",
+                            func_args=["alice", "bob"])
+        uids = list(root.flat.uids)
+        assert len(uids) == 2
+        res = c.serve_task(attr="friend",
+                           frontier={"uids": uids})
+        assert res.edges_traversed == 3
+        assert len(res.matrix.rows) == 2
+        # flat union is deduped: alice→{bob,carol}, bob→{carol}
+        assert len(res.flat.uids) == 2
+        c.close()
+    finally:
+        server.stop(0)
+
+
+def test_http_endpoints(alpha):
+    srv = make_http_server(alpha)
+    serve_background(srv)
+    port = srv.server_address[1]
+    base = f"http://127.0.0.1:{port}"
+
+    def post(path, body, ctype="application/dql"):
+        req = urllib.request.Request(
+            base + path, data=body.encode(),
+            headers={"Content-Type": ctype})
+        with urllib.request.urlopen(req) as r:
+            return json.loads(r.read())
+
+    out = post("/query", '{ q(func: eq(name, "alice")) { name } }')
+    assert out["data"] == {"q": [{"name": "alice"}]}
+    assert "server_latency" in out["extensions"]
+
+    out = post("/mutate?commitNow=true", '_:x <name> "erin" .',
+               "application/rdf")
+    assert out["data"]["txn"]["commit_ts"] > 0
+
+    out = post("/query", json.dumps(
+        {"query": "{ q(func: eq(name, $n)) { name } }",
+         "variables": {"$n": "erin"}}), "application/json")
+    assert out["data"] == {"q": [{"name": "erin"}]}
+
+    with urllib.request.urlopen(base + "/health") as r:
+        assert json.loads(r.read())[0]["status"] == "healthy"
+    with urllib.request.urlopen(base + "/state") as r:
+        st = json.loads(r.read())
+        assert "friend" in st["groups"]["1"]["tablets"]
+    with urllib.request.urlopen(base + "/debug/prometheus_metrics") as r:
+        assert b"query_latency" in r.read()
+    srv.shutdown()
+
+
+def test_http_error_paths(alpha):
+    srv = make_http_server(alpha)
+    serve_background(srv)
+    port = srv.server_address[1]
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/query", data=b"{ bad query",
+        headers={"Content-Type": "application/dql"})
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(req)
+    assert ei.value.code == 400
+    srv.shutdown()

@@ -1,0 +1,180 @@
+"""Transaction/MVCC semantics: snapshot isolation, conflicts, rollup.
+
+Reference parity: posting/list_test.go mutation-layering tests,
+zero oracle commit arbitration, and the bank-transfer concurrent-txn
+invariant test (contrib/integration/bank — SURVEY §4).
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from dgraph_tpu.server.api import Alpha, TxnAborted
+
+
+def make_alpha():
+    a = Alpha(device_threshold=10**9)  # numpy path for small tests
+    a.alter("""
+        name: string @index(exact) .
+        friend: [uid] @reverse .
+        balance: int .
+    """)
+    return a
+
+
+def test_mutate_then_query():
+    a = make_alpha()
+    res = a.mutate(set_nquads="""
+        _:x <name> "alice" .
+        _:y <name> "bob" .
+        _:x <friend> _:y .
+    """)
+    assert set(res["uids"]) == {"_:x", "_:y"}
+    out = a.query('{ q(func: eq(name, "alice")) { name friend { name } } }')
+    assert out == {"q": [{"name": "alice", "friend": [{"name": "bob"}]}]}
+
+
+def test_snapshot_isolation():
+    a = make_alpha()
+    a.mutate(set_nquads='_:x <name> "alice" .')
+    txn = a.new_txn()  # snapshot before bob exists
+    a.mutate(set_nquads='_:y <name> "bob" .')
+    seen = txn.query('{ q(func: has(name)) { name } }')
+    assert [r["name"] for r in seen["q"]] == ["alice"]
+    # a fresh read sees both
+    now = a.query('{ q(func: has(name)) { name } }')
+    assert sorted(r["name"] for r in now["q"]) == ["alice", "bob"]
+
+
+def test_conflict_aborts_second_committer():
+    a = make_alpha()
+    uids = a.mutate(set_nquads='_:x <name> "alice" .')["uids"]
+    x = uids["_:x"]
+    t1, t2 = a.new_txn(), a.new_txn()
+    t1.mutate(set_nquads=f'<{x}> <balance> "10"^^<xs:int> .')
+    t2.mutate(set_nquads=f'<{x}> <balance> "20"^^<xs:int> .')
+    t1.commit()
+    with pytest.raises(TxnAborted):
+        t2.commit()
+    out = a.query(f'{{ q(func: uid({x})) {{ balance }} }}')
+    assert out == {"q": [{"balance": 10}]}
+
+
+def test_no_conflict_on_disjoint_subjects():
+    a = make_alpha()
+    u = a.mutate(set_nquads='_:x <name> "a" .\n_:y <name> "b" .')["uids"]
+    t1, t2 = a.new_txn(), a.new_txn()
+    t1.mutate(set_nquads=f'<{u["_:x"]}> <balance> "1"^^<xs:int> .')
+    t2.mutate(set_nquads=f'<{u["_:y"]}> <balance> "2"^^<xs:int> .')
+    t1.commit()
+    t2.commit()  # disjoint conflict keys — both commit
+
+
+def test_delete_star_and_edge():
+    a = make_alpha()
+    u = a.mutate(set_nquads="""
+        _:x <name> "alice" .
+        _:y <name> "bob" .
+        _:z <name> "carol" .
+        _:x <friend> _:y .
+        _:x <friend> _:z .
+    """)["uids"]
+    x, y = u["_:x"], u["_:y"]
+    a.mutate(del_nquads=f'<{x}> <friend> <{y}> .')
+    out = a.query(f'{{ q(func: uid({x})) {{ friend {{ name }} }} }}')
+    assert out == {"q": [{"friend": [{"name": "carol"}]}]}
+    a.mutate(del_nquads=f'<{x}> <friend> * .')
+    out = a.query(f'{{ q(func: uid({x})) {{ name friend {{ name }} }} }}')
+    assert out == {"q": [{"name": "alice"}]}
+
+
+def test_value_overwrite_vs_list_append():
+    a = make_alpha()
+    a.alter("tag: [string] .")
+    u = a.mutate(set_nquads='_:x <name> "v1" .')["uids"]["_:x"]
+    a.mutate(set_nquads=f'<{u}> <name> "v2" .')
+    out = a.query(f'{{ q(func: uid({u})) {{ name tag }} }}')
+    assert out == {"q": [{"name": "v2"}]}  # scalar: last write wins
+    a.mutate(set_nquads=f'<{u}> <tag> "t1" .')
+    a.mutate(set_nquads=f'<{u}> <tag> "t2" .')
+    out = a.query(f'{{ q(func: uid({u})) {{ tag }} }}')
+    assert sorted(out["q"][0]["tag"]) == ["t1", "t2"]  # list: set union
+
+
+def test_json_mutation_nested():
+    a = make_alpha()
+    a.mutate(set_json={"name": "alice",
+                       "friend": [{"name": "bob"}, {"name": "carol"}]})
+    out = a.query('{ q(func: eq(name, "alice")) { name friend { name } } }')
+    names = sorted(f["name"] for f in out["q"][0]["friend"])
+    assert names == ["bob", "carol"]
+
+
+def test_rollup_preserves_view():
+    a = make_alpha()
+    a.mutate(set_nquads='_:x <name> "alice" .')
+    a.mutate(set_nquads='_:y <name> "bob" .')
+    before = a.query('{ q(func: has(name)) { name } }')
+    a.mvcc.rollup()
+    assert a.mvcc.layers == []
+    after = a.query('{ q(func: has(name)) { name } }')
+    assert before == after
+
+
+def test_alter_builds_index_over_existing_data():
+    a = Alpha(device_threshold=10**9)
+    a.mutate(set_nquads='_:x <title> "hello world" .')
+    with pytest.raises(ValueError):
+        a.query('{ q(func: anyofterms(title, "hello")) { title } }')
+    a.alter("title: string @index(term) .")
+    out = a.query('{ q(func: anyofterms(title, "hello")) { title } }')
+    assert out == {"q": [{"title": "hello world"}]}
+
+
+def test_bank_transfer_invariant():
+    """Concurrent conflicting transfers preserve total balance
+    (reference: contrib/integration/bank)."""
+    a = make_alpha()
+    n_acct, per = 4, 100
+    uids = []
+    for i in range(n_acct):
+        u = a.mutate(set_nquads=f'_:a <name> "acct{i}" .\n'
+                                f'_:a <balance> "{per}"^^<xs:int> .')
+        uids.append(u["uids"]["_:a"])
+
+    committed = [0]
+    lock = threading.Lock()
+
+    def transfer(rng):
+        for _ in range(25):
+            i, j = rng.choice(n_acct, 2, replace=False)
+            t = a.new_txn()
+            try:
+                bi = t.query(f'{{ q(func: uid({uids[i]})) {{ balance }} }}')["q"][0]["balance"]
+                bj = t.query(f'{{ q(func: uid({uids[j]})) {{ balance }} }}')["q"][0]["balance"]
+                amt = int(rng.integers(1, 10))
+                if bi < amt:
+                    t.discard()
+                    continue
+                t.mutate(set_nquads=(
+                    f'<{uids[i]}> <balance> "{bi - amt}"^^<xs:int> .\n'
+                    f'<{uids[j]}> <balance> "{bj + amt}"^^<xs:int> .'))
+                t.commit()
+                with lock:
+                    committed[0] += 1
+            except TxnAborted:
+                pass
+
+    threads = [threading.Thread(target=transfer,
+                                args=(np.random.default_rng(seed),))
+               for seed in range(4)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+
+    out = a.query('{ q(func: has(balance)) { balance } }')
+    total = sum(r["balance"] for r in out["q"])
+    assert total == n_acct * per, f"money leaked: {total}"
+    assert committed[0] > 0, "no transfer ever committed"
