@@ -1,0 +1,209 @@
+"""CLI process entry: `python -m dgraph_tpu <subcommand>`.
+
+Reference parity: `dgraph/cmd/root.go` cobra subcommands — `alpha` (data
+server), `zero` (cluster oracle service), `live` / `bulk` (loaders),
+`export`, `debug` (snapshot inspector), `version`. argparse stands in for
+cobra; every flag maps onto the typed configs in utils/config.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from dgraph_tpu import __version__
+from dgraph_tpu.utils import logging as xlog
+from dgraph_tpu.utils.config import AlphaConfig, load_config
+
+
+def cmd_alpha(args) -> int:
+    from dgraph_tpu.server.api import Alpha
+    from dgraph_tpu.server.http import make_http_server, serve_background
+    from dgraph_tpu.server.task import make_server
+    from dgraph_tpu.store import checkpoint
+
+    cfg = load_config(AlphaConfig, args.config, {
+        "p_dir": args.p, "http_port": args.http_port,
+        "grpc_port": args.grpc_port, "log_level": args.log_level})
+    xlog.setup(cfg.log_level)
+    log = xlog.get("alpha")
+
+    base = None
+    import os
+    if os.path.exists(os.path.join(cfg.p_dir, "manifest.json")):
+        base, base_ts = checkpoint.load(cfg.p_dir)
+        log.info("loaded snapshot: %d nodes from %s", base.n_nodes, cfg.p_dir)
+    alpha = Alpha(base=base, device_threshold=cfg.device_threshold)
+
+    grpc_server, grpc_port = make_server(
+        alpha, f"{cfg.http_addr}:{cfg.grpc_port}")
+    grpc_server.start()
+    http_server = make_http_server(alpha, cfg.http_addr, cfg.http_port)
+    serve_background(http_server)
+    log.info("alpha up: grpc=%d http=%d", grpc_port,
+             http_server.server_address[1])
+    try:
+        grpc_server.wait_for_termination()
+    except KeyboardInterrupt:
+        log.info("shutting down; checkpointing to %s", cfg.p_dir)
+        checkpoint.save(alpha.mvcc.rollup(), cfg.p_dir,
+                        base_ts=alpha.mvcc.base_ts)
+    return 0
+
+
+def cmd_zero(args) -> int:
+    # Standalone oracle service (reference: dgraph zero). The in-process
+    # Alpha embeds its own oracle; a standalone zero serves uid/ts leases
+    # to external loaders over gRPC.
+    import grpc
+    from concurrent import futures
+    from dgraph_tpu.cluster.oracle import Oracle
+    from dgraph_tpu.protos import task_pb2 as pb
+
+    xlog.setup(args.log_level)
+    log = xlog.get("zero")
+    oracle = Oracle()
+
+    def assign(req, ctx):
+        r = oracle.assign_uids(int(req.num))
+        return pb.AssignedIds(start_id=r.start, end_id=r.stop - 1)
+
+    def timestamps(req, ctx):
+        ts = oracle.read_ts()
+        return pb.AssignedIds(start_id=ts, end_id=ts)
+
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=4))
+    server.add_generic_rpc_handlers((
+        grpc.method_handlers_generic_handler("dgraph_tpu.Zero", {
+            "AssignUids": grpc.unary_unary_rpc_method_handler(
+                assign, request_deserializer=pb.AssignRequest.FromString,
+                response_serializer=lambda m: m.SerializeToString()),
+            "Timestamps": grpc.unary_unary_rpc_method_handler(
+                timestamps, request_deserializer=pb.AssignRequest.FromString,
+                response_serializer=lambda m: m.SerializeToString()),
+        }),))
+    port = server.add_insecure_port(f"127.0.0.1:{args.port}")
+    server.start()
+    log.info("zero up: grpc=%d", port)
+    server.wait_for_termination()
+    return 0
+
+
+def cmd_bulk(args) -> int:
+    from dgraph_tpu.loader.bulk import run_bulk
+    xlog.setup(args.log_level)
+    rdf = open(args.files).read()
+    schema = open(args.schema).read() if args.schema else ""
+    st = run_bulk(rdf, args.out, schema_text=schema,
+                  n_mappers=args.mappers)
+    print(json.dumps({"nquads": st.nquads, "nodes": st.nodes,
+                      "edges": st.edges, "elapsed_s": round(st.elapsed_s, 3)}))
+    return 0
+
+
+def cmd_live(args) -> int:
+    from dgraph_tpu.loader.live import run_live
+    from dgraph_tpu.server.api import Alpha
+    from dgraph_tpu.store import checkpoint
+    xlog.setup(args.log_level)
+    import os
+    base = None
+    if os.path.exists(os.path.join(args.p, "manifest.json")):
+        base, _ = checkpoint.load(args.p)
+    alpha = Alpha(base=base)
+    if args.schema:
+        alpha.alter(open(args.schema).read())
+    st = run_live(alpha, open(args.files).read(),
+                  batch_size=args.batch, concurrency=args.conc)
+    checkpoint.save(alpha.mvcc.rollup(), args.p, base_ts=alpha.mvcc.base_ts)
+    print(json.dumps({"nquads": st.nquads, "txns": st.txns,
+                      "aborts": st.aborts,
+                      "elapsed_s": round(st.elapsed_s, 3)}))
+    return 0
+
+
+def cmd_export(args) -> int:
+    from dgraph_tpu.server.export import export_json, export_rdf
+    from dgraph_tpu.store import checkpoint
+    store, _ = checkpoint.load(args.p)
+    with open(args.out, "w") as f:
+        n = (export_json if args.format == "json" else export_rdf)(store, f)
+    print(json.dumps({"exported": n, "format": args.format}))
+    return 0
+
+
+def cmd_debug(args) -> int:
+    """Snapshot inspector (reference: dgraph debug p-dir dump)."""
+    from dgraph_tpu.store import checkpoint
+    store, base_ts = checkpoint.load(args.p)
+    info = {
+        "base_ts": base_ts,
+        "nodes": store.n_nodes,
+        "predicates": {
+            p: {"edges": pd.fwd.nnz if pd.fwd else 0,
+                "reverse": pd.rev is not None,
+                "value_rows": {lang or ".": len(col.subj)
+                               for lang, col in pd.vals.items()},
+                "indexes": sorted(pd.index)}
+            for p, pd in sorted(store.preds.items())},
+        "schema": store.schema.to_text(),
+    }
+    print(json.dumps(info, indent=1))
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="dgraph_tpu",
+        description="TPU-native distributed graph database")
+    ap.add_argument("--version", action="version",
+                    version=f"dgraph_tpu {__version__}")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("alpha", help="run the data server")
+    p.add_argument("--p", default="p", help="posting snapshot dir")
+    p.add_argument("--config", default=None)
+    p.add_argument("--http_port", type=int, default=None)
+    p.add_argument("--grpc_port", type=int, default=None)
+    p.add_argument("--log_level", default="info")
+    p.set_defaults(fn=cmd_alpha)
+
+    p = sub.add_parser("zero", help="run the cluster oracle service")
+    p.add_argument("--port", type=int, default=5080)
+    p.add_argument("--log_level", default="info")
+    p.set_defaults(fn=cmd_zero)
+
+    p = sub.add_parser("bulk", help="offline bulk load → snapshot dir")
+    p.add_argument("--files", required=True, help="N-Quad input file")
+    p.add_argument("--schema", default=None)
+    p.add_argument("--out", default="p")
+    p.add_argument("--mappers", type=int, default=4)
+    p.add_argument("--log_level", default="info")
+    p.set_defaults(fn=cmd_bulk)
+
+    p = sub.add_parser("live", help="transactional load into a snapshot")
+    p.add_argument("--files", required=True)
+    p.add_argument("--schema", default=None)
+    p.add_argument("--p", default="p")
+    p.add_argument("--batch", type=int, default=1000)
+    p.add_argument("--conc", type=int, default=4)
+    p.add_argument("--log_level", default="info")
+    p.set_defaults(fn=cmd_live)
+
+    p = sub.add_parser("export", help="dump a snapshot as RDF/JSON")
+    p.add_argument("--p", default="p")
+    p.add_argument("--out", required=True)
+    p.add_argument("--format", choices=("rdf", "json"), default="rdf")
+    p.set_defaults(fn=cmd_export)
+
+    p = sub.add_parser("debug", help="inspect a snapshot dir")
+    p.add_argument("--p", default="p")
+    p.set_defaults(fn=cmd_debug)
+
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

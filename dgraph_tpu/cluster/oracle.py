@@ -47,11 +47,21 @@ class Oracle:
 
     # -- timestamps ---------------------------------------------------------
     def read_ts(self) -> int:
-        """New start timestamp (reference: Zero.Timestamps lease)."""
+        """New start timestamp for a TRANSACTION — tracked as pending until
+        commit/abort (reference: Zero.Timestamps lease)."""
         with self._lock:
             ts = self._next_ts
             self._next_ts += 1
             self._pending[ts] = TxnStatus(start_ts=ts, commit_ts=0)
+            self._max_assigned = max(self._max_assigned, ts)
+            return ts
+
+    def read_only_ts(self) -> int:
+        """Timestamp for a one-shot read — not tracked, so it never blocks
+        the gc watermark (reference: best-effort/read-only queries)."""
+        with self._lock:
+            ts = self._next_ts
+            self._next_ts += 1
             self._max_assigned = max(self._max_assigned, ts)
             return ts
 
@@ -61,6 +71,27 @@ class Oracle:
         (reference: pb.OracleDelta.MaxAssigned)."""
         with self._lock:
             return self._max_assigned
+
+    def min_active_ts(self) -> int:
+        """Oldest start_ts an undecided txn still reads at — the snapshot
+        retention watermark (reference: oracle doneUntil)."""
+        with self._lock:
+            active = [st.start_ts for st in self._pending.values()
+                      if st.commit_ts == 0]
+            return min(active) if active else self._next_ts
+
+    def gc(self) -> int:
+        """Drop decided txn records and conflict entries no active txn can
+        collide with; returns the min-active watermark."""
+        with self._lock:
+            active = [st.start_ts for st in self._pending.values()
+                      if st.commit_ts == 0]
+            floor = min(active) if active else self._next_ts
+            self._pending = {ts: st for ts, st in self._pending.items()
+                             if st.commit_ts == 0}
+            self._commits = {k: c for k, c in self._commits.items()
+                             if c > floor}
+            return floor
 
     # -- uid leases ---------------------------------------------------------
     def assign_uids(self, n: int) -> range:

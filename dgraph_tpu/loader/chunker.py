@@ -86,6 +86,10 @@ def parse_json(obj, _counter: list | None = None) -> list[NQuad]:
     """
     if isinstance(obj, (str, bytes)):
         obj = json.loads(obj)
+    else:
+        import copy
+        obj = copy.deepcopy(obj)  # blank-node refs are injected into the
+        # tree during flattening; never mutate the caller's object
     counter = _counter if _counter is not None else [0]
     out: list[NQuad] = []
     items = obj if isinstance(obj, list) else [obj]

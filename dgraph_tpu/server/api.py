@@ -10,15 +10,16 @@ transports (HTTP/gRPC) wrap this object in `server/http.py` /
 Transactions follow the reference's client model: `txn = alpha.new_txn()`,
 any number of `txn.query` / `txn.mutate` calls, then `txn.commit()` (Zero
 arbitration; raises `TxnAborted` on conflict) or `txn.discard()`.
-`commit_now=True` mutations are single-shot transactions.
+`commit_now=True` mutations are single-shot transactions; with
+`commit_now=False` the server keeps the txn open, continued by start_ts
+(reference: pb.TxnContext keys round-tripping + CommitOrAbort).
 """
 
 from __future__ import annotations
 
+import contextlib
 import threading
 from dataclasses import dataclass, field
-
-import numpy as np
 
 from dgraph_tpu.cluster.oracle import Oracle, TxnAborted
 from dgraph_tpu.engine import Engine
@@ -31,6 +32,8 @@ from dgraph_tpu.store.types import Kind
 
 __all__ = ["Alpha", "Txn", "TxnAborted"]
 
+GC_EVERY = 256  # timestamps between oracle/store gc sweeps
+
 
 class Alpha:
     """Single-process data server: oracle + MVCC store + query engine."""
@@ -42,29 +45,62 @@ class Alpha:
         self.xidmap = XidMap(self.oracle)
         self.device_threshold = device_threshold
         self._apply_lock = threading.Lock()
+        self._state_lock = threading.Lock()
+        self._open_txns: dict[int, Txn] = {}
+        self._active_reads: dict[int, int] = {}
+        self._gc_tick = 0
         if base is not None and base.n_nodes:
             self.oracle.bump_uid(int(base.uids[-1]))
 
     # -- public api surface (api.Dgraph analog) -----------------------------
     def new_txn(self) -> "Txn":
-        return Txn(self)
+        txn = Txn(self)
+        with self._state_lock:
+            self._open_txns[txn.start_ts] = txn
+        return txn
+
+    def txn(self, start_ts: int) -> "Txn":
+        """Continue a server-held open transaction by start_ts."""
+        with self._state_lock:
+            t = self._open_txns.get(start_ts)
+        if t is None:
+            raise TxnAborted(f"no open txn at start_ts {start_ts}")
+        return t
+
+    @contextlib.contextmanager
+    def _reading(self, ts: int):
+        """Track in-flight reads so gc never drops a snapshot under them."""
+        with self._state_lock:
+            self._active_reads[ts] = self._active_reads.get(ts, 0) + 1
+        try:
+            yield
+        finally:
+            with self._state_lock:
+                self._active_reads[ts] -= 1
+                if not self._active_reads[ts]:
+                    del self._active_reads[ts]
 
     def query(self, dql: str, variables: dict | None = None,
               read_ts: int | None = None) -> dict:
         """Read-only query at a snapshot (reference: Server.Query with
         best-effort/read-only txn)."""
-        ts = self.oracle.read_ts() if read_ts is None else read_ts
-        store = self.mvcc.read_view(ts)
-        return Engine(store, device_threshold=self.device_threshold).query(
-            dql, variables)
+        ts = self.oracle.read_only_ts() if read_ts is None else read_ts
+        with self._reading(ts):
+            store = self.mvcc.read_view(ts)
+            out = Engine(store, device_threshold=self.device_threshold
+                         ).query(dql, variables)
+        self._maybe_gc()
+        return out
 
     def mutate(self, *, set_nquads: str | None = None,
                del_nquads: str | None = None,
                set_json=None, del_json=None,
-               commit_now: bool = True) -> dict:
-        """One-shot mutation transaction (reference: Server.Mutate with
-        CommitNow)."""
-        txn = self.new_txn()
+               commit_now: bool = True,
+               start_ts: int | None = None) -> dict:
+        """Mutation RPC. With start_ts: continue that open txn. With
+        commit_now=False: leave the txn open and return its start_ts
+        (reference: Server.Mutate + CommitNow flag)."""
+        txn = self.txn(start_ts) if start_ts else self.new_txn()
         try:
             uids = txn.mutate(set_nquads=set_nquads, del_nquads=del_nquads,
                               set_json=set_json, del_json=del_json)
@@ -73,25 +109,40 @@ class Alpha:
             return {"uids": uids,
                     "txn": {"start_ts": txn.start_ts,
                             "commit_ts": txn.commit_ts}}
-        except Exception:
+        except TxnAborted:
             txn.discard()
             raise
+        except Exception:
+            if commit_now:
+                txn.discard()
+            raise
+
+    def commit_or_abort(self, start_ts: int, abort: bool = False) -> int:
+        """reference: Server.CommitOrAbort. Returns commit_ts (0 on abort)."""
+        txn = self.txn(start_ts)
+        if abort:
+            txn.discard()
+            return 0
+        return txn.commit()
 
     def alter(self, schema_text: str) -> None:
         """Schema mutation + index rebuild (reference: Server.Alter →
-        schema.Update + posting.RebuildIndex)."""
+        schema.Update + posting.RebuildIndex). The new snapshot is built
+        under the merged schema and swapped in atomically, so concurrent
+        queries see either fully-old or fully-new index state."""
         new = parse_schema(schema_text)
         with self._apply_lock:
-            self.mvcc.schema.update(new)
-            # rebuild the base snapshot under the new schema: recreates
-            # reverse CSR blocks and inverted indexes
-            self.mvcc.rollup()
-            self.mvcc._views.clear()
+            merged = self.mvcc.schema.clone()
+            merged.update(new)
+            self.mvcc.rebuild_base(schema=merged)
 
     def drop_all(self) -> None:
         """reference: api.Operation{DropAll}."""
         with self._apply_lock:
-            self.mvcc.__init__()
+            self.mvcc = MVCCStore()
+            self.xidmap = XidMap(self.oracle)
+            with self._state_lock:
+                self._open_txns.clear()
 
     # -- commit path (worker/draft.go applyMutations analog) ----------------
     def _commit(self, txn: "Txn") -> int:
@@ -101,11 +152,27 @@ class Alpha:
             self.mvcc.apply(txn.mutation, commit_ts)
             return commit_ts
 
+    def _txn_done(self, txn: "Txn") -> None:
+        with self._state_lock:
+            self._open_txns.pop(txn.start_ts, None)
+
+    # -- maintenance --------------------------------------------------------
+    def _maybe_gc(self) -> None:
+        with self._state_lock:
+            self._gc_tick += 1
+            if self._gc_tick % GC_EVERY:
+                return
+            reads_floor = min(self._active_reads, default=None)
+        floor = self.oracle.gc()
+        if reads_floor is not None:
+            floor = min(floor, reads_floor)
+        self.mvcc.gc(floor)
+
 
 @dataclass
 class Txn:
-    """Client-side transaction bookkeeping (reference: dgo txn / edgraph
-    txn context): buffered mutations, blank-node uid map, commit state."""
+    """Transaction bookkeeping (reference: dgo txn / edgraph txn context):
+    buffered mutations, blank-node uid map, commit state."""
 
     alpha: Alpha
     start_ts: int = 0
@@ -166,10 +233,7 @@ class Txn:
             if ps is not None and ps.kind == Kind.UID:
                 m.edge_dels.append((s, nq.predicate, None))
             else:
-                m.val_dels.append((s, nq.predicate, None, ""))
-                if ps is not None and ps.lang:
-                    # star delete covers every language column
-                    m.val_dels.append((s, nq.predicate, None, "*"))
+                m.val_dels.append((s, nq.predicate, None, "*"))
         elif nq.object_id is not None:
             o = self._resolve(nq.object_id)
             (m.edge_dels if delete else m.edge_sets).append(
@@ -185,6 +249,7 @@ class Txn:
         if self._done:
             raise TxnAborted("txn finished")
         self._done = True
+        self.alpha._txn_done(self)
         if self.mutation.is_empty():
             self.alpha.oracle.abort(self.start_ts)
             return 0
@@ -194,4 +259,5 @@ class Txn:
     def discard(self) -> None:
         if not self._done:
             self._done = True
+            self.alpha._txn_done(self)
             self.alpha.oracle.abort(self.start_ts)

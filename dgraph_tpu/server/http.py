@@ -79,21 +79,44 @@ def make_http_server(alpha: Alpha, addr: str = "127.0.0.1",
                             "total_us":
                                 int((time.perf_counter() - t0) * 1e6)}}})
                 elif self.path.startswith("/mutate"):
-                    commit_now = "commitNow=true" in self.path or \
-                        (self.headers.get("X-Dgraph-CommitNow") == "true")
                     ctype = self.headers.get("Content-Type") or ""
                     body = self._body().decode()
+                    qs = self.path.partition("?")[2]
+                    start_ts = None
+                    for part in qs.split("&"):
+                        if part.startswith("startTs="):
+                            start_ts = int(part.split("=", 1)[1])
+                    commit_now = "commitNow=true" in qs or \
+                        (self.headers.get("X-Dgraph-CommitNow") == "true")
                     if "application/json" in ctype:
                         req = json.loads(body)
                         res = alpha.mutate(
                             set_json=req.get("set"),
                             del_json=req.get("delete"),
-                            commit_now=commit_now or req.get("commitNow",
-                                                             True))
+                            commit_now=(commit_now or
+                                        req.get("commitNow", False)),
+                            start_ts=start_ts)
                     else:
                         res = alpha.mutate(set_nquads=body,
-                                           commit_now=True)
+                                           commit_now=commit_now,
+                                           start_ts=start_ts)
                     self._send(200, {"data": res})
+                elif self.path.startswith("/commit"):
+                    qs = self.path.partition("?")[2]
+                    start_ts = abort = None
+                    for part in qs.split("&"):
+                        if part.startswith("startTs="):
+                            start_ts = int(part.split("=", 1)[1])
+                        if part.startswith("abort="):
+                            abort = part.split("=", 1)[1] == "true"
+                    if start_ts is None:
+                        self._send(400, {"errors": [
+                            {"message": "startTs required"}]})
+                        return
+                    cts = alpha.commit_or_abort(start_ts,
+                                                abort=bool(abort))
+                    self._send(200, {"data": {
+                        "code": "Success", "commit_ts": cts}})
                 elif self.path.startswith("/alter"):
                     body = self._body().decode()
                     if body.strip().startswith("{"):

@@ -51,13 +51,23 @@ class DgraphService:
                 del_nquads=req.del_nquads or None,
                 set_json=req.set_json or None,
                 del_json=req.del_json or None,
-                commit_now=req.commit_now)
+                commit_now=req.commit_now,
+                start_ts=req.start_ts or None)
         except TxnAborted as e:
             ctx.abort(grpc.StatusCode.ABORTED, str(e))
         return pb.MutationResp(
             uids=res["uids"],
             txn=pb.TxnContext(start_ts=res["txn"]["start_ts"],
                               commit_ts=res["txn"]["commit_ts"]))
+
+    def CommitOrAbort(self, req: pb.TxnContext, ctx) -> pb.TxnContext:
+        try:
+            cts = self.alpha.commit_or_abort(req.start_ts,
+                                             abort=req.aborted)
+        except TxnAborted as e:
+            ctx.abort(grpc.StatusCode.ABORTED, str(e))
+        return pb.TxnContext(start_ts=req.start_ts, commit_ts=cts,
+                             aborted=req.aborted)
 
     def Alter(self, req: pb.Operation, ctx) -> pb.Payload:
         if req.drop_all:
@@ -139,6 +149,7 @@ def make_server(alpha: Alpha, addr: str = "127.0.0.1:0",
             "Query": _unary(d.Query, pb.Request),
             "Mutate": _unary(d.Mutate, pb.MutationReq),
             "Alter": _unary(d.Alter, pb.Operation),
+            "CommitOrAbort": _unary(d.CommitOrAbort, pb.TxnContext),
             "AssignUids": _unary(d.AssignUids, pb.AssignRequest),
         }),
         grpc.method_handlers_generic_handler(SERVICE_WORKER, {
@@ -177,6 +188,12 @@ class Client:
         self._call(SERVICE_DGRAPH, "Alter",
                    pb.Operation(schema=schema, drop_all=drop_all),
                    pb.Payload)
+
+    def commit_or_abort(self, start_ts: int,
+                        abort: bool = False) -> pb.TxnContext:
+        return self._call(SERVICE_DGRAPH, "CommitOrAbort",
+                          pb.TxnContext(start_ts=start_ts, aborted=abort),
+                          pb.TxnContext)
 
     def serve_task(self, **kw) -> pb.TaskResult:
         return self._call(SERVICE_WORKER, "ServeTask",

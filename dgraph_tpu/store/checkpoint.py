@@ -1,0 +1,111 @@
+"""Checkpoint: versioned on-disk Store snapshots.
+
+Reference parity: the reference's three persistence mechanisms (SURVEY §5)
+— Badger's LSM as durable posting storage, raft snapshots, and
+export/binary-backup — collapse here into one: the host-disk CSR block
+store with a versioned manifest. TPU HBM is a cache over this, never the
+source of truth; recovery = reload (the stateless-sidecar failure model).
+
+Layout:  <dir>/manifest.json
+         <dir>/uids.npy
+         <dir>/<pred-hash>.<fwd|rev>.indptr.npy / .indices.npy
+         <dir>/<pred-hash>.val.<lang>.subj.npy / .vals.npy
+Index blocks are rebuilt on load (cheap, and keeps the format stable
+against tokenizer changes — the reference likewise rebuilds indexes on
+schema migration rather than shipping them in backups).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+
+import numpy as np
+
+from dgraph_tpu.store.schema import parse_schema
+from dgraph_tpu.store.store import (
+    EdgeRel, PredicateData, Store, ValueColumn, build_indexes)
+
+FORMAT_VERSION = 1
+
+
+def _slug(pred: str) -> str:
+    h = hashlib.sha1(pred.encode()).hexdigest()[:12]
+    safe = "".join(c if c.isalnum() or c in "._-" else "_" for c in pred)
+    return f"{safe[:40]}.{h}"
+
+
+def save(store: Store, dirname: str, base_ts: int = 0) -> None:
+    """Write a Store snapshot (reference: export/backup at a timestamp)."""
+    os.makedirs(dirname, exist_ok=True)
+    np.save(os.path.join(dirname, "uids.npy"), store.uids)
+    preds_meta = {}
+    for pred, pd in store.preds.items():
+        slug = _slug(pred)
+        meta = {"slug": slug, "langs": sorted(pd.vals)}
+        for side, rel in (("fwd", pd.fwd), ("rev", pd.rev)):
+            if rel is not None:
+                np.save(os.path.join(dirname, f"{slug}.{side}.indptr.npy"),
+                        rel.indptr)
+                np.save(os.path.join(dirname, f"{slug}.{side}.indices.npy"),
+                        rel.indices)
+                meta[side] = True
+        for lang, col in pd.vals.items():
+            lslug = lang or "_"
+            np.save(os.path.join(dirname, f"{slug}.val.{lslug}.subj.npy"),
+                    col.subj)
+            vals = col.vals
+            if vals.dtype == object:  # strings: store as fixed-width UTF
+                vals = np.array([str(v) for v in vals], dtype=np.str_)
+            np.save(os.path.join(dirname, f"{slug}.val.{lslug}.vals.npy"),
+                    vals)
+        preds_meta[pred] = meta
+    manifest = {
+        "format_version": FORMAT_VERSION,
+        "base_ts": base_ts,
+        "n_nodes": store.n_nodes,
+        "schema": store.schema.to_text(),
+        "predicates": preds_meta,
+    }
+    tmp = os.path.join(dirname, "manifest.json.tmp")
+    with open(tmp, "w") as f:
+        json.dump(manifest, f, indent=1)
+    os.replace(tmp, os.path.join(dirname, "manifest.json"))
+
+
+def load(dirname: str) -> tuple[Store, int]:
+    """Load (store, base_ts). Reference: restore / bulk-load handoff."""
+    with open(os.path.join(dirname, "manifest.json")) as f:
+        manifest = json.load(f)
+    if manifest["format_version"] != FORMAT_VERSION:
+        raise ValueError(
+            f"checkpoint format {manifest['format_version']} != "
+            f"{FORMAT_VERSION}")
+    uids = np.load(os.path.join(dirname, "uids.npy"))
+    schema = parse_schema(manifest["schema"])
+    preds: dict[str, PredicateData] = {}
+    for pred, meta in manifest["predicates"].items():
+        slug = meta["slug"]
+        pd = PredicateData(schema=schema.get(pred))
+        for side in ("fwd", "rev"):
+            if meta.get(side):
+                indptr = np.load(
+                    os.path.join(dirname, f"{slug}.{side}.indptr.npy"))
+                indices = np.load(
+                    os.path.join(dirname, f"{slug}.{side}.indices.npy"))
+                setattr(pd, side, EdgeRel(indptr=indptr, indices=indices))
+        for lang in meta["langs"]:
+            lslug = lang or "_"
+            vals = np.load(
+                os.path.join(dirname, f"{slug}.val.{lslug}.vals.npy"),
+                allow_pickle=False)
+            if vals.dtype.kind == "U":  # restore string columns to object
+                vals = vals.astype(object)
+            pd.vals[lang] = ValueColumn(
+                subj=np.load(
+                    os.path.join(dirname, f"{slug}.val.{lslug}.subj.npy")),
+                vals=vals)
+        preds[pred] = pd
+    build_indexes(preds)
+    return Store(uids=uids, schema=schema, preds=preds), manifest["base_ts"]

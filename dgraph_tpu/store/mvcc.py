@@ -1,17 +1,19 @@
 """MVCC layering over immutable Store snapshots.
 
 Reference parity: `posting/mvcc.go` + `posting/list.go` — each posting list
-is an immutable Badger layer plus an in-memory mutable delta layer keyed by
-commit timestamp; readers at `read_ts` see base ∪ {deltas with commit_ts ≤
-read_ts}; `Rollup` folds deltas into a new immutable layer.
+is an immutable Badger layer plus in-memory delta layers keyed by commit
+timestamp; readers at `read_ts` see base ∪ {deltas with commit_ts ≤
+read_ts}; `Rollup` folds deltas into a new immutable layer, and Badger
+retains old versions for open readers.
 
-TPU-first shape: the immutable layer here is the whole CSR `Store` snapshot
+TPU-first shape: the immutable layer here is a whole CSR `Store` snapshot
 (what lives in HBM); deltas are small host-side edge/value logs per commit.
-A read view materialises base+visible-deltas into a fresh Store (cached per
-visible-set), and `rollup()` promotes the current view to the new base —
-the moral analog of posting-list rollups plus Badger compaction, with HBM
-as a cache over host state (SURVEY §5 checkpoint model: device memory is
-never the source of truth).
+Version retention works like Badger's: `rollup()` adds a *fold point* (a
+materialised snapshot at some commit_ts) without discarding the layers
+older readers still need; `gc(min_active_ts)` is the watermark-driven
+cleanup (reference: oracle MaxAssigned / doneUntil watermarks) that drops
+history no open transaction can reach. HBM is a cache over host state,
+never the source of truth (SURVEY §5 checkpoint model).
 """
 
 from __future__ import annotations
@@ -22,6 +24,8 @@ from dataclasses import dataclass, field
 from dgraph_tpu.store.schema import Schema
 from dgraph_tpu.store.store import TYPE_PRED, Store, StoreBuilder
 from dgraph_tpu.store.types import Kind
+
+_VIEW_CACHE = 8  # non-fold-point views retained (newest win)
 
 
 @dataclass
@@ -59,14 +63,27 @@ class _Layer:
 
 
 class MVCCStore:
-    """Versioned posting store: base snapshot + committed delta layers."""
+    """Versioned posting store: fold-point snapshots + delta layers."""
 
     def __init__(self, base: Store | None = None, base_ts: int = 0):
         self._lock = threading.Lock()
-        self.base = base if base is not None else StoreBuilder().finalize()
-        self.base_ts = base_ts
-        self.layers: list[_Layer] = []       # sorted by commit_ts
+        base = base if base is not None else StoreBuilder().finalize()
+        # history of fold points, ascending by ts; first entry is the
+        # oldest snapshot still reachable by an open reader
+        self._history: list[tuple[int, Store]] = [(base_ts, base)]
+        self.layers: list[_Layer] = []       # all retained, ascending ts
         self._views: dict[tuple, Store] = {}
+
+    # -- current base (newest fold point) ------------------------------------
+    @property
+    def base(self) -> Store:
+        with self._lock:
+            return self._history[-1][1]
+
+    @property
+    def base_ts(self) -> int:
+        with self._lock:
+            return self._history[-1][0]
 
     @property
     def schema(self) -> Schema:
@@ -79,99 +96,141 @@ class MVCCStore:
         with self._lock:
             if self.layers and commit_ts <= self.layers[-1].commit_ts:
                 raise ValueError("commit_ts must be monotonic")
-            if commit_ts <= self.base_ts:
-                raise ValueError("commit_ts below base snapshot")
+            if commit_ts <= self._history[-1][0]:
+                raise ValueError("commit_ts below newest fold point")
             self.layers.append(_Layer(commit_ts, mut))
 
     # -- read path ----------------------------------------------------------
     def read_view(self, read_ts: int) -> Store:
-        """Store snapshot visible at `read_ts` (base ∪ deltas ≤ read_ts)."""
+        """Store snapshot visible at `read_ts` — nearest fold point at or
+        below, plus the delta layers in between."""
         with self._lock:
-            visible = tuple(l.commit_ts for l in self.layers
-                            if l.commit_ts <= read_ts)
-            if not visible:
-                return self.base
-            view = self._views.get(visible)
+            fold_ts, fold_store = self._fold_at(read_ts)
+            pending = [l for l in self.layers
+                       if fold_ts < l.commit_ts <= read_ts]
+            if not pending:
+                return fold_store
+            key = (fold_ts, pending[-1].commit_ts)
+            view = self._views.get(key)
             if view is None:
-                view = self._materialize(
-                    [l for l in self.layers if l.commit_ts <= read_ts])
-                self._views[visible] = view
+                view = _materialize(fold_store, pending)
+                self._views[key] = view
+                while len(self._views) > _VIEW_CACHE:
+                    self._views.pop(next(iter(self._views)))
             return view
 
+    def _fold_at(self, ts: int) -> tuple[int, Store]:
+        for fold_ts, store in reversed(self._history):
+            if fold_ts <= ts:
+                return fold_ts, store
+        raise ValueError(
+            f"read_ts {ts} predates the oldest retained snapshot "
+            f"({self._history[0][0]}); raise the gc watermark lag")
+
+    # -- compaction ---------------------------------------------------------
     def rollup(self, upto_ts: int | None = None) -> Store:
-        """Fold layers ≤ upto_ts into a new base (reference: List.Rollup +
-        snapshot compaction). Returns the new base snapshot."""
+        """Create a fold point at `upto_ts` (default: newest layer).
+        Older layers/snapshots are RETAINED for open readers until gc()
+        (reference: Badger keeps versions until the watermark moves)."""
         with self._lock:
             if upto_ts is None:
-                upto_ts = self.layers[-1].commit_ts if self.layers else self.base_ts
-            folded = [l for l in self.layers if l.commit_ts <= upto_ts]
-            if folded:
-                self.base = self._materialize(folded)
-                self.base_ts = folded[-1].commit_ts
-                self.layers = [l for l in self.layers
-                               if l.commit_ts > upto_ts]
-                self._views.clear()
-            return self.base
+                upto_ts = (self.layers[-1].commit_ts if self.layers
+                           else self._history[-1][0])
+            fold_ts, fold_store = self._fold_at(upto_ts)
+            pending = [l for l in self.layers
+                       if fold_ts < l.commit_ts <= upto_ts]
+            if not pending:
+                return fold_store
+            new_ts = pending[-1].commit_ts
+            store = _materialize(fold_store, pending)
+            self._history.append((new_ts, store))
+            return store
 
-    # -- merge --------------------------------------------------------------
-    def _materialize(self, layers: list[_Layer]) -> Store:
-        """Rebuild a Store from base + deltas (host-side; the new CSR blocks
-        re-enter HBM via Store.device_rel on first use)."""
-        base = self.base
-        b = StoreBuilder(schema=base.schema.clone())
+    def rebuild_base(self, schema: Schema | None = None) -> Store:
+        """Re-materialise the newest state under `schema` and fold — the
+        index/reverse rebuild behind Alter (reference: RebuildIndex). The
+        swap is atomic: readers hold either the old or the new snapshot."""
+        with self._lock:
+            fold_ts, fold_store = self._history[-1]
+            pending = [l for l in self.layers if l.commit_ts > fold_ts]
+            new_ts = pending[-1].commit_ts if pending else fold_ts
+            store = _materialize(fold_store, pending, schema=schema)
+            self._history.append((new_ts, store))
+            self._views.clear()
+            return store
 
-        # live edges/values from base, as dicts for delete application
-        import numpy as np
-        edges: dict[str, set] = {}
-        for pred, pd in base.preds.items():
-            if pd.fwd is not None and pd.fwd.nnz:
-                deg = pd.fwd.indptr[1:] - pd.fwd.indptr[:-1]
-                src_r = np.repeat(np.arange(base.n_nodes), deg)
-                s_uid = base.uids[src_r]
-                o_uid = base.uids[pd.fwd.indices]
-                edges[pred] = set(zip(s_uid.tolist(), o_uid.tolist()))
-        vals: dict[tuple, dict] = {}
-        for pred, pd in base.preds.items():
-            for lang, col in pd.vals.items():
-                d = vals.setdefault((pred, lang), {})
-                for s, v in zip(col.subj, col.vals):
-                    d.setdefault(int(base.uids[s]), []).append(v)
+    def gc(self, min_active_ts: int) -> None:
+        """Drop snapshots/layers unreachable by any ts ≥ min_active_ts."""
+        with self._lock:
+            keep = 0
+            for i, (fold_ts, _) in enumerate(self._history):
+                if fold_ts <= min_active_ts:
+                    keep = i
+            self._history = self._history[keep:]
+            floor = self._history[0][0]
+            self.layers = [l for l in self.layers if l.commit_ts > floor]
+            self._views = {k: v for k, v in self._views.items()
+                           if k[0] >= floor}
 
-        for layer in layers:
-            m = layer.mut
-            for s, p, o in m.edge_dels:
-                if o is None:
-                    edges[p] = {e for e in edges.get(p, set())
-                                if e[0] != s}
+
+def _materialize(base: Store, layers: list[_Layer],
+                 schema: Schema | None = None) -> Store:
+    """Rebuild a Store from base + deltas (host-side; the new CSR blocks
+    re-enter HBM via Store.device_rel on first use)."""
+    import numpy as np
+    b = StoreBuilder(schema=(schema if schema is not None
+                             else base.schema.clone()))
+
+    # live edges/values from base, as dicts for delete application
+    edges: dict[str, set] = {}
+    for pred, pd in base.preds.items():
+        if pd.fwd is not None and pd.fwd.nnz:
+            deg = pd.fwd.indptr[1:] - pd.fwd.indptr[:-1]
+            src_r = np.repeat(np.arange(base.n_nodes), deg)
+            s_uid = base.uids[src_r]
+            o_uid = base.uids[pd.fwd.indices]
+            edges[pred] = set(zip(s_uid.tolist(), o_uid.tolist()))
+    vals: dict[tuple, dict] = {}
+    for pred, pd in base.preds.items():
+        for lang, col in pd.vals.items():
+            d = vals.setdefault((pred, lang), {})
+            for s, v in zip(col.subj, col.vals):
+                d.setdefault(int(base.uids[s]), []).append(v)
+
+    for layer in layers:
+        m = layer.mut
+        for s, p, o in m.edge_dels:
+            if o is None:
+                edges[p] = {e for e in edges.get(p, set()) if e[0] != s}
+            else:
+                edges.get(p, set()).discard((s, o))
+        for s, p, o in m.edge_sets:
+            edges.setdefault(p, set()).add((s, o))
+        for s, p, _v, lang in m.val_dels:
+            if lang == "*":  # delete across every language column
+                for (vp, _vl), d in vals.items():
+                    if vp == p:
+                        d.pop(s, None)
+            else:
+                vals.get((p, lang), {}).pop(s, None)
+        for s, p, v, lang in m.val_sets:
+            ps = b.schema.peek(p)
+            if ps is not None and ps.is_list:
+                vals.setdefault((p, lang), {}).setdefault(s, []).append(v)
+            else:
+                vals.setdefault((p, lang), {})[s] = [v]
+
+    for pred, es in edges.items():
+        for s, o in sorted(es):
+            b.add_edge(s, pred, o)
+    for (pred, lang), d in vals.items():
+        for s, vlist in sorted(d.items()):
+            for v in vlist:
+                if pred == TYPE_PRED:
+                    b.add_type(s, str(v))
                 else:
-                    edges.get(p, set()).discard((s, o))
-            for s, p, o in m.edge_sets:
-                edges.setdefault(p, set()).add((s, o))
-            for s, p, _v, lang in m.val_dels:
-                if lang == "*":  # delete across every language column
-                    for (vp, _vl), d in vals.items():
-                        if vp == p:
-                            d.pop(s, None)
-                else:
-                    vals.get((p, lang), {}).pop(s, None)
-            for s, p, v, lang in m.val_sets:
-                ps = base.schema.peek(p)
-                if ps is not None and ps.is_list:
-                    vals.setdefault((p, lang), {}).setdefault(s, []).append(v)
-                else:
-                    vals.setdefault((p, lang), {})[s] = [v]
-
-        for pred, es in edges.items():
-            for s, o in sorted(es):
-                b.add_edge(s, pred, o)
-        for (pred, lang), d in vals.items():
-            for s, vlist in sorted(d.items()):
-                for v in vlist:
-                    if pred == TYPE_PRED:
-                        b.add_type(s, str(v))
-                    else:
-                        b.add_value(s, pred, _to_py(v), lang)
-        return b.finalize()
+                    b.add_value(s, pred, _to_py(v), lang)
+    return b.finalize()
 
 
 def _to_py(v):

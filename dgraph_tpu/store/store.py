@@ -281,23 +281,28 @@ class StoreBuilder:
                 vals[i] = dpairs[j][1]
             pd.vals[lang] = ValueColumn(subj=subj, vals=vals)
 
-        # build inverted indexes (reference: posting/index.go BuildTokens)
-        for pred, pd in preds.items():
-            ps = pd.schema
-            if not ps.index_tokenizers:
-                continue
-            for tk in ps.index_tokenizers:
-                if tk not in ("exact", "hash", "term", "fulltext", "trigram"):
-                    continue  # numeric/datetime ranges use sorted columns
-                inv: dict[str, list[int]] = {}
-                for lang, col in pd.vals.items():
-                    for s, v in zip(col.subj, col.vals):
-                        for t in tokens_for(tk, v):
-                            inv.setdefault(t, []).append(int(s))
-                pd.index[tk] = {t: np.unique(np.array(s_list, np.int32))
-                                for t, s_list in inv.items()}
-
+        build_indexes(preds)
         return Store(uids=uids, schema=self.schema, preds=preds)
+
+
+def build_indexes(preds: dict[str, PredicateData]) -> None:
+    """Build inverted token indexes from value columns (reference:
+    posting/index.go BuildTokens / RebuildIndex). Shared by StoreBuilder
+    and checkpoint load."""
+    for pred, pd in preds.items():
+        ps = pd.schema
+        if not ps.index_tokenizers:
+            continue
+        for tk in ps.index_tokenizers:
+            if tk not in ("exact", "hash", "term", "fulltext", "trigram"):
+                continue  # numeric/datetime ranges use sorted columns
+            inv: dict[str, list[int]] = {}
+            for lang, col in pd.vals.items():
+                for s, v in zip(col.subj, col.vals):
+                    for t in tokens_for(tk, v):
+                        inv.setdefault(t, []).append(int(s))
+            pd.index[tk] = {t: np.unique(np.array(s_list, np.int32))
+                            for t, s_list in inv.items()}
 
 
 def _csr_from_pairs(src: np.ndarray, dst: np.ndarray, n: int) -> EdgeRel:

@@ -1,0 +1,69 @@
+"""Typed configuration + superflag parsing.
+
+Reference parity: `x/flags.go` (`z.SuperFlag` grouped flags like
+`--badger compression=zstd;numgoroutines=8`) and the cobra/viper flag
+surface of `dgraph alpha|zero` (SURVEY §5 config system). One dataclass
+per process role; values come from defaults < config file (JSON/TOML-lite)
+< CLI flags.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from dataclasses import dataclass, field
+
+
+def parse_superflag(s: str) -> dict[str, str]:
+    """'a=1; b=x' → {'a': '1', 'b': 'x'} (reference: z.SuperFlag)."""
+    out = {}
+    for part in s.split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise ValueError(f"superflag needs key=value, got {part!r}")
+        k, v = part.split("=", 1)
+        out[k.strip()] = v.strip()
+    return out
+
+
+@dataclass
+class AlphaConfig:
+    """`dgraph_tpu alpha` (reference: dgraph/cmd/alpha/run.go flags)."""
+
+    p_dir: str = "p"              # posting checkpoint dir
+    http_addr: str = "127.0.0.1"
+    http_port: int = 8080
+    grpc_port: int = 9080
+    device_threshold: int = 512   # frontier size that moves a hop on-device
+    mesh_devices: int = 0         # 0 = all visible devices
+    rollup_every: int = 64        # commits between automatic rollups
+    log_level: str = "info"
+
+
+@dataclass
+class ZeroConfig:
+    """`dgraph_tpu zero` (reference: dgraph/cmd/zero/run.go flags)."""
+
+    grpc_port: int = 5080
+    first_uid: int = 1
+    first_ts: int = 1
+    log_level: str = "info"
+
+
+def load_config(cls, path: str | None = None, overrides: dict | None = None):
+    """defaults < json file < overrides (reference: viper precedence)."""
+    cfg = cls()
+    if path and os.path.exists(path):
+        with open(path) as f:
+            data = json.load(f)
+        for k, v in data.items():
+            if hasattr(cfg, k):
+                setattr(cfg, k, v)
+    for k, v in (overrides or {}).items():
+        if v is not None and hasattr(cfg, k):
+            fieldtype = type(getattr(cfg, k))
+            setattr(cfg, k, fieldtype(v))
+    return cfg

@@ -1,0 +1,78 @@
+"""Tracing: per-hop spans + device profiling.
+
+Reference parity: OpenCensus spans around each `ProcessTaskOverNetwork`
+leg with Jaeger export (SURVEY §5). TPU equivalent: lightweight in-process
+spans (queryable buffer + log lines) and `jax.profiler` trace capture for
+Perfetto when a trace directory is set. Spans fence device work with
+`block_until_ready` so timings are honest.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+
+_TRACE_DIR: str | None = None
+_BUF: deque = deque(maxlen=4096)
+_LOCK = threading.Lock()
+_TLS = threading.local()
+
+
+@dataclass
+class Span:
+    name: str
+    start_us: int
+    dur_us: int = 0
+    parent: str = ""
+    attrs: dict = field(default_factory=dict)
+
+
+def enable_device_trace(trace_dir: str) -> None:
+    """Arm jax.profiler capture for the next `span(..., device=True)`."""
+    global _TRACE_DIR
+    _TRACE_DIR = trace_dir
+
+
+@contextlib.contextmanager
+def span(name: str, device: bool = False, **attrs):
+    """Time a region; nests via thread-local parent tracking.
+
+    `device=True` additionally wraps the region in a jax.profiler trace
+    (if armed) and blocks on async dispatch before closing the span.
+    """
+    parent = getattr(_TLS, "current", "")
+    _TLS.current = name
+    t0 = time.perf_counter()
+    prof = None
+    if device and _TRACE_DIR is not None:
+        import jax
+        prof = jax.profiler.trace(_TRACE_DIR)
+        prof.__enter__()
+    try:
+        yield
+    finally:
+        if device:
+            import jax
+            # fence pending async work so dur_us covers real execution
+            jax.effects_barrier()
+        if prof is not None:
+            prof.__exit__(None, None, None)
+        _TLS.current = parent
+        s = Span(name=name, start_us=int(t0 * 1e6),
+                 dur_us=int((time.perf_counter() - t0) * 1e6),
+                 parent=parent, attrs=attrs)
+        with _LOCK:
+            _BUF.append(s)
+
+
+def recent(n: int = 100) -> list[Span]:
+    with _LOCK:
+        return list(_BUF)[-n:]
+
+
+def clear() -> None:
+    with _LOCK:
+        _BUF.clear()

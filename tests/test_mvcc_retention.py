@@ -1,0 +1,138 @@
+"""MVCC retention + alter/continuation regressions (code-review findings).
+
+Reference parity model: Badger version retention for open readers, oracle
+doneUntil watermarks, CommitOrAbort continuation.
+"""
+
+import pytest
+
+from dgraph_tpu.server.api import Alpha, TxnAborted
+
+
+def make_alpha():
+    a = Alpha(device_threshold=10**9)
+    a.alter("name: string @index(exact) .\nbalance: int .")
+    return a
+
+
+def test_alter_indexes_with_no_pending_layers():
+    """alter() must rebuild indexes even right after a rollup."""
+    a = Alpha(device_threshold=10**9)
+    a.mutate(set_nquads='_:x <title> "hello world" .')
+    a.mvcc.rollup()  # no pending layers now
+    a.alter("title: string @index(term) .")
+    out = a.query('{ q(func: anyofterms(title, "hello")) { title } }')
+    assert out == {"q": [{"title": "hello world"}]}
+
+
+def test_rollup_keeps_open_snapshots():
+    """An open txn must not see commits folded into base after its start."""
+    a = make_alpha()
+    a.mutate(set_nquads='_:x <name> "alice" .')
+    txn = a.new_txn()
+    a.mutate(set_nquads='_:y <name> "bob" .')
+    a.mvcc.rollup()  # folds bob's commit into a new fold point
+    seen = txn.query('{ q(func: has(name)) { name } }')
+    assert [r["name"] for r in seen["q"]] == ["alice"]
+    txn.discard()
+
+
+def test_commit_now_false_continuation():
+    a = make_alpha()
+    res = a.mutate(set_nquads='_:x <name> "zed" .', commit_now=False)
+    st = res["txn"]["start_ts"]
+    assert res["txn"]["commit_ts"] == 0
+    # not visible before commit
+    out = a.query('{ q(func: eq(name, "zed")) { name } }')
+    assert out == {"q": []}
+    cts = a.commit_or_abort(st)
+    assert cts > 0
+    out = a.query('{ q(func: eq(name, "zed")) { name } }')
+    assert out == {"q": [{"name": "zed"}]}
+
+
+def test_commit_or_abort_abort():
+    a = make_alpha()
+    res = a.mutate(set_nquads='_:x <name> "gone" .', commit_now=False)
+    assert a.commit_or_abort(res["txn"]["start_ts"], abort=True) == 0
+    out = a.query('{ q(func: eq(name, "gone")) { name } }')
+    assert out == {"q": []}
+    with pytest.raises(TxnAborted):
+        a.commit_or_abort(res["txn"]["start_ts"])
+
+
+def test_oracle_gc_bounds_state():
+    a = make_alpha()
+    a.mutate(set_nquads='_:x <name> "n" .')
+    for _ in range(600):  # > GC_EVERY queries
+        a.query('{ q(func: eq(name, "n")) { name } }')
+    assert len(a.oracle._pending) < 300
+    assert len(a.mvcc._views) <= 8
+
+
+def test_gc_respects_open_txn():
+    a = make_alpha()
+    a.mutate(set_nquads='_:x <name> "alice" .')
+    txn = a.new_txn()
+    a.mutate(set_nquads='_:y <name> "bob" .')
+    a.mvcc.rollup()
+    for _ in range(600):
+        a.query('{ q(func: has(name)) { name } }')  # triggers gc sweeps
+    # the open txn's snapshot must still be readable
+    seen = txn.query('{ q(func: has(name)) { name } }')
+    assert [r["name"] for r in seen["q"]] == ["alice"]
+    txn.discard()
+
+
+def test_grpc_txn_continuation():
+    from dgraph_tpu.server.task import Client, make_server
+    a = make_alpha()
+    server, port = make_server(a)
+    server.start()
+    try:
+        c = Client(f"127.0.0.1:{port}")
+        r = c.mutate(set_nquads='_:x <name> "tx" .', commit_now=False)
+        st = r.txn.start_ts
+        assert r.txn.commit_ts == 0
+        r2 = c.mutate(set_nquads=f'_:y <name> "ty" .', commit_now=False,
+                      start_ts=st)
+        ctx = c.commit_or_abort(st)
+        assert ctx.commit_ts > 0
+        out = c.query('{ q(func: has(name)) { name } }')
+        assert sorted(x["name"] for x in out["q"]) == ["tx", "ty"]
+        c.close()
+    finally:
+        server.stop(0)
+
+
+def test_http_commit_endpoint():
+    import json
+    import urllib.request
+    from dgraph_tpu.server.http import make_http_server, serve_background
+    a = make_alpha()
+    srv = make_http_server(a)
+    serve_background(srv)
+    port = srv.server_address[1]
+
+    def post(path, body):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}{path}", data=body.encode(),
+            headers={"Content-Type": "application/rdf"})
+        with urllib.request.urlopen(req) as r:
+            return json.loads(r.read())
+
+    res = post("/mutate", '_:x <name> "h" .')
+    st = res["data"]["txn"]["start_ts"]
+    assert res["data"]["txn"]["commit_ts"] == 0
+    res = post(f"/commit?startTs={st}", "")
+    assert res["data"]["commit_ts"] > 0
+    out = post("/query", '{ q(func: eq(name, "h")) { name } }')
+    assert out["data"] == {"q": [{"name": "h"}]}
+    srv.shutdown()
+
+
+def test_parse_json_does_not_mutate_input():
+    from dgraph_tpu.loader.chunker import parse_json
+    obj = {"name": "a", "friend": [{"name": "b"}]}
+    parse_json(obj)
+    assert "uid" not in obj and "uid" not in obj["friend"][0]
