@@ -1,0 +1,157 @@
+"""Native host runtime: ctypes bindings over libdgtpu.so.
+
+Reference parity note (SURVEY §2.6): the reference is pure Go — its
+performance-critical host loops are `codec/` varint decode and the bulk
+reducer's sort. Those two roles are implemented here in C++ (codec.cpp,
+csr.cpp), built with `make -C dgraph_tpu/native`, loaded via ctypes (no
+pybind11 in this image). Every entry point has a numpy fallback so the
+framework runs without the native build; `HAVE_NATIVE` reports which path
+is active.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+
+import numpy as np
+
+_DIR = os.path.dirname(__file__)
+_SO = os.path.join(_DIR, "libdgtpu.so")
+_lib = None
+
+
+def _load():
+    global _lib
+    if _lib is not None:
+        return _lib
+    if not os.path.exists(_SO):
+        return None
+    lib = ctypes.CDLL(_SO)
+    lib.dg_codec_bound.restype = ctypes.c_int64
+    lib.dg_codec_bound.argtypes = [ctypes.c_int64]
+    lib.dg_codec_encode.restype = ctypes.c_int64
+    lib.dg_codec_encode.argtypes = [
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_uint8)]
+    lib.dg_codec_decode.restype = ctypes.c_int64
+    lib.dg_codec_decode.argtypes = [
+        ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64, ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int64)]
+    lib.dg_build_csr.restype = ctypes.c_int64
+    lib.dg_build_csr.argtypes = [
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+        ctypes.c_int64, ctypes.c_int32,
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_uint64)]
+    _lib = lib
+    return lib
+
+
+def build(quiet: bool = True) -> bool:
+    """Compile libdgtpu.so in place (reference role: `go build`)."""
+    global _lib, HAVE_NATIVE
+    try:
+        subprocess.run(["make", "-C", _DIR],
+                       capture_output=quiet, check=True, timeout=120)
+    except Exception:
+        return False
+    _lib = None
+    HAVE_NATIVE = _load() is not None
+    return HAVE_NATIVE
+
+
+HAVE_NATIVE = _load() is not None
+
+
+def _ptr(a: np.ndarray, ct):
+    return a.ctypes.data_as(ctypes.POINTER(ct))
+
+
+# -- codec (reference: codec.Encoder/Decoder) --------------------------------
+
+def codec_encode(uids: np.ndarray) -> bytes:
+    """Sorted int64 uids → delta-varint bytes."""
+    uids = np.ascontiguousarray(uids, np.int64)
+    lib = _load()
+    if lib is not None:
+        out = np.empty(int(lib.dg_codec_bound(len(uids))), np.uint8)
+        n = lib.dg_codec_encode(_ptr(uids, ctypes.c_int64), len(uids),
+                                _ptr(out, ctypes.c_uint8))
+        if n < 0:
+            raise ValueError("uids not sorted ascending")
+        return out[:n].tobytes()
+    # python fallback: LEB128 deltas
+    if len(uids) and (uids[0] < 0 or np.any(np.diff(uids) < 0)):
+        raise ValueError("uids not sorted ascending (and nonnegative)")
+    out = bytearray()
+    prev = 0
+    for v in uids.tolist():
+        d = v - prev
+        prev = v
+        while True:
+            b = d & 0x7F
+            d >>= 7
+            if d:
+                out.append(b | 0x80)
+            else:
+                out.append(b)
+                break
+    return bytes(out)
+
+
+def codec_decode(buf: bytes, n: int) -> np.ndarray:
+    """delta-varint bytes → sorted int64 uids[n]."""
+    lib = _load()
+    if lib is not None:
+        raw = np.frombuffer(buf, np.uint8)
+        out = np.empty(n, np.int64)
+        got = lib.dg_codec_decode(_ptr(raw, ctypes.c_uint8), len(raw), n,
+                                  _ptr(out, ctypes.c_int64))
+        if got != n:
+            raise ValueError(f"decoded {got} of {n} uids")
+        return out
+    out = np.empty(n, np.int64)
+    prev = 0
+    pos = 0
+    for i in range(n):
+        u = 0
+        shift = 0
+        while True:
+            if pos >= len(buf):
+                raise ValueError(f"decoded {i} of {n} uids")
+            b = buf[pos]
+            pos += 1
+            u |= (b & 0x7F) << shift
+            if not (b & 0x80):
+                break
+            shift += 7
+        prev += u
+        out[i] = prev
+    return out
+
+
+# -- CSR build (reference: bulk reduce) --------------------------------------
+
+def build_csr(src: np.ndarray, dst: np.ndarray, n: int):
+    """Edge pairs → (indptr[int32, n+1], indices[int32, nnz]), sorted rows,
+    deduped. Matches store._csr_from_pairs output exactly."""
+    src = np.ascontiguousarray(src, np.int32)
+    dst = np.ascontiguousarray(dst, np.int32)
+    m = len(src)
+    lib = _load()
+    if lib is not None and m:
+        indptr = np.empty(n + 1, np.int32)
+        indices = np.empty(m, np.int32)
+        scratch = np.empty(m, np.uint64)
+        nnz = lib.dg_build_csr(
+            _ptr(src, ctypes.c_int32), _ptr(dst, ctypes.c_int32), m, n,
+            _ptr(indptr, ctypes.c_int32), _ptr(indices, ctypes.c_int32),
+            _ptr(scratch, ctypes.c_uint64))
+        if nnz < 0:
+            raise ValueError("rank out of range in edge pairs")
+        return indptr, indices[:nnz].copy()
+    from dgraph_tpu.store.store import _csr_from_pairs_np
+    rel = _csr_from_pairs_np(src, dst, n)
+    return rel.indptr, rel.indices

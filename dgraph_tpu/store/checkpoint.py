@@ -36,10 +36,22 @@ def _slug(pred: str) -> str:
     return f"{safe[:40]}.{h}"
 
 
-def save(store: Store, dirname: str, base_ts: int = 0) -> None:
-    """Write a Store snapshot (reference: export/backup at a timestamp)."""
+def save(store: Store, dirname: str, base_ts: int = 0,
+         compress: bool | None = None) -> None:
+    """Write a Store snapshot (reference: export/backup at a timestamp).
+
+    `compress` (default: auto when the native lib is built) delta-varint
+    packs the sorted uid vocabulary via native/codec.cpp — the role the
+    reference's codec.UidPack plays for posting storage."""
+    from dgraph_tpu import native
+    if compress is None:
+        compress = native.HAVE_NATIVE
     os.makedirs(dirname, exist_ok=True)
-    np.save(os.path.join(dirname, "uids.npy"), store.uids)
+    if compress:
+        with open(os.path.join(dirname, "uids.duc"), "wb") as f:
+            f.write(native.codec_encode(store.uids))
+    else:
+        np.save(os.path.join(dirname, "uids.npy"), store.uids)
     preds_meta = {}
     for pred, pd in store.preds.items():
         slug = _slug(pred)
@@ -65,6 +77,7 @@ def save(store: Store, dirname: str, base_ts: int = 0) -> None:
         "format_version": FORMAT_VERSION,
         "base_ts": base_ts,
         "n_nodes": store.n_nodes,
+        "uids_codec": bool(compress),
         "schema": store.schema.to_text(),
         "predicates": preds_meta,
     }
@@ -82,7 +95,12 @@ def load(dirname: str) -> tuple[Store, int]:
         raise ValueError(
             f"checkpoint format {manifest['format_version']} != "
             f"{FORMAT_VERSION}")
-    uids = np.load(os.path.join(dirname, "uids.npy"))
+    if manifest.get("uids_codec"):
+        from dgraph_tpu import native
+        with open(os.path.join(dirname, "uids.duc"), "rb") as f:
+            uids = native.codec_decode(f.read(), manifest["n_nodes"])
+    else:
+        uids = np.load(os.path.join(dirname, "uids.npy"))
     schema = parse_schema(manifest["schema"])
     preds: dict[str, PredicateData] = {}
     for pred, meta in manifest["predicates"].items():

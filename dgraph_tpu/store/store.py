@@ -306,7 +306,18 @@ def build_indexes(preds: dict[str, PredicateData]) -> None:
 
 
 def _csr_from_pairs(src: np.ndarray, dst: np.ndarray, n: int) -> EdgeRel:
-    """Sorted-by-(src, dst), deduped CSR from edge pairs."""
+    """Sorted-by-(src, dst), deduped CSR from edge pairs. Uses the native
+    C++ builder when built (native/csr.cpp — the bulk-reduce hot loop);
+    numpy otherwise. Outputs are bit-identical either way."""
+    if len(src) and n < 2**31:
+        from dgraph_tpu import native
+        if native.HAVE_NATIVE:
+            indptr, indices = native.build_csr(src, dst, n)
+            return EdgeRel(indptr=indptr, indices=indices)
+    return _csr_from_pairs_np(src, dst, n)
+
+
+def _csr_from_pairs_np(src: np.ndarray, dst: np.ndarray, n: int) -> EdgeRel:
     order = np.lexsort((dst, src))
     src, dst = src[order], dst[order]
     if len(src):
