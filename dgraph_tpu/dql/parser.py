@@ -331,8 +331,42 @@ class Parser:
                 while not self.accept(")"):
                     sg.groupby.append(self.name())
                     self.accept(",")
+            elif d == "facets":
+                self._parse_facets_args(sg)
             else:
                 raise ParseError(f"unknown directive @{d}")
+
+    def _parse_facets_args(self, sg: SubGraph) -> None:
+        """@facets | @facets(k1, a: k2) | @facets(eq(k, v) ...) |
+        @facets(orderasc: k). Multiple @facets directives accumulate
+        (reference: one for keys, one for filters, one for order)."""
+        if sg.facet_keys is None:
+            sg.facet_keys = []
+        if not self.accept("("):
+            return  # bare @facets → all keys
+        if self.peek().text == ")":
+            self.next()
+            return
+        # filter form: a function name followed by "("
+        if self.peek(1).text == "(" and self.peek().text.lower() in (
+                "eq", "le", "lt", "ge", "gt", "not", "and", "or"):
+            tree = self._filter_or()
+            self.expect(")")
+            sg.facet_filter = tree if sg.facet_filter is None else \
+                FilterNode(op="and", children=[sg.facet_filter, tree])
+            return
+        while True:
+            name = self.name()
+            if name in ("orderasc", "orderdesc") and self.accept(":"):
+                sg.facet_orders.append(Order(
+                    attr=self.name(), desc=(name == "orderdesc")))
+            elif self.accept(":"):
+                sg.facet_keys.append((name, self.name()))  # alias: key
+            else:
+                sg.facet_keys.append(("", name))
+            if not self.accept(","):
+                break
+        self.expect(")")
 
     def _parse_recurse_args(self) -> RecurseArgs:
         args = RecurseArgs()
@@ -441,7 +475,7 @@ class Parser:
             sg.attr = attr
         if self.peek().text == "@" and self.peek(1).kind == "name" and \
                 self.peek(1).text not in ("filter", "recurse", "cascade",
-                                          "normalize", "groupby"):
+                                          "normalize", "groupby", "facets"):
             self.next()
             sg.lang = self._lang_chain()
         if self.accept("("):

@@ -32,12 +32,16 @@ from dgraph_tpu.store.store import Store
 from dgraph_tpu.store.types import Kind
 
 
+EMPTY64 = np.zeros(0, np.int64)
+
+
 @dataclass
 class LevelNode:
     sg: SubGraph
     nodes: np.ndarray                      # sorted unique int32 ranks
     matrix_seg: np.ndarray = field(default_factory=lambda: EMPTY)
     matrix_child: np.ndarray = field(default_factory=lambda: EMPTY)
+    matrix_pos: np.ndarray = field(default_factory=lambda: EMPTY64)
     display: np.ndarray | None = None      # root blocks: ordered rank list
     children: list["LevelNode"] = field(default_factory=list)
     leaf_sgs: list[SubGraph] = field(default_factory=list)
@@ -70,21 +74,26 @@ class Executor:
 
     # -- frontier expansion (the hot op) ------------------------------------
     def expand(self, pred: str, reverse: bool, frontier: np.ndarray):
-        """Whole-frontier CSR expansion → (neighbors, seg) host arrays."""
+        """Whole-frontier CSR expansion → (neighbors, seg, edge_pos) host
+        arrays. `edge_pos` indexes the FORWARD `indices` array only when
+        reverse=False (facet columns are forward-aligned); for reverse
+        expansion it indexes the reverse CSR (facets unsupported on ~pred,
+        as in the reference)."""
         rel = self.store.rel(pred, reverse)
         if len(frontier) == 0 or rel.nnz == 0:
-            return EMPTY, EMPTY
+            return EMPTY, EMPTY, EMPTY64
         if len(frontier) >= self.device_threshold:
             return self._expand_device(pred, reverse, frontier)
         starts = rel.indptr[frontier]
         deg = rel.indptr[frontier + 1] - starts
         total = int(deg.sum())
         if total == 0:
-            return EMPTY, EMPTY
+            return EMPTY, EMPTY, EMPTY64
         seg = np.repeat(np.arange(len(frontier), dtype=np.int32), deg)
         base = np.repeat(np.cumsum(deg) - deg, deg)
-        pos = np.repeat(starts, deg) + (np.arange(total, dtype=np.int64) - base)
-        return rel.indices[pos], seg
+        pos = np.repeat(starts.astype(np.int64), deg) + \
+            (np.arange(total, dtype=np.int64) - base)
+        return rel.indices[pos], seg, pos
 
     def _expand_device(self, pred: str, reverse: bool, frontier: np.ndarray):
         indptr, indices = self.store.device_rel(pred, reverse)
@@ -92,9 +101,10 @@ class Executor:
         fr = ops.pad_to(frontier, fcap)
         deg = self.store.rel(pred, reverse).degree(frontier)
         ecap = _bucket(max(int(deg.sum()), 1))
-        nbrs, seg, _pos, valid, total = ops.gather_edges(indptr, indices, fr, ecap)
+        nbrs, seg, pos, valid, total = ops.gather_edges(indptr, indices, fr, ecap)
         valid = np.asarray(valid)
-        return np.asarray(nbrs)[valid], np.asarray(seg)[valid]
+        return (np.asarray(nbrs)[valid], np.asarray(seg)[valid],
+                np.asarray(pos)[valid].astype(np.int64))
 
     # -- filters ------------------------------------------------------------
     def apply_filter(self, tree: FilterNode | None, universe: np.ndarray) -> np.ndarray:
@@ -121,14 +131,59 @@ class Executor:
         return EMPTY
 
     def filter_edges(self, filters: FilterNode | None, nbrs: np.ndarray,
-                     seg: np.ndarray):
+                     seg: np.ndarray, pos: np.ndarray | None = None):
         """Apply a filter tree to a flattened edge list, re-masking rows.
         Shared by plain expansion, @recurse, and shortest-path hops."""
+        if pos is None:
+            pos = EMPTY64
         if filters is None or not len(nbrs):
-            return nbrs, seg
+            return nbrs, seg, pos
         allowed = self.apply_filter(filters, np.unique(nbrs).astype(np.int32))
         keep = np.isin(nbrs, allowed)
-        return nbrs[keep], seg[keep]
+        return nbrs[keep], seg[keep], (pos[keep] if len(pos) else pos)
+
+    def facet_filter_edges(self, sg: SubGraph, pred: str,
+                           nbrs: np.ndarray, seg: np.ndarray,
+                           pos: np.ndarray):
+        """@facets(eq(k, v) ...) — drop edges whose facets fail the tree
+        (reference: facets filtering in worker facetsFilter)."""
+        if sg.facet_filter is None or not len(nbrs):
+            return nbrs, seg, pos
+        keep = self._eval_facet_tree(sg.facet_filter, pred, pos)
+        return nbrs[keep], seg[keep], pos[keep]
+
+    def _eval_facet_tree(self, tree: FilterNode, pred: str,
+                         pos: np.ndarray) -> np.ndarray:
+        if tree.op == "leaf":
+            f = tree.func
+            fvals = self.store.edge_facets(pred, pos, [f.attr]).get(
+                f.attr, [None] * len(pos))
+            want = f.args[0] if f.args else None
+            out = np.zeros(len(pos), bool)
+            for i, v in enumerate(fvals):
+                if v is None:
+                    continue
+                try:
+                    if f.name == "eq":
+                        out[i] = v == want or str(v) == str(want)
+                    elif f.name == "le":
+                        out[i] = v <= want
+                    elif f.name == "lt":
+                        out[i] = v < want
+                    elif f.name == "ge":
+                        out[i] = v >= want
+                    elif f.name == "gt":
+                        out[i] = v > want
+                except TypeError:
+                    pass
+            return out
+        if tree.op == "not":
+            return ~self._eval_facet_tree(tree.children[0], pred, pos)
+        parts = [self._eval_facet_tree(c, pred, pos) for c in tree.children]
+        out = parts[0]
+        for p in parts[1:]:
+            out = (out & p) if tree.op == "and" else (out | p)
+        return out
 
     def _leaf_set(self, f: FuncNode, universe: np.ndarray) -> np.ndarray:
         if f.name == "uid" and (f.args or not f.uids):
@@ -192,6 +247,26 @@ class Executor:
             keys.append(seg)
         return np.lexsort(tuple(keys))
 
+    def _facet_order(self, sg: SubGraph, nbrs: np.ndarray, seg: np.ndarray,
+                     pos: np.ndarray) -> np.ndarray:
+        """Row-internal ordering by facet values (@facets(orderasc: k));
+        edges without the facet sort last."""
+        keys = [np.asarray(nbrs)]
+        for o in reversed(sg.facet_orders):
+            fvals = self.store.edge_facets(sg.attr, pos, [o.attr]).get(
+                o.attr, [None] * len(pos))
+            has = np.array([v is not None for v in fvals], bool)
+            present = [_orderable(v) for v in fvals if v is not None]
+            placeholder = present[0] if present else 0
+            k = np.array([_orderable(v) if v is not None else placeholder
+                          for v in fvals])
+            if o.desc:
+                k = _negate_key(k)
+            keys.append(k)
+            keys.append(~has)
+        keys.append(seg)
+        return np.lexsort(tuple(keys))
+
     def paginate(self, arr_len: int, sg: SubGraph, ranks: np.ndarray) -> np.ndarray:
         """Row slice per first/offset/after → index array into the row."""
         idx = np.arange(arr_len)
@@ -248,12 +323,19 @@ class Executor:
 
     def run_child(self, sg: SubGraph, frontier: np.ndarray) -> LevelNode:
         """Expand one uid-predicate child level below `frontier`."""
-        nbrs, seg = self.expand(sg.attr, sg.is_reverse, frontier)
-        nbrs, seg = self.filter_edges(sg.filters, nbrs, seg)
+        nbrs, seg, pos = self.expand(sg.attr, sg.is_reverse, frontier)
+        nbrs, seg, pos = self.filter_edges(sg.filters, nbrs, seg, pos)
+        if not sg.is_reverse:
+            nbrs, seg, pos = self.facet_filter_edges(sg, sg.attr, nbrs,
+                                                     seg, pos)
         # row-internal ordering (default: uid order, which CSR already gives)
-        if sg.orders:
-            order_idx = self.order_ranks(nbrs, sg.orders, seg=seg)
+        if sg.orders or sg.facet_orders:
+            if sg.facet_orders and not sg.is_reverse:
+                order_idx = self._facet_order(sg, nbrs, seg, pos)
+            else:
+                order_idx = self.order_ranks(nbrs, sg.orders, seg=seg)
             nbrs, seg = nbrs[order_idx], seg[order_idx]
+            pos = pos[order_idx] if len(pos) else pos
         # per-row pagination (seg is nondecreasing: CSR construction order,
         # preserved by masking, and lexsort uses seg as the primary key)
         if sg.first or sg.offset or sg.after:
@@ -268,10 +350,12 @@ class Executor:
             if keep_idx:
                 keep_idx = np.sort(np.concatenate(keep_idx))
                 nbrs, seg = nbrs[keep_idx], seg[keep_idx]
+                pos = pos[keep_idx] if len(pos) else pos
         nodes = np.unique(nbrs).astype(np.int32)
         node = LevelNode(sg=sg, nodes=nodes,
                          matrix_seg=seg.astype(np.int32),
-                         matrix_child=nbrs.astype(np.int32))
+                         matrix_child=nbrs.astype(np.int32),
+                         matrix_pos=pos)
         if sg.var_name:
             self.uid_vars[sg.var_name] = nodes
         if sg.groupby:

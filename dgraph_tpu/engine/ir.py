@@ -100,6 +100,12 @@ class SubGraph:
     normalize: bool = False
     groupby: list[str] = field(default_factory=list)
 
+    # facets (reference: @facets on edges/value leaves)
+    # None = not requested; [] = all keys; else [(alias, key), ...]
+    facet_keys: Optional[list] = None
+    facet_filter: Optional[FilterNode] = None  # leaf FuncNode.attr = key
+    facet_orders: list[Order] = field(default_factory=list)
+
     # math/val computation on leaves
     math_expr: Optional[object] = None  # engine.math.MathTree
 

@@ -144,9 +144,25 @@ class _Renderer:
             if g is not None and g.groups:
                 obj[name] = [{"@groupby": self._groups_list(g)}]
             return
+        facet_cols = None
+        if child.sg.facet_keys is not None and not child.sg.is_reverse \
+                and len(child.matrix_pos):
+            keys = [k for _, k in child.sg.facet_keys] or None
+            aliases = {k: a for a, k in (child.sg.facet_keys or []) if a}
+            facet_cols = (self.store.edge_facets(
+                child.sg.attr, child.matrix_pos, keys), aliases)
         lst = []
-        for cr in rows.tolist():
+        for j, cr in enumerate(rows.tolist()):
             o = self.node_obj(child, int(cr), aliased_only)
+            if o is None:
+                continue
+            if facet_cols is not None:
+                cols, aliases = facet_cols
+                mi = int(row_idx[j])  # position into matrix arrays
+                for k, vals in cols.items():
+                    if vals[mi] is not None:
+                        fname = aliases.get(k) or f"{name}|{k}"
+                        o[fname] = _json_val(vals[mi])
             if o:
                 lst.append(o)
         lst.extend(self._row_level_entries(child, rows))

@@ -48,8 +48,8 @@ def shortest_path(ex, sg) -> PathData:
             break
         level_new: dict[int, list[tuple[int, int]]] = {}
         for i, esg in enumerate(data.edge_sgs):
-            nbrs, seg = ex.expand(esg.attr, esg.is_reverse, frontier)
-            nbrs, seg = ex.filter_edges(esg.filters, nbrs, seg)
+            nbrs, seg, pos = ex.expand(esg.attr, esg.is_reverse, frontier)
+            nbrs, seg, pos = ex.filter_edges(esg.filters, nbrs, seg, pos)
             for n, s in zip(nbrs.tolist(), seg.tolist()):
                 if n not in parents:  # unseen at earlier levels
                     level_new.setdefault(n, []).append((int(frontier[s]), i))

@@ -34,6 +34,7 @@ class NQuad:
     object_value: object = None    # scalar-valued object
     lang: str = ""
     is_star: bool = False          # object "*" (delete-all)
+    facets: dict | None = None     # (key=value, ...) edge metadata
 
 
 _NQUAD_RE = re.compile(
@@ -44,7 +45,36 @@ _NQUAD_RE = re.compile(
     r'<([^>]*)>|(_:[A-Za-z0-9._-]+)|(uid\([^)]*\))|(\*)|'       # object id/*
     r'"((?:[^"\\]|\\.)*)"'                                      # literal
     r'(?:@([A-Za-z-]+)|\^\^<([^>]*)>)?'
-    r')\s*\.\s*$')
+    r')'
+    r'(?:\s*\(([^)]*)\))?'                                      # facets
+    r'\s*\.\s*$')
+
+
+def _parse_facets(spec: str) -> dict:
+    """'since=2006-01-02, close=true, score=4' → typed facet dict
+    (reference: facets in RDF mutations, chunker/rdf facet parsing)."""
+    out: dict = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise ValueError(f"facet needs key=value, got {part!r}")
+        k, v = part.split("=", 1)
+        k, v = k.strip(), v.strip()
+        if v.startswith('"') and v.endswith('"'):
+            out[k] = v[1:-1]
+        elif v in ("true", "false"):
+            out[k] = v == "true"
+        else:
+            try:
+                out[k] = int(v)
+            except ValueError:
+                try:
+                    out[k] = float(v)
+                except ValueError:
+                    out[k] = v
+    return out
 
 
 def parse_rdf(text: str) -> list[NQuad]:
@@ -58,9 +88,11 @@ def parse_rdf(text: str) -> list[NQuad]:
         if not m:
             raise ValueError(f"bad N-Quad at line {lineno}: {line!r}")
         (s_iri, s_blank, s_var, pred, o_iri, o_blank, o_var, star,
-         lit, lang, typ) = m.groups()
+         lit, lang, typ, facet_spec) = m.groups()
         subject = s_iri or s_blank or s_var
         nq = NQuad(subject=subject, predicate=pred)
+        if facet_spec is not None:
+            nq.facets = _parse_facets(facet_spec)
         if star:
             nq.is_star = True
         elif lit is not None:

@@ -236,13 +236,16 @@ class Txn:
                 m.val_dels.append((s, nq.predicate, None, "*"))
         elif nq.object_id is not None:
             o = self._resolve(nq.object_id)
-            (m.edge_dels if delete else m.edge_sets).append(
-                (s, nq.predicate, o))
+            if delete:
+                m.edge_dels.append((s, nq.predicate, o))
+            else:
+                m.edge_sets.append((s, nq.predicate, o, nq.facets))
         else:
             if delete:
                 m.val_dels.append((s, nq.predicate, None, nq.lang))
             else:
-                m.val_sets.append((s, nq.predicate, nq.object_value, nq.lang))
+                m.val_sets.append((s, nq.predicate, nq.object_value, nq.lang,
+                                   nq.facets))
 
     # -- outcome ------------------------------------------------------------
     def commit(self) -> int:

@@ -104,8 +104,8 @@ class WorkerService:
         frontier_uids = np.array(list(req.frontier.uids), np.int64)
         ranks = store.rank_of(frontier_uids)
         known = ranks >= 0
-        nbrs, seg = ex.expand(req.attr, req.reverse,
-                              ranks[known].astype(np.int32))
+        nbrs, seg, _pos = ex.expand(req.attr, req.reverse,
+                                    ranks[known].astype(np.int32))
         rows = []
         kept_pos = np.nonzero(known)[0]
         for i in range(len(frontier_uids)):

@@ -36,16 +36,16 @@ class Mutation:
     (subject, predicate)" (reference: S P * deletion).
     """
 
-    edge_sets: list = field(default_factory=list)   # (s, pred, o)
+    edge_sets: list = field(default_factory=list)   # (s, pred, o[, facets])
     edge_dels: list = field(default_factory=list)   # (s, pred, o|None)
-    val_sets: list = field(default_factory=list)    # (s, pred, value, lang)
+    val_sets: list = field(default_factory=list)    # (s, pred, v, lang[, facets])
     val_dels: list = field(default_factory=list)    # (s, pred, None, lang)
 
     def conflict_keys(self):
         """Keys Zero arbitrates on: (pred, subject) per touched list
         (reference: posting key fingerprints sent in pb.TxnContext)."""
         keys = set()
-        for s, p, _ in self.edge_sets + self.edge_dels:
+        for s, p, *_ in self.edge_sets + self.edge_dels:
             keys.add((p, s))
         for s, p, *_ in self.val_sets + self.val_dels:
             keys.add((p, s))
@@ -183,6 +183,8 @@ def _materialize(base: Store, layers: list[_Layer],
 
     # live edges/values from base, as dicts for delete application
     edges: dict[str, set] = {}
+    efacets: dict[str, dict] = {}   # pred → {(s,o): facet dict}
+    vfacets: dict[str, dict] = {}   # pred → {s: facet dict}
     for pred, pd in base.preds.items():
         if pd.fwd is not None and pd.fwd.nnz:
             deg = pd.fwd.indptr[1:] - pd.fwd.indptr[:-1]
@@ -190,6 +192,15 @@ def _materialize(base: Store, layers: list[_Layer],
             s_uid = base.uids[src_r]
             o_uid = base.uids[pd.fwd.indices]
             edges[pred] = set(zip(s_uid.tolist(), o_uid.tolist()))
+            for key, fc in pd.efacets.items():
+                fm = efacets.setdefault(pred, {})
+                for pos, v in zip(fc.pos.tolist(), fc.vals):
+                    pair = (int(s_uid[pos]), int(o_uid[pos]))
+                    fm.setdefault(pair, {})[key] = v
+        for key, d in pd.vfacets.items():
+            fm = vfacets.setdefault(pred, {})
+            for s_rank, v in d.items():
+                fm.setdefault(int(base.uids[s_rank]), {})[key] = v
     vals: dict[tuple, dict] = {}
     for pred, pd in base.preds.items():
         for lang, col in pd.vals.items():
@@ -202,34 +213,45 @@ def _materialize(base: Store, layers: list[_Layer],
         for s, p, o in m.edge_dels:
             if o is None:
                 edges[p] = {e for e in edges.get(p, set()) if e[0] != s}
+                efacets[p] = {pair: f for pair, f in
+                              efacets.get(p, {}).items() if pair[0] != s}
             else:
                 edges.get(p, set()).discard((s, o))
-        for s, p, o in m.edge_sets:
+                efacets.get(p, {}).pop((s, o), None)
+        for s, p, o, *f in m.edge_sets:
             edges.setdefault(p, set()).add((s, o))
+            if f and f[0]:
+                efacets.setdefault(p, {})[(s, o)] = dict(f[0])
         for s, p, _v, lang in m.val_dels:
             if lang == "*":  # delete across every language column
                 for (vp, _vl), d in vals.items():
                     if vp == p:
                         d.pop(s, None)
+                vfacets.get(p, {}).pop(s, None)
             else:
                 vals.get((p, lang), {}).pop(s, None)
-        for s, p, v, lang in m.val_sets:
+        for s, p, v, lang, *f in m.val_sets:
             ps = b.schema.peek(p)
             if ps is not None and ps.is_list:
                 vals.setdefault((p, lang), {}).setdefault(s, []).append(v)
             else:
                 vals.setdefault((p, lang), {})[s] = [v]
+            if f and f[0]:
+                vfacets.setdefault(p, {})[s] = dict(f[0])
 
     for pred, es in edges.items():
+        fm = efacets.get(pred, {})
         for s, o in sorted(es):
-            b.add_edge(s, pred, o)
+            b.add_edge(s, pred, o, facets=fm.get((s, o)))
     for (pred, lang), d in vals.items():
+        fm = vfacets.get(pred, {})
         for s, vlist in sorted(d.items()):
             for v in vlist:
                 if pred == TYPE_PRED:
                     b.add_type(s, str(v))
                 else:
-                    b.add_value(s, pred, _to_py(v), lang)
+                    b.add_value(s, pred, _to_py(v), lang,
+                                facets=fm.get(s))
     return b.finalize()
 
 

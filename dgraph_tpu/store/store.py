@@ -78,6 +78,27 @@ class ValueColumn:
 
 
 @dataclass
+class FacetCol:
+    """Edge facets for one key, columnar by edge position.
+
+    Reference: facets stored per posting (pb.Posting.Facets); here a
+    sparse column aligned to `EdgeRel.indices` positions — the layout the
+    hop kernel's `edge_pos` output gathers from (ops/hop.py)."""
+
+    pos: np.ndarray   # sorted int64 positions into fwd.indices
+    vals: np.ndarray  # object array of facet values
+
+    def get(self, positions: np.ndarray) -> list:
+        """Facet values at edge positions; None where absent."""
+        idx = np.searchsorted(self.pos, positions)
+        idx_c = np.minimum(idx, max(len(self.pos) - 1, 0))
+        hit = (len(self.pos) > 0) & (self.pos[idx_c] == positions)
+        return [self.vals[i] if h else None
+                for i, h in zip(np.atleast_1d(idx_c).tolist(),
+                                np.atleast_1d(hit).tolist())]
+
+
+@dataclass
 class PredicateData:
     schema: PredicateSchema
     fwd: EdgeRel | None = None
@@ -86,6 +107,10 @@ class PredicateData:
     vals: dict[str, ValueColumn] = field(default_factory=dict)
     # tokenizer → token → sorted int32 rank array
     index: dict[str, dict[str, np.ndarray]] = field(default_factory=dict)
+    # facet key → edge-position column (forward direction)
+    efacets: dict[str, FacetCol] = field(default_factory=dict)
+    # facet key → {subject rank: value} for value postings
+    vfacets: dict[str, dict[int, object]] = field(default_factory=dict)
 
 
 class Store:
@@ -178,6 +203,32 @@ class Store:
             return np.zeros(0, np.int32)
         return np.unique(np.concatenate(parts))
 
+    # -- facets -------------------------------------------------------------
+    def edge_facets(self, pred: str, positions: np.ndarray,
+                    keys=None) -> dict[str, list]:
+        """Facet values per requested key at forward edge positions.
+        `keys=None` → every key present (reference: @facets with no args)."""
+        p = self.preds.get(pred)
+        if not p or not p.efacets:
+            return {}
+        use = p.efacets.keys() if keys is None else \
+            [k for k in keys if k in p.efacets]
+        return {k: p.efacets[k].get(np.asarray(positions, np.int64))
+                for k in use}
+
+    def value_facets(self, pred: str, rank: int, keys=None) -> dict:
+        """Facets on a value posting (reference: facets on scalar edges)."""
+        p = self.preds.get(pred)
+        if not p or not p.vfacets:
+            return {}
+        use = p.vfacets.keys() if keys is None else \
+            [k for k in keys if k in p.vfacets]
+        out = {}
+        for k in use:
+            if rank in p.vfacets[k]:
+                out[k] = p.vfacets[k][rank]
+        return out
+
     def index_lookup(self, pred: str, tokenizer: str, token: str) -> np.ndarray:
         """token → sorted rank posting list (reference: index key get)."""
         p = self.preds.get(pred)
@@ -212,8 +263,12 @@ class StoreBuilder:
         self._edges: dict[str, list[tuple[int, int]]] = {}
         self._values: dict[tuple[str, str], list[tuple[int, object]]] = {}
         self._known_uids: set[int] = set()
+        # facets keyed by the (subject, object) uid pair / subject uid
+        self._efacets: dict[str, dict[tuple[int, int], dict]] = {}
+        self._vfacets: dict[str, dict[int, dict]] = {}
 
-    def add_edge(self, subj: int, pred: str, obj: int) -> None:
+    def add_edge(self, subj: int, pred: str, obj: int,
+                 facets: dict | None = None) -> None:
         ps = self.schema.get(pred)
         if ps.kind == Kind.DEFAULT and not any(
                 p == pred for p, _ in self._values):
@@ -221,10 +276,13 @@ class StoreBuilder:
         elif ps.kind != Kind.UID:
             raise ValueError(f"predicate {pred!r} holds {ps.kind} values, not uids")
         self._edges.setdefault(pred, []).append((subj, obj))
+        if facets:
+            self._efacets.setdefault(pred, {})[(subj, obj)] = dict(facets)
         self._known_uids.add(subj)
         self._known_uids.add(obj)
 
-    def add_value(self, subj: int, pred: str, value, lang: str = "") -> None:
+    def add_value(self, subj: int, pred: str, value, lang: str = "",
+                  facets: dict | None = None) -> None:
         ps = self.schema.get(pred)
         if ps.kind == Kind.UID or pred in self._edges:
             raise ValueError(f"predicate {pred!r} is a uid predicate")
@@ -237,6 +295,8 @@ class StoreBuilder:
             elif isinstance(value, float):
                 ps.kind = Kind.FLOAT
         self._values.setdefault((pred, lang), []).append((subj, value))
+        if facets:
+            self._vfacets.setdefault(pred, {})[subj] = dict(facets)
         self._known_uids.add(subj)
 
     def add_type(self, subj: int, type_name: str) -> None:
@@ -255,6 +315,24 @@ class StoreBuilder:
             pd.fwd = _csr_from_pairs(sr[:, 0], sr[:, 1], n)
             if ps.reverse:
                 pd.rev = _csr_from_pairs(sr[:, 1], sr[:, 0], n)
+            # align edge facets to final CSR positions
+            fmap = self._efacets.get(pred)
+            if fmap:
+                by_key: dict[str, list[tuple[int, object]]] = {}
+                for (s, o), fd in fmap.items():
+                    sr_, or_ = rank[s], rank[o]
+                    row = pd.fwd.row(sr_)
+                    j = int(np.searchsorted(row, or_))
+                    if j >= len(row) or row[j] != or_:
+                        continue  # edge was not retained
+                    pos = int(pd.fwd.indptr[sr_]) + j
+                    for k, v in fd.items():
+                        by_key.setdefault(k, []).append((pos, v))
+                for k, pv in by_key.items():
+                    pv.sort()
+                    pd.efacets[k] = FacetCol(
+                        pos=np.array([p for p, _ in pv], np.int64),
+                        vals=np.array([v for _, v in pv], object))
 
         for (pred, lang), pairs in self._values.items():
             ps = self.schema.get(pred)
@@ -280,6 +358,14 @@ class StoreBuilder:
             for i, j in enumerate(order):
                 vals[i] = dpairs[j][1]
             pd.vals[lang] = ValueColumn(subj=subj, vals=vals)
+
+        for pred, vmap in self._vfacets.items():
+            pd = preds.get(pred)
+            if pd is None:
+                continue
+            for s, fd in vmap.items():
+                for k, v in fd.items():
+                    pd.vfacets.setdefault(k, {})[int(rank[s])] = v
 
         build_indexes(preds)
         return Store(uids=uids, schema=self.schema, preds=preds)
