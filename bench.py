@@ -7,16 +7,21 @@ style, BASELINE.json configs[4]), not one query at a time. The reference
 serves the mix with per-query goroutines walking posting lists
 (posting/list.go List.Uids); the CPU baseline here is the same algorithm
 vectorised per query in numpy — a stronger per-query engine than Go
-per-uid loops.
+per-uid loops — and is measured DIRECTLY over all B queries (no
+extrapolation; the measured window is multiple seconds).
 
-The TPU numerator is ops/bfs.py::bitmap_recurse: B=256 traversals packed
-into the lanes of a frontier bitmap, the whole depth-4 batch as ONE fused
-XLA program (per hop: one wide row-gather + one row-scatter over the COO
-edge list + a deg·mask MXU matvec for the edge counters). Useful-edge
+The device numerator is ops/bfs.py::bitmap_recurse: B=256 traversals
+packed into the lanes of a frontier bitmap, the whole depth-4 batch as ONE
+fused XLA program (per hop: one wide row-gather + one row-scatter over the
+COO edge list + a deg·mask MXU matvec for the edge counters). Useful-edge
 counts are identical on both sides; wall-clock is what differs.
 
-No published reference numbers exist in this environment (SURVEY §6), so
-vs_baseline is measured-TPU / measured-CPU on identical work.
+Robustness contract (the driver grades this file): all device work runs in
+a SUBPROCESS under a deadline — a wedged TPU backend (which hangs inside
+uninterruptible XLA init) cannot poison the parent. On TPU failure the
+parent re-runs the child on the XLA CPU backend so a real kernel number
+still comes out, marked platform=cpu. One parseable JSON line is printed
+in every outcome; errors ride along in an "error" field.
 
 Prints ONE JSON line:
   {"metric": ..., "value": ..., "unit": "edges/s", "vs_baseline": ...}
@@ -25,7 +30,10 @@ Prints ONE JSON line:
 from __future__ import annotations
 
 import json
+import os
+import subprocess
 import sys
+import threading
 import time
 
 import numpy as np
@@ -35,13 +43,38 @@ AVG_DEG = 16.0             # ~16M directed edges
 B = 256                    # concurrent queries (bitmap lanes)
 SEEDS_PER_QUERY = 4
 DEPTH = 4
-CPU_QUERIES = 8            # measured directly; scaled to B (independent
-                           # queries on one core scale linearly)
 DEV_REPS = 5
+
+METRIC = f"edges_traversed_per_sec_{DEPTH}hop_recurse_{B}q"
+GLOBAL_DEADLINE_S = 780    # parent ceiling: emit JSON before any external
+                           # timeout can kill us silently
+CHILD_TPU_S = 420          # graph rebuild + init + transfer + compile + reps
+CHILD_CPU_S = 300
+
+_emitted = threading.Event()
 
 
 def log(*a):
     print(*a, file=sys.stderr, flush=True)
+
+
+def emit(obj) -> None:
+    """Print the single graded JSON line exactly once, then hard-exit is
+    the caller's job (abandoned XLA threads may hold locks)."""
+    if _emitted.is_set():
+        return
+    _emitted.set()
+    print(json.dumps(obj), flush=True)
+
+
+def build_workload():
+    from dgraph_tpu.models.synthetic import powerlaw_rel
+
+    rel = powerlaw_rel(N_NODES, AVG_DEG, seed=42)
+    rng = np.random.default_rng(7)
+    seed_lists = [rng.integers(0, N_NODES, SEEDS_PER_QUERY)
+                  for _ in range(B)]
+    return rel, seed_lists
 
 
 def cpu_recurse(indptr, indices, seeds, depth):
@@ -68,31 +101,28 @@ def cpu_recurse(indptr, indices, seeds, depth):
     return edges
 
 
-def main():
+# ---------------------------------------------------------------------------
+# child: one device measurement on the requested platform
+
+def child_main(platform: str) -> None:
+    if platform == "cpu":
+        os.environ["JAX_PLATFORMS"] = "cpu"
+
     import jax
 
-    from dgraph_tpu.models.synthetic import powerlaw_rel
+    if platform == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+
+    t0 = time.perf_counter()
+    plat = jax.devices()[0].platform
+    log(f"child backend: {plat} ({time.perf_counter() - t0:.1f}s)")
+
+    rel, seed_lists = build_workload()
+    cpu_edges = [cpu_recurse(rel.indptr, rel.indices, s, DEPTH)
+                 for s in seed_lists]
+
     from dgraph_tpu.ops.bfs import bitmap_recurse, ranks_to_bitmap
 
-    log(f"graph: {N_NODES} nodes, avg_deg {AVG_DEG} ...")
-    rel = powerlaw_rel(N_NODES, AVG_DEG, seed=42)
-    log(f"graph: {rel.nnz} edges; workload: {B} queries x depth-{DEPTH} "
-        f"recurse, {SEEDS_PER_QUERY} seeds each")
-
-    rng = np.random.default_rng(7)
-    seed_lists = [rng.integers(0, N_NODES, SEEDS_PER_QUERY)
-                  for _ in range(B)]
-
-    # -- CPU baseline (per-query walks, as the reference's goroutines) ------
-    t0 = time.perf_counter()
-    cpu_edges = [cpu_recurse(rel.indptr, rel.indices, seed_lists[q], DEPTH)
-                 for q in range(CPU_QUERIES)]
-    cpu_t = time.perf_counter() - t0
-    cpu_s = cpu_t * (B / CPU_QUERIES)       # independent queries: linear
-    log(f"cpu: {CPU_QUERIES} queries in {cpu_t:.2f}s -> {B} queries "
-        f"~{cpu_s:.1f}s (linear scale)")
-
-    # -- TPU batched kernel -------------------------------------------------
     deg = (rel.indptr[1:] - rel.indptr[:-1]).astype(np.int32)
     src = np.repeat(np.arange(N_NODES, dtype=np.int32), deg)
     mask0 = ranks_to_bitmap(seed_lists, N_NODES)
@@ -102,44 +132,125 @@ def main():
     dst_d = jax.device_put(rel.indices)
     deg_d = jax.device_put(deg)
     mask_d = jax.device_put(mask0)
-    log(f"device transfer: {time.perf_counter() - t0:.1f}s "
-        f"({jax.devices()[0].platform})")
+    jax.block_until_ready((src_d, dst_d, deg_d, mask_d))
+    log(f"child device_put: {time.perf_counter() - t0:.1f}s")
 
     def run():
-        return bitmap_recurse(src_d, dst_d, deg_d, mask_d, depth=DEPTH)
+        _l, _s, edges = bitmap_recurse(src_d, dst_d, deg_d, mask_d,
+                                       depth=DEPTH)
+        return np.asarray(edges)  # forces full sync
 
     t0 = time.perf_counter()
-    last, seen, edges_d = run()
-    edges_dev = np.asarray(edges_d)          # forces full sync
-    log(f"compile+first run: {time.perf_counter() - t0:.1f}s")
+    edges_dev = run()
+    log(f"child compile+first run: {time.perf_counter() - t0:.1f}s")
 
-    # identical work check: kernel's per-query counts vs the CPU walks
-    for q in range(CPU_QUERIES):
+    # identical-work check: kernel per-query counts vs the CPU walks
+    for q in range(B):
         assert int(edges_dev[q]) == cpu_edges[q], (
             q, int(edges_dev[q]), cpu_edges[q])
     total_edges = int(edges_dev.astype(np.int64).sum())
 
+    reps = DEV_REPS if plat != "cpu" else 2
     ts = []
-    for _ in range(DEV_REPS):
+    for _ in range(reps):
         t0 = time.perf_counter()
-        _l, _s, e = run()
-        np.asarray(e)                        # sync (scalar-ish transfer)
+        run()
         ts.append(time.perf_counter() - t0)
     dev_s = min(ts)
+    log(f"child {plat}: {total_edges} edges in {dev_s * 1e3:.0f}ms")
+    print(json.dumps({"platform": plat, "total_edges": total_edges,
+                      "dev_s": dev_s}), flush=True)
+    os._exit(0)
 
-    cpu_eps = total_edges / cpu_s if cpu_s else 0.0
-    dev_eps = total_edges / dev_s
-    log(f"tpu: {total_edges} edges across {B} queries in "
-        f"{dev_s * 1e3:.0f}ms = {dev_eps:,.0f} edges/s "
-        f"(cpu {cpu_eps:,.0f})")
 
-    print(json.dumps({
-        "metric": f"edges_traversed_per_sec_{DEPTH}hop_recurse_{B}q",
+def run_child(platform: str, timeout_s: float) -> dict:
+    """Run one device measurement out-of-process. Raises on any failure."""
+    t0 = time.perf_counter()
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--child", platform],
+        capture_output=True, text=True, timeout=timeout_s,
+        cwd=os.path.dirname(os.path.abspath(__file__)))
+    for line in proc.stderr.splitlines()[-6:]:
+        log(f"  [{platform}] {line}")
+    if proc.returncode != 0:
+        tail = proc.stderr.strip().splitlines()[-1] if proc.stderr else "?"
+        raise RuntimeError(
+            f"child({platform}) rc={proc.returncode}: {tail}")
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    log(f"child({platform}) done in {time.perf_counter() - t0:.1f}s")
+    return out
+
+
+def main() -> None:
+    def last_resort():
+        emit({"metric": METRIC, "value": 0, "unit": "edges/s",
+              "vs_baseline": 0.0,
+              "error": f"global deadline {GLOBAL_DEADLINE_S}s hit"})
+        sys.stdout.flush()
+        os._exit(3)
+
+    watchdog = threading.Timer(GLOBAL_DEADLINE_S, last_resort)
+    watchdog.daemon = True
+    watchdog.start()
+
+    log(f"graph: {N_NODES} nodes, avg_deg {AVG_DEG} ...")
+    rel, seed_lists = build_workload()
+    log(f"graph: {rel.nnz} edges; workload: {B} queries x depth-{DEPTH} "
+        f"recurse, {SEEDS_PER_QUERY} seeds each")
+
+    # -- CPU baseline: ALL B queries measured directly (no extrapolation) ---
+    t0 = time.perf_counter()
+    cpu_edges = [cpu_recurse(rel.indptr, rel.indices, s, DEPTH)
+                 for s in seed_lists]
+    cpu_s = time.perf_counter() - t0
+    total_edges = int(sum(cpu_edges))
+    cpu_eps = total_edges / cpu_s
+    log(f"cpu baseline: {B} queries, {total_edges} edges in {cpu_s:.2f}s "
+        f"= {cpu_eps:,.0f} edges/s")
+
+    # -- device measurement, subprocess-isolated ----------------------------
+    err = None
+    res = None
+    try:
+        res = run_child("default", CHILD_TPU_S)
+    except Exception as e:  # noqa: BLE001 — fall back, report
+        err = f"tpu child failed: {type(e).__name__}: {e}"
+        log(err)
+        try:
+            res = run_child("cpu", CHILD_CPU_S)
+        except Exception as e2:  # noqa: BLE001
+            emit({"metric": METRIC, "value": 0, "unit": "edges/s",
+                  "vs_baseline": 0.0,
+                  "error": f"{err}; cpu fallback failed: {e2}",
+                  "cpu_edges_per_sec": round(cpu_eps)})
+            os._exit(2)
+
+    assert res["total_edges"] == total_edges, (res["total_edges"],
+                                               total_edges)
+    dev_eps = total_edges / res["dev_s"]
+    log(f"{res['platform']}: {total_edges} edges in "
+        f"{res['dev_s'] * 1e3:.0f}ms = {dev_eps:,.0f} edges/s "
+        f"(cpu baseline {cpu_eps:,.0f})")
+
+    out = {
+        "metric": METRIC,
         "value": round(dev_eps),
         "unit": "edges/s",
-        "vs_baseline": round(dev_eps / cpu_eps, 2) if cpu_eps else 0.0,
-    }))
+        "vs_baseline": round(dev_eps / cpu_eps, 2),
+        "platform": res["platform"],
+        "cpu_edges_per_sec": round(cpu_eps),
+    }
+    if err:
+        out["error"] = f"measured on XLA cpu backend; {err}"
+    emit(out)
+    watchdog.cancel()
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os._exit(0)
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) >= 3 and sys.argv[1] == "--child":
+        child_main(sys.argv[2])
+    else:
+        main()
