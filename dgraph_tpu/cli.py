@@ -21,7 +21,6 @@ def cmd_alpha(args) -> int:
     from dgraph_tpu.server.api import Alpha
     from dgraph_tpu.server.http import make_http_server, serve_background
     from dgraph_tpu.server.task import make_server
-    from dgraph_tpu.store import checkpoint
 
     cfg = load_config(AlphaConfig, args.config, {
         "p_dir": args.p, "http_port": args.http_port,
@@ -29,12 +28,10 @@ def cmd_alpha(args) -> int:
     xlog.setup(cfg.log_level)
     log = xlog.get("alpha")
 
-    base = None
-    import os
-    if os.path.exists(os.path.join(cfg.p_dir, "manifest.json")):
-        base, base_ts = checkpoint.load(cfg.p_dir)
-        log.info("loaded snapshot: %d nodes from %s", base.n_nodes, cfg.p_dir)
-    alpha = Alpha(base=base, device_threshold=cfg.device_threshold)
+    # checkpoint + WAL replay boot: every commit that reached disk before
+    # a crash is recovered (reference: badger open + raft WAL restore)
+    alpha = Alpha.open(cfg.p_dir, device_threshold=cfg.device_threshold)
+    log.info("opened %s: %d nodes", cfg.p_dir, alpha.mvcc.base.n_nodes)
 
     grpc_server, grpc_port = make_server(
         alpha, f"{cfg.http_addr}:{cfg.grpc_port}")
@@ -47,8 +44,7 @@ def cmd_alpha(args) -> int:
         grpc_server.wait_for_termination()
     except KeyboardInterrupt:
         log.info("shutting down; checkpointing to %s", cfg.p_dir)
-        checkpoint.save(alpha.mvcc.rollup(), cfg.p_dir,
-                        base_ts=alpha.mvcc.base_ts)
+        alpha.checkpoint_to(cfg.p_dir)
     return 0
 
 

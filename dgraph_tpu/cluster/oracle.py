@@ -19,12 +19,21 @@ Transaction model (snapshot isolation, first-committer-wins):
 
 from __future__ import annotations
 
+import hashlib
 import threading
 from dataclasses import dataclass, field
 
 
 class TxnAborted(Exception):
     """Raised on commit conflict (reference: pb.TxnContext.Aborted)."""
+
+
+def fingerprint(key) -> str:
+    """Deterministic cross-process conflict-key fingerprint. Python's
+    hash() is salted per process, which both risks collisions and makes
+    keys unshareable between nodes; sha1 hex is stable and collision-free
+    for distinct keys (reference: farm fingerprints on posting keys)."""
+    return hashlib.sha1(str(key).encode()).hexdigest()
 
 
 @dataclass
@@ -41,8 +50,8 @@ class Oracle:
         self._next_ts = first_ts
         self._next_uid = first_uid
         self._pending: dict[int, TxnStatus] = {}
-        # conflict key → commit_ts of the last txn that wrote it
-        self._commits: dict[int, int] = {}
+        # sha1 fingerprint of conflict key → commit_ts of the last writer
+        self._commits: dict[str, int] = {}
         self._max_assigned = first_ts - 1
 
     # -- timestamps ---------------------------------------------------------
@@ -103,6 +112,13 @@ class Oracle:
             self._next_uid += n
             return range(lo, lo + n)
 
+    def bump_ts(self, ts: int) -> None:
+        """Ensure future timestamps start above a replayed commit_ts
+        (reference: oracle restore from raft snapshot + WAL)."""
+        with self._lock:
+            self._next_ts = max(self._next_ts, ts + 1)
+            self._max_assigned = max(self._max_assigned, ts)
+
     def bump_uid(self, uid: int) -> None:
         """Ensure future leases start above an externally-loaded uid
         (reference: bulk-load → zero lease handoff)."""
@@ -117,7 +133,7 @@ class Oracle:
             st = self._pending.get(start_ts)
             if st is None or st.commit_ts != 0:
                 raise TxnAborted(f"txn {start_ts} is not pending")
-            keys = {hash(k) for k in conflict_keys}
+            keys = {fingerprint(k) for k in conflict_keys}
             for k in keys:
                 if self._commits.get(k, 0) > start_ts:
                     st.commit_ts = -1

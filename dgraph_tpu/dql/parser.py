@@ -339,13 +339,19 @@ class Parser:
     def _parse_facets_args(self, sg: SubGraph) -> None:
         """@facets | @facets(k1, a: k2) | @facets(eq(k, v) ...) |
         @facets(orderasc: k). Multiple @facets directives accumulate
-        (reference: one for keys, one for filters, one for order)."""
-        if sg.facet_keys is None:
-            sg.facet_keys = []
+        (reference: one for keys, one for filters, one for order). Only the
+        bare/key forms request facet OUTPUT (facet_keys); the filter and
+        order forms alone do not."""
+        def want_output():
+            if sg.facet_keys is None:
+                sg.facet_keys = []
+
         if not self.accept("("):
+            want_output()
             return  # bare @facets → all keys
         if self.peek().text == ")":
             self.next()
+            want_output()
             return
         # filter form: a function name followed by "("
         if self.peek(1).text == "(" and self.peek().text.lower() in (
@@ -361,8 +367,10 @@ class Parser:
                 sg.facet_orders.append(Order(
                     attr=self.name(), desc=(name == "orderdesc")))
             elif self.accept(":"):
+                want_output()
                 sg.facet_keys.append((name, self.name()))  # alias: key
             else:
+                want_output()
                 sg.facet_keys.append(("", name))
             if not self.accept(","):
                 break

@@ -158,11 +158,12 @@ class Executor:
             f = tree.func
             fvals = self.store.edge_facets(pred, pos, [f.attr]).get(
                 f.attr, [None] * len(pos))
-            want = f.args[0] if f.args else None
+            want0 = f.args[0] if f.args else None
             out = np.zeros(len(pos), bool)
             for i, v in enumerate(fvals):
                 if v is None:
                     continue
+                want = _coerce_to(want0, v)
                 try:
                     if f.name == "eq":
                         out[i] = v == want or str(v) == str(want)
@@ -425,6 +426,23 @@ class Executor:
                 if vs:
                     env[int(r)] = vs[0]
             self.val_vars[sg.var_name] = env
+
+
+def _coerce_to(want, v):
+    """Coerce a parsed (string) comparison arg to the facet value's type
+    (reference: facets are typed per-posting; filter args convert to them)."""
+    if not isinstance(want, str):
+        return want
+    try:
+        if isinstance(v, (bool, np.bool_)):
+            return want.strip().lower() in ("true", "1")
+        if isinstance(v, (int, np.integer)):
+            return int(want)
+        if isinstance(v, (float, np.floating)):
+            return float(want)
+    except ValueError:
+        pass
+    return want
 
 
 def _orderable(v):

@@ -135,7 +135,7 @@ class _Renderer:
 
     def _render_edge(self, child: LevelNode, parent: LevelNode, rank: int,
                      obj: dict, aliased_only: bool = False) -> None:
-        rows = self._rows(child, parent, rank)
+        rows, row_idx = self._rows(child, parent, rank)
         name = child.sg.alias or (
             f"~{child.sg.attr}" if child.sg.is_reverse else child.sg.attr)
         if child.groups is not None:
@@ -186,8 +186,9 @@ class _Renderer:
                 entries.append({leaf.alias or "count": int(len(np.unique(rows)))})
         return entries
 
-    def _rows(self, child: LevelNode, parent: LevelNode, rank: int) -> np.ndarray:
-        """Matrix row of `rank`: child ranks in row order."""
+    def _rows(self, child: LevelNode, parent: LevelNode, rank: int):
+        """Matrix row of `rank`: (child ranks in row order, their indices
+        into the matrix arrays — matrix_pos/facet columns align to these)."""
         m = self._row_maps.get(id(child))
         if m is None:
             m = {}
@@ -198,10 +199,11 @@ class _Renderer:
             ends = np.searchsorted(sseg, np.arange(len(parent.nodes)), "right")
             for pos in range(len(parent.nodes)):
                 if ends[pos] > starts[pos]:
-                    m[pos] = child.matrix_child[order[starts[pos]:ends[pos]]]
+                    idx = order[starts[pos]:ends[pos]]
+                    m[pos] = (child.matrix_child[idx], idx)
             self._row_maps[id(child)] = m
         pos = int(np.searchsorted(parent.nodes, rank))
-        return m.get(pos, np.zeros(0, np.int32))
+        return m.get(pos, (np.zeros(0, np.int32), np.zeros(0, np.int64)))
 
     # -- recurse ------------------------------------------------------------
     def _render_recurse_children(self, data, rank: int, obj: dict,

@@ -39,11 +39,13 @@ class Alpha:
     """Single-process data server: oracle + MVCC store + query engine."""
 
     def __init__(self, base: Store | None = None,
-                 device_threshold: int = 512):
+                 device_threshold: int = 512, wal=None, base_ts: int = 0):
         self.oracle = Oracle()
-        self.mvcc = MVCCStore(base=base)
+        self.mvcc = MVCCStore(base=base, base_ts=base_ts)
+        self.oracle.bump_ts(base_ts)
         self.xidmap = XidMap(self.oracle)
         self.device_threshold = device_threshold
+        self.wal = wal  # store.wal.WAL | None: fsync'd commit log
         self._apply_lock = threading.Lock()
         self._state_lock = threading.Lock()
         self._open_txns: dict[int, Txn] = {}
@@ -51,6 +53,61 @@ class Alpha:
         self._gc_tick = 0
         if base is not None and base.n_nodes:
             self.oracle.bump_uid(int(base.uids[-1]))
+
+    @classmethod
+    def open(cls, p_dir: str, device_threshold: int = 512,
+             sync: bool = True) -> "Alpha":
+        """Boot from a persistence dir: newest checkpoint + WAL replay
+        (reference: Badger open + raft WAL restore on alpha start). Every
+        commit that reached the WAL before a crash is recovered."""
+        import os
+
+        from dgraph_tpu.store import checkpoint
+        from dgraph_tpu.store.schema import parse_schema
+        from dgraph_tpu.store.wal import WAL, replay
+
+        base, base_ts = None, 0
+        if os.path.exists(os.path.join(p_dir, "manifest.json")):
+            base, base_ts = checkpoint.load(p_dir)
+        wal_path = os.path.join(p_dir, "wal.log")
+        alpha = cls(base=base, device_threshold=device_threshold,
+                    base_ts=base_ts)
+        max_ts, max_uid = base_ts, 0
+        for ts, kind, obj in replay(wal_path):
+            if ts <= base_ts:
+                continue  # checkpoint already absorbed it
+            if kind == "schema":
+                merged = alpha.mvcc.schema.clone()
+                merged.update(parse_schema(obj))
+                alpha.mvcc.rebuild_base(schema=merged)
+            elif kind == "drop":
+                alpha.mvcc = MVCCStore()
+                alpha.xidmap = XidMap(alpha.oracle)
+            else:
+                alpha.mvcc.apply(obj, ts)
+                for s, _p, o, *_ in obj.edge_sets:
+                    max_uid = max(max_uid, s, o)
+                for s, _p, *_ in (obj.edge_dels + obj.val_sets
+                                  + obj.val_dels):
+                    max_uid = max(max_uid, s)
+            max_ts = max(max_ts, ts)
+        alpha.oracle.bump_ts(max_ts)
+        if max_uid:
+            alpha.oracle.bump_uid(max_uid)
+        alpha.wal = WAL(wal_path, sync=sync)
+        return alpha
+
+    def checkpoint_to(self, p_dir: str) -> int:
+        """Fold all committed state into an on-disk checkpoint and drop the
+        WAL records it absorbed. Returns the checkpoint base_ts."""
+        from dgraph_tpu.store import checkpoint
+        with self._apply_lock:
+            store = self.mvcc.rollup()
+            ts = self.mvcc.base_ts
+            checkpoint.save(store, p_dir, base_ts=ts)
+            if self.wal is not None:
+                self.wal.truncate(ts)
+        return ts
 
     # -- public api surface (api.Dgraph analog) -----------------------------
     def new_txn(self) -> "Txn":
@@ -68,12 +125,17 @@ class Alpha:
         return t
 
     @contextlib.contextmanager
-    def _reading(self, ts: int):
-        """Track in-flight reads so gc never drops a snapshot under them."""
+    def _reading(self, ts: int | None = None):
+        """Track in-flight reads so gc never drops a snapshot under them.
+        With ts=None a fresh read-only ts is issued INSIDE the state lock —
+        registration is atomic with issuance, so a concurrent gc sweep can
+        never miss a ts that exists but isn't registered yet."""
         with self._state_lock:
+            if ts is None:
+                ts = self.oracle.read_only_ts()
             self._active_reads[ts] = self._active_reads.get(ts, 0) + 1
         try:
-            yield
+            yield ts
         finally:
             with self._state_lock:
                 self._active_reads[ts] -= 1
@@ -84,8 +146,7 @@ class Alpha:
               read_ts: int | None = None) -> dict:
         """Read-only query at a snapshot (reference: Server.Query with
         best-effort/read-only txn)."""
-        ts = self.oracle.read_only_ts() if read_ts is None else read_ts
-        with self._reading(ts):
+        with self._reading(read_ts) as ts:
             store = self.mvcc.read_view(ts)
             out = Engine(store, device_threshold=self.device_threshold
                          ).query(dql, variables)
@@ -100,6 +161,7 @@ class Alpha:
         """Mutation RPC. With start_ts: continue that open txn. With
         commit_now=False: leave the txn open and return its start_ts
         (reference: Server.Mutate + CommitNow flag)."""
+        created = not start_ts
         txn = self.txn(start_ts) if start_ts else self.new_txn()
         try:
             uids = txn.mutate(set_nquads=set_nquads, del_nquads=del_nquads,
@@ -113,7 +175,10 @@ class Alpha:
             txn.discard()
             raise
         except Exception:
-            if commit_now:
+            # a newly-created txn whose start_ts never reached the client
+            # can never be discarded by them — it would pin the gc
+            # watermark forever; only a continued txn survives an error
+            if commit_now or created:
                 txn.discard()
             raise
 
@@ -134,11 +199,16 @@ class Alpha:
         with self._apply_lock:
             merged = self.mvcc.schema.clone()
             merged.update(new)
+            if self.wal is not None:
+                self.wal.append_schema(schema_text,
+                                       self.oracle.read_only_ts())
             self.mvcc.rebuild_base(schema=merged)
 
     def drop_all(self) -> None:
         """reference: api.Operation{DropAll}."""
         with self._apply_lock:
+            if self.wal is not None:
+                self.wal.append_drop(self.oracle.read_only_ts())
             self.mvcc = MVCCStore()
             self.xidmap = XidMap(self.oracle)
             with self._state_lock:
@@ -148,7 +218,12 @@ class Alpha:
     def _commit(self, txn: "Txn") -> int:
         with self._apply_lock:
             commit_ts = self.oracle.commit(
-                txn.start_ts, txn.mutation.conflict_keys())
+                txn.start_ts, txn.mutation.conflict_keys(self.mvcc.schema))
+            # write-ahead: on disk before the in-memory apply, so a crash
+            # between the two replays the record (reference: raft entry
+            # fsync before posting-list apply)
+            if self.wal is not None:
+                self.wal.append(txn.mutation, commit_ts)
             self.mvcc.apply(txn.mutation, commit_ts)
             return commit_ts
 

@@ -88,7 +88,13 @@ class WorkerService:
         self.alpha = alpha
 
     def ServeTask(self, req: pb.TaskQuery, ctx) -> pb.TaskResult:
-        ts = req.read_ts or self.alpha.oracle.read_ts()
+        # one-shot read: read_only_ts never registers a pending txn (a
+        # leaked read_ts would pin the oracle gc watermark forever), and
+        # _reading keeps gc from dropping the snapshot mid-task
+        with self.alpha._reading(int(req.read_ts) or None) as ts:
+            return self._serve(req, ts)
+
+    def _serve(self, req: pb.TaskQuery, ts: int) -> pb.TaskResult:
         store = self.alpha.mvcc.read_view(ts)
         ex = Executor(store,
                       device_threshold=self.alpha.device_threshold)

@@ -25,9 +25,13 @@ import numpy as np
 
 from dgraph_tpu.store.schema import parse_schema
 from dgraph_tpu.store.store import (
-    EdgeRel, PredicateData, Store, ValueColumn, build_indexes)
+    EdgeRel, FacetCol, PredicateData, Store, ValueColumn, build_indexes)
+# facet scalars use the WAL's codec so both durability paths (checkpoint
+# vs WAL replay) recover identical types
+from dgraph_tpu.store.wal import dec_scalar, enc_scalar
 
-FORMAT_VERSION = 1
+FORMAT_VERSION = 2  # v2: facet persistence (<slug>.facets.json)
+MIN_FORMAT_VERSION = 1  # v1 checkpoints load (they predate facet storage)
 
 
 def _slug(pred: str) -> str:
@@ -72,6 +76,20 @@ def save(store: Store, dirname: str, base_ts: int = 0,
                 vals = np.array([str(v) for v in vals], dtype=np.str_)
             np.save(os.path.join(dirname, f"{slug}.val.{lslug}.vals.npy"),
                     vals)
+        if pd.efacets or pd.vfacets:
+            # facets ride in a JSON sidecar (they are sparse; the reference
+            # persists them inside each posting — same durability contract)
+            fdoc = {
+                "efacets": {k: {"pos": col.pos.tolist(),
+                                "vals": [enc_scalar(v) for v in col.vals]}
+                            for k, col in pd.efacets.items()},
+                "vfacets": {k: {str(r): enc_scalar(v)
+                                for r, v in m.items()}
+                            for k, m in pd.vfacets.items()},
+            }
+            with open(os.path.join(dirname, f"{slug}.facets.json"), "w") as f:
+                json.dump(fdoc, f)
+            meta["facets"] = True
         preds_meta[pred] = meta
     manifest = {
         "format_version": FORMAT_VERSION,
@@ -91,10 +109,11 @@ def load(dirname: str) -> tuple[Store, int]:
     """Load (store, base_ts). Reference: restore / bulk-load handoff."""
     with open(os.path.join(dirname, "manifest.json")) as f:
         manifest = json.load(f)
-    if manifest["format_version"] != FORMAT_VERSION:
+    if not (MIN_FORMAT_VERSION <= manifest["format_version"]
+            <= FORMAT_VERSION):
         raise ValueError(
-            f"checkpoint format {manifest['format_version']} != "
-            f"{FORMAT_VERSION}")
+            f"checkpoint format {manifest['format_version']} not in "
+            f"[{MIN_FORMAT_VERSION}, {FORMAT_VERSION}]")
     if manifest.get("uids_codec"):
         from dgraph_tpu import native
         with open(os.path.join(dirname, "uids.duc"), "rb") as f:
@@ -124,6 +143,17 @@ def load(dirname: str) -> tuple[Store, int]:
                 subj=np.load(
                     os.path.join(dirname, f"{slug}.val.{lslug}.subj.npy")),
                 vals=vals)
+        if meta.get("facets"):
+            with open(os.path.join(dirname, f"{slug}.facets.json")) as f:
+                fdoc = json.load(f)
+            for k, col in fdoc.get("efacets", {}).items():
+                vals = np.empty(len(col["vals"]), dtype=object)
+                vals[:] = [dec_scalar(v) for v in col["vals"]]
+                pd.efacets[k] = FacetCol(
+                    pos=np.array(col["pos"], np.int64), vals=vals)
+            for k, m in fdoc.get("vfacets", {}).items():
+                pd.vfacets[k] = {int(r): dec_scalar(v)
+                                 for r, v in m.items()}
         preds[pred] = pd
     build_indexes(preds)
     return Store(uids=uids, schema=schema, preds=preds), manifest["base_ts"]

@@ -41,14 +41,29 @@ class Mutation:
     val_sets: list = field(default_factory=list)    # (s, pred, v, lang[, facets])
     val_dels: list = field(default_factory=list)    # (s, pred, None, lang)
 
-    def conflict_keys(self):
-        """Keys Zero arbitrates on: (pred, subject) per touched list
-        (reference: posting key fingerprints sent in pb.TxnContext)."""
+    def conflict_keys(self, schema=None):
+        """Keys Zero arbitrates on, as deterministic serialized strings
+        (reference: posting key fingerprints sent in pb.TxnContext —
+        posting.addConflictKeys): "<pred>|<subj>" per touched list, plus
+        "<pred>|tok|<tokenizer>:<token>" per index token of values written
+        to @upsert predicates, so two txns upserting the same value collide
+        even under different subjects. Strings (not Python hash()) so keys
+        are stable across processes — the multi-node oracle ships them over
+        the wire."""
         keys = set()
         for s, p, *_ in self.edge_sets + self.edge_dels:
-            keys.add((p, s))
+            keys.add(f"{p}|{s}")
         for s, p, *_ in self.val_sets + self.val_dels:
-            keys.add((p, s))
+            keys.add(f"{p}|{s}")
+        if schema is not None:
+            from dgraph_tpu.store.tok import tokens_for
+            for s, p, v, *_rest in self.val_sets:
+                ps = schema.peek(p)
+                if not ps or not ps.upsert or v is None:
+                    continue
+                for t in ps.index_tokenizers:
+                    for token in tokens_for(t, v):
+                        keys.add(f"{p}|tok|{t}:{token}")
         return keys
 
     def is_empty(self) -> bool:

@@ -58,6 +58,29 @@ def test_checkpoint_roundtrip(tmp_path):
     assert "exact" in loaded.preds["name"].index
 
 
+def test_checkpoint_persists_facets(tmp_path):
+    """Edge and value facets survive save/load (reference: facets live
+    inside each posting, so backups carry them; round-1 advisor finding)."""
+    a = Alpha(device_threshold=10**9)
+    a.alter(SCHEMA)
+    a.mutate(set_nquads="""
+      _:a <name> "alice" (origin="fr") .
+      _:b <name> "bob" .
+      _:a <friend> _:b (since=2004, close=true) .
+    """)
+    store = a.mvcc.rollup()
+    assert store.preds["friend"].efacets, "fixture must produce edge facets"
+    checkpoint.save(store, str(tmp_path / "p"))
+    loaded, _ = checkpoint.load(str(tmp_path / "p"))
+    q = ('{ q(func: eq(name, "alice")) '
+         '{ name @facets friend @facets { name } } }')
+    want = Alpha(base=store).query(q)
+    got = Alpha(base=loaded).query(q)
+    assert got == want
+    assert got["q"][0]["friend"][0]["friend|since"] == 2004
+    assert got["q"][0]["friend"][0]["friend|close"] is True
+
+
 def test_bulk_load_and_boot(tmp_path):
     st = run_bulk(RDF, str(tmp_path / "p"), schema_text=SCHEMA, n_mappers=2)
     assert st.nquads == 7 and st.edges == 2

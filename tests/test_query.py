@@ -35,6 +35,12 @@ PEOPLE = {
     6: ("Bear", 12, 1.10, False, "2010-03-03"),
 }
 FRIENDS = [(1, 2), (1, 3), (1, 4), (2, 3), (3, 4), (4, 5), (5, 6)]
+# edge facets on friend (reference: facets stored per posting)
+FRIEND_FACETS = {
+    (1, 2): {"since": 2004, "close": True},
+    (1, 3): {"since": 2010, "close": False},
+    (1, 4): {"since": 1999},
+}
 FILMS = {100: "The Wire", 101: "Blade Runner", 102: "Blade Trinity"}
 STARRING = [(100, 1), (100, 2), (101, 3), (101, 1), (102, 3)]
 GENRES = {200: "Drama", 201: "SciFi"}
@@ -53,7 +59,7 @@ def build_store():
     b.add_value(1, "name", "Michonne-fr", lang="fr")
     b.add_value(2, "nickname", "The King")
     for s, o in FRIENDS:
-        b.add_edge(s, "friend", o)
+        b.add_edge(s, "friend", o, facets=FRIEND_FACETS.get((s, o)))
     b.add_edge(2, "boss", 1)
     b.add_edge(3, "boss", 1)
     for uid, name in FILMS.items():
@@ -293,6 +299,41 @@ CASES = [
     ("bool_filter", """
      { dead(func: type(Person)) @filter(eq(alive, false)) { name } }""",
      {"dead": [{"name": "King Lear"}, {"name": "Bear"}]}),
+
+    # edge facets (reference: query/query_test.go facet tables; rendered as
+    # "<edge>|<key>" on the child object)
+    ("facets_bare", """
+     { me(func: uid(1)) { friend @facets { name } } }""",
+     {"me": [{"friend": [
+         {"name": "King Lear", "friend|close": True, "friend|since": 2004},
+         {"name": "Margaret", "friend|close": False, "friend|since": 2010},
+         {"name": "Leonard", "friend|since": 1999}]}]}),
+
+    ("facets_keyed", """
+     { me(func: uid(1)) { friend @facets(since) { name } } }""",
+     {"me": [{"friend": [
+         {"name": "King Lear", "friend|since": 2004},
+         {"name": "Margaret", "friend|since": 2010},
+         {"name": "Leonard", "friend|since": 1999}]}]}),
+
+    ("facets_alias", """
+     { me(func: uid(1)) { friend @facets(met: since) { name } } }""",
+     {"me": [{"friend": [
+         {"name": "King Lear", "met": 2004},
+         {"name": "Margaret", "met": 2010},
+         {"name": "Leonard", "met": 1999}]}]}),
+
+    ("facets_filter", """
+     { me(func: uid(1)) { friend @facets(eq(close, true)) { name } } }""",
+     {"me": [{"friend": [{"name": "King Lear"}]}]}),
+
+    ("facets_order", """
+     { me(func: uid(1)) { friend @facets(orderasc: since) @facets(since)
+       { name } } }""",
+     {"me": [{"friend": [
+         {"name": "Leonard", "friend|since": 1999},
+         {"name": "King Lear", "friend|since": 2004},
+         {"name": "Margaret", "friend|since": 2010}]}]}),
 ]
 
 

@@ -180,3 +180,81 @@ def test_bank_transfer_invariant():
     total = sum(r["balance"] for r in out["q"])
     assert total == n_acct * per, f"money leaked: {total}"
     assert committed[0] > 0, "no transfer ever committed"
+
+
+# ---- conflict-key determinism & upsert index conflicts (round-2) -----------
+
+def test_conflict_keys_deterministic_across_processes():
+    """Keys must hash identically in another interpreter (Python hash() is
+    per-process salted; a multi-node oracle ships fingerprints on the wire)."""
+    import subprocess
+    import sys
+
+    prog = (
+        "from dgraph_tpu.store.mvcc import Mutation\n"
+        "from dgraph_tpu.cluster.oracle import fingerprint\n"
+        "m = Mutation(edge_sets=[(1, 'friend', 2, None)],\n"
+        "             val_sets=[(3, 'name', 'alice', '', None)])\n"
+        "print(sorted(fingerprint(k) for k in m.conflict_keys()))\n")
+    outs = set()
+    for _ in range(2):
+        r = subprocess.run([sys.executable, "-c", prog], cwd="/root/repo",
+                           capture_output=True, text=True,
+                           env={"PYTHONHASHSEED": "random", "PATH": "/usr/bin:/bin",
+                                "HOME": "/root"})
+        assert r.returncode == 0, r.stderr
+        outs.add(r.stdout.strip())
+    assert len(outs) == 1, f"fingerprints differ across processes: {outs}"
+
+
+def test_upsert_index_token_conflict():
+    """Two txns writing the SAME value to an @upsert indexed predicate must
+    conflict even on different subjects (reference: posting.addConflictKeys
+    adds index keys for @upsert predicates)."""
+    a = Alpha(device_threshold=10**9)
+    a.alter("email: string @index(exact) @upsert .")
+    t1, t2 = a.new_txn(), a.new_txn()
+    t1.mutate(set_nquads='_:u1 <email> "x@y.com" .')
+    t2.mutate(set_nquads='_:u2 <email> "x@y.com" .')
+    t1.commit()
+    with pytest.raises(TxnAborted):
+        t2.commit()
+
+
+def test_non_upsert_same_value_no_conflict():
+    """Without @upsert, same value on different subjects never conflicts."""
+    a = Alpha(device_threshold=10**9)
+    a.alter("email: string @index(exact) .")
+    t1, t2 = a.new_txn(), a.new_txn()
+    t1.mutate(set_nquads='_:u1 <email> "x@y.com" .')
+    t2.mutate(set_nquads='_:u2 <email> "x@y.com" .')
+    t1.commit()
+    t2.commit()
+
+
+def test_mutate_error_discards_new_txn():
+    """A parse error in mutate(commit_now=False) with no client start_ts
+    must not leak an open txn pinning the gc watermark (advisor finding)."""
+    a = Alpha(device_threshold=10**9)
+    floor0 = a.oracle.min_active_ts()
+    with pytest.raises(ValueError):
+        a.mutate(set_nquads="this is not rdf", commit_now=False)
+    assert not a._open_txns
+    assert a.oracle.min_active_ts() >= floor0
+
+
+def test_serve_task_read_leaves_no_pending_txn():
+    """ServeTask one-shot reads use read_only_ts: the oracle gc watermark
+    must keep advancing (advisor finding: leaked read_ts pinned it)."""
+    from dgraph_tpu.protos import task_pb2 as pb
+    from dgraph_tpu.server.task import WorkerService
+
+    a = Alpha(device_threshold=10**9)
+    a.alter("friend: [uid] .")
+    a.mutate(set_nquads="_:a <friend> _:b .")
+    ws = WorkerService(a)
+    ws.ServeTask(pb.TaskQuery(attr="friend",
+                              frontier=pb.UidList(uids=[1])), None)
+    assert not a._active_reads
+    # no undecided txn may remain: the watermark equals the next fresh ts
+    assert a.oracle.min_active_ts() == a.oracle.max_assigned + 1

@@ -1,0 +1,169 @@
+"""Durability: mutation WAL + crash recovery.
+
+Reference parity: Badger persists every committed txn and the raft WAL
+replays the tail on restart (SURVEY §5). The contract under test: any
+commit() that RETURNED is on disk and survives a hard kill; a torn tail
+(partial append at crash) is dropped cleanly, never corrupting the store.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from dgraph_tpu.server.api import Alpha
+from dgraph_tpu.store.mvcc import Mutation
+from dgraph_tpu.store.wal import WAL, replay
+
+SCHEMA = "name: string @index(exact) .\nfriend: [uid] @reverse .\n"
+
+
+def test_wal_roundtrip(tmp_path):
+    path = str(tmp_path / "wal.log")
+    w = WAL(path)
+    m1 = Mutation(edge_sets=[(1, "friend", 2, {"since": 2004})],
+                  val_sets=[(1, "name", "alice", "", None)])
+    m2 = Mutation(edge_dels=[(1, "friend", 2)],
+                  val_dels=[(1, "name", None, "")])
+    w.append(m1, 10)
+    w.append_schema(SCHEMA, 11)
+    w.append(m2, 12)
+    w.append_drop(13)
+    w.close()
+    recs = list(replay(path))
+    assert [(ts, kind) for ts, kind, _ in recs] == [
+        (10, "mut"), (11, "schema"), (12, "mut"), (13, "drop")]
+    assert recs[0][2].edge_sets == [(1, "friend", 2, {"since": 2004})]
+    assert recs[0][2].val_sets == [(1, "name", "alice", "", None)]
+    assert recs[1][2] == SCHEMA
+    assert recs[2][2].edge_dels == [(1, "friend", 2)]
+
+
+def test_wal_torn_tail_dropped(tmp_path):
+    path = str(tmp_path / "wal.log")
+    w = WAL(path)
+    w.append(Mutation(val_sets=[(1, "name", "a", "", None)]), 5)
+    w.append(Mutation(val_sets=[(2, "name", "b", "", None)]), 6)
+    w.close()
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:
+        f.truncate(size - 7)  # torn mid-record, as a crash would leave it
+    recs = list(replay(path))
+    assert len(recs) == 1 and recs[0][0] == 5
+
+
+def test_wal_truncate_keeps_tail(tmp_path):
+    path = str(tmp_path / "wal.log")
+    w = WAL(path)
+    for ts in (5, 6, 7):
+        w.append(Mutation(val_sets=[(ts, "name", f"v{ts}", "", None)]), ts)
+    w.truncate(6)
+    w.append(Mutation(val_sets=[(8, "name", "v8", "", None)]), 8)
+    w.close()
+    assert [ts for ts, _k, _o in replay(path)] == [7, 8]
+
+
+def test_alpha_recovers_unsnapshotted_commits(tmp_path):
+    p = str(tmp_path / "p")
+    a = Alpha.open(p)
+    a.alter(SCHEMA)
+    a.mutate(set_nquads='_:a <name> "alice" .\n_:b <name> "bob" .\n'
+                        '_:a <friend> _:b .')
+    # NO checkpoint — simulate a crash by just reopening the dir
+    b = Alpha.open(p)
+    out = b.query('{ q(func: eq(name, "alice")) { name friend { name } } }')
+    assert out == {"q": [{"name": "alice", "friend": [{"name": "bob"}]}]}
+    # index from the replayed Alter works, and new commits keep flowing
+    b.mutate(set_nquads='_:c <name> "carol" .')
+    out = b.query('{ q(func: has(name)) { name } }')
+    assert sorted(r["name"] for r in out["q"]) == ["alice", "bob", "carol"]
+
+
+def test_alpha_checkpoint_truncates_and_recovers(tmp_path):
+    p = str(tmp_path / "p")
+    a = Alpha.open(p)
+    a.alter(SCHEMA)
+    a.mutate(set_nquads='_:a <name> "alice" .')
+    a.checkpoint_to(p)
+    a.mutate(set_nquads='_:b <name> "bob" .')  # post-checkpoint tail
+    b = Alpha.open(p)
+    out = b.query('{ q(func: has(name)) { name } }')
+    assert sorted(r["name"] for r in out["q"]) == ["alice", "bob"]
+
+
+def test_alpha_drop_all_survives_restart(tmp_path):
+    p = str(tmp_path / "p")
+    a = Alpha.open(p)
+    a.alter(SCHEMA)
+    a.mutate(set_nquads='_:a <name> "alice" .')
+    a.drop_all()
+    b = Alpha.open(p)
+    assert b.query('{ q(func: has(name)) { name } }') == {"q": []}
+
+
+_CHILD = r"""
+import sys
+sys.path.insert(0, "/root/repo")
+sys.path.insert(0, "/root/repo/tests")
+import conftest  # noqa: F401 — cpu platform
+from dgraph_tpu.server.api import Alpha
+
+p = sys.argv[1]
+a = Alpha.open(p)
+a.alter("name: string @index(exact) .")
+i = 0
+while True:
+    a.mutate(set_nquads=f'_:x <name> "row{i}" .')
+    print(i, flush=True)   # ack AFTER commit returned
+    i += 1
+"""
+
+
+def test_kill_during_load_loses_no_acked_commit(tmp_path):
+    """SIGKILL an alpha mid-load; every commit it ACKED must survive
+    (the reference's Badger guarantee; VERDICT round-1 item 4)."""
+    p = str(tmp_path / "p")
+    child = str(tmp_path / "child.py")
+    with open(child, "w") as f:
+        f.write(_CHILD)
+    proc = subprocess.Popen([sys.executable, child, p],
+                            stdout=subprocess.PIPE, text=True,
+                            cwd="/root/repo")
+    acked = []
+    deadline = time.time() + 60
+    while len(acked) < 12 and time.time() < deadline:
+        line = proc.stdout.readline()
+        if line.strip().isdigit():
+            acked.append(int(line))
+    proc.kill()
+    proc.wait()
+    assert len(acked) >= 12, f"child too slow: {len(acked)} acks"
+
+    b = Alpha.open(p)
+    out = b.query('{ q(func: has(name)) { name } }')
+    names = {r["name"] for r in out["q"]}
+    missing = [i for i in acked if f"row{i}" not in names]
+    assert not missing, f"acked commits lost after kill: {missing}"
+
+
+def test_idle_restart_preserves_base_ts(tmp_path):
+    """Reopen + re-checkpoint with no new commits must not regress the
+    manifest base_ts / timestamp epoch (code-review finding)."""
+    p = str(tmp_path / "p")
+    a = Alpha.open(p)
+    a.alter(SCHEMA)
+    a.mutate(set_nquads='_:a <name> "alice" .')
+    ts1 = a.checkpoint_to(p)
+    assert ts1 > 0
+    b = Alpha.open(p)  # idle incarnation: reads only
+    b.query('{ q(func: has(name)) { name } }')
+    ts2 = b.checkpoint_to(p)
+    assert ts2 >= ts1, f"base_ts regressed: {ts1} -> {ts2}"
+    c = Alpha.open(p)
+    # fresh timestamps continue above the checkpoint epoch
+    assert c.oracle.read_only_ts() > ts1
+    assert c.query('{ q(func: has(name)) { name } }') == {
+        "q": [{"name": "alice"}]}
