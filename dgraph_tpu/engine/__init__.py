@@ -20,16 +20,18 @@ class Engine:
     query.Request.ProcessQuery → outputnode (SURVEY §3.1).
     """
 
-    def __init__(self, store, device_threshold: int = 512):
+    def __init__(self, store, device_threshold: int = 512, mesh=None):
         self.store = store
         self.device_threshold = device_threshold
+        self.mesh = mesh  # jax.sharding.Mesh | None → SPMD expansion
 
     def query(self, q: str, variables: dict | None = None) -> dict:
         from dgraph_tpu.dql.parser import parse
         from dgraph_tpu.engine.varorder import execution_order
 
         blocks = parse(q, variables)
-        ex = Executor(self.store, device_threshold=self.device_threshold)
+        ex = Executor(self.store, device_threshold=self.device_threshold,
+                      mesh=self.mesh)
         results: dict[int, LevelNode] = {}
         for i in execution_order(blocks):
             results[i] = ex.run_block(blocks[i])

@@ -57,6 +57,19 @@ def expand_recurse(ex, root) -> None:
     for c in root.sg.children:
         (data.edge_sgs if ex._expands(c) else data.leaf_sgs).append(c)
 
+    # Single-predicate depth-bounded visit-once recursions run as ONE
+    # compiled SPMD program on the mesh (all hops inside one lax.scan over
+    # shard_map — the north-star fusion). Filters/facet-filters/loop need
+    # per-hop host logic and fall back to the loop below.
+    if (ex.mesh is not None and not args.loop and args.depth
+            and len(data.edge_sgs) == 1 and not data.edge_sgs[0].filters
+            and not data.edge_sgs[0].facet_filter
+            and len(root.nodes) > 0):
+        _fused_recurse(ex, root, data, args.depth)
+        _bind_recurse_vars(ex, root, data, sg)
+        root.recurse_data = data
+        return
+
     frontier = root.nodes
     seen = root.nodes.copy()
     for _d in range(depth):
@@ -101,7 +114,13 @@ def expand_recurse(ex, root) -> None:
     data.all_nodes = seen if not args.loop else np.unique(np.concatenate(
         [root.nodes] + [c for lv in data.by_depth for (_p, c) in lv.values()]
     )).astype(np.int32)
-    # leaf vars (value leaves inside recurse) bind over every visited node
+    _bind_recurse_vars(ex, root, data, sg)
+    root.recurse_data = data
+
+
+def _bind_recurse_vars(ex, root, data: RecurseData, sg: SubGraph) -> None:
+    """Leaf value vars bind over every visited node; the block's uid var
+    is the whole reachable set."""
     for leaf in data.leaf_sgs:
         if leaf.var_name:
             saved_nodes = root.nodes
@@ -110,4 +129,54 @@ def expand_recurse(ex, root) -> None:
             root.nodes = saved_nodes
     if sg.var_name:
         ex.uid_vars[sg.var_name] = data.all_nodes
-    root.recurse_data = data
+
+
+def _fused_recurse(ex, root, data: RecurseData, depth: int) -> None:
+    """Drive parallel.dhop.recurse_fused_matrix: the whole hop loop is one
+    jitted shard_map program (reference: query/recurse.go expandRecurse,
+    with the per-level ProcessTaskOverNetwork fan-out collapsed into
+    on-mesh collectives). Host work is only cap policy + matrix unpack."""
+    from dgraph_tpu import ops
+    from dgraph_tpu.engine.execute import _bucket
+    from dgraph_tpu.ops.uidalgebra import SENTINEL32
+    from dgraph_tpu.parallel.dhop import recurse_fused_matrix
+
+    esg = data.edge_sgs[0]
+    srel = ex.store.sharded_rel(esg.attr, esg.is_reverse, ex.mesh)
+    out_cap = _bucket(max(len(root.nodes), 1))
+    seen_cap = _bucket(4 * out_cap, lo=256)
+    edge_cap = _bucket(1, lo=1024)
+    for _attempt in range(12):  # geometric cap growth, bounded
+        fr = ops.pad_to(np.sort(root.nodes).astype(np.int32), out_cap)
+        (last, seen, edges, needs, nbrs_s, seg_s, _pos_s,
+         frontiers) = recurse_fused_matrix(
+            ex.mesh, srel, fr, edge_cap=edge_cap, out_cap=out_cap,
+            seen_cap=seen_cap, depth=depth)
+        need_out, need_seen, need_edge = (int(x) for x in np.asarray(needs))
+        if (need_out <= out_cap and need_seen <= seen_cap
+                and need_edge <= edge_cap):
+            break
+        out_cap = _bucket(max(need_out, out_cap))
+        seen_cap = _bucket(max(need_seen, seen_cap), lo=256)
+        edge_cap = _bucket(max(need_edge, edge_cap), lo=1024)
+    else:
+        raise RuntimeError("recurse caps failed to converge")
+
+    nbrs_s = np.asarray(nbrs_s)      # [D, depth, edge_cap]
+    seg_s = np.asarray(seg_s)
+    frontiers = np.asarray(frontiers)  # [depth, out_cap]
+    parts_p, parts_c = [], []
+    for h in range(depth):
+        fr_h = frontiers[h]
+        for d in range(nbrs_s.shape[0]):
+            row = nbrs_s[d, h]
+            m = row != SENTINEL32
+            if not m.any():
+                continue
+            parts_p.append(fr_h[seg_s[d, h][m]])
+            parts_c.append(row[m])
+    if parts_p:
+        data.edges[0] = (np.concatenate(parts_p).astype(np.int32),
+                         np.concatenate(parts_c).astype(np.int32))
+    seen = np.asarray(seen)
+    data.all_nodes = seen[seen != SENTINEL32].astype(np.int32)

@@ -31,7 +31,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from dgraph_tpu.ops.hop import gather_edges
 from dgraph_tpu.ops.uidalgebra import (
-    difference_sorted, sentinel, sort_unique_count, valid_mask)
+    _member, difference_sorted, sentinel, sort_unique_count, valid_mask)
 from dgraph_tpu.parallel.mesh import SHARD_AXIS
 from dgraph_tpu.parallel.pshard import ShardedRel
 
@@ -82,6 +82,50 @@ def scatter_gather_hop(mesh: Mesh, rel: ShardedRel, frontier: jax.Array,
     out_cap.
     """
     return _build_sg_hop(mesh, edge_cap, out_cap)(
+        rel.indptr_s, rel.indices_s, rel.row_lo, frontier)
+
+
+@functools.lru_cache(maxsize=64)
+def _build_matrix_hop(mesh: Mesh, edge_cap: int):
+    def per_device(indptr_b, indices_b, row_lo_b, frontier):
+        indptr, indices, row_lo = indptr_b[0], indices_b[0], row_lo_b[0]
+        n_rows = indptr.shape[0] - 1
+        mine = (valid_mask(frontier) & (frontier >= row_lo)
+                & (frontier < row_lo + n_rows))
+        local_f = jnp.where(mine, frontier - row_lo, sentinel(frontier.dtype))
+        nbrs, seg, edge_pos, valid, total = gather_edges(
+            indptr, indices, local_f, edge_cap)
+        max_shard = lax.pmax(total, SHARD_AXIS)
+        return (nbrs[None], seg[None], edge_pos[None], total[None],
+                max_shard)
+
+    fn = jax.shard_map(
+        per_device, mesh=mesh,
+        in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS), P()),
+        out_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS),
+                   P(SHARD_AXIS), P()),
+        check_vma=False,
+    )
+    return jax.jit(fn)
+
+
+def matrix_hop(mesh: Mesh, rel: ShardedRel, frontier: jax.Array,
+               edge_cap: int):
+    """One hop that RETURNS the edge matrix, not just the merged frontier —
+    the seam the query engine needs (reference: pb.Result.UidMatrix from
+    ProcessTaskOverNetwork). Frontier is replicated; each device expands
+    the rows it owns; outputs stay sharded:
+
+      (nbrs[D, edge_cap], seg[D, edge_cap], edge_pos[D, edge_cap],
+       totals[D], max_shard_edges)
+
+    Per shard d, the first totals[d] slots are that shard's edges in CSR
+    row order; `seg` indexes the GLOBAL frontier (each row is owned by
+    exactly one shard, so a host stable-sort by seg rebuilds global row
+    order); `edge_pos` is local — add rel.pos_lo[d] for the absolute
+    position facet columns key on. Valid only if max_shard_edges ≤
+    edge_cap; otherwise re-run at a bigger bucket."""
+    return _build_matrix_hop(mesh, edge_cap)(
         rel.indptr_s, rel.indices_s, rel.row_lo, frontier)
 
 
@@ -185,6 +229,87 @@ def _build_recurse(mesh: Mesh, edge_cap: int, out_cap: int, seen_cap: int,
         check_vma=False,
     )
     return jax.jit(fn)
+
+
+@functools.lru_cache(maxsize=64)
+def _build_recurse_matrix(mesh: Mesh, edge_cap: int, out_cap: int,
+                          seen_cap: int, depth: int):
+    """recurse_fused plus per-hop edge-matrix capture: the variant the DQL
+    engine drives, because JSON rendering needs every (parent, child) edge,
+    not just the frontier (reference: expandRecurse keeps each level's
+    UidMatrix for outputnode)."""
+
+    def per_device(indptr_b, indices_b, row_lo_b, frontier):
+        indptr, indices, row_lo = indptr_b[0], indices_b[0], row_lo_b[0]
+        n_rows = indptr.shape[0] - 1
+        snt = sentinel(frontier.dtype)
+
+        def hop(carry, _):
+            frontier, seen, edges, need_out, need_seen, need_edge = carry
+            mine = (valid_mask(frontier) & (frontier >= row_lo)
+                    & (frontier < row_lo + n_rows))
+            local_f = jnp.where(mine, frontier - row_lo, snt)
+            nbrs, seg, edge_pos, valid, t = gather_edges(
+                indptr, indices, local_f, edge_cap)
+            # visit-once: drop edges to nodes seen BEFORE this hop (edges
+            # between two nodes first reached in the same hop are kept —
+            # matching the host loop's first-visit-tree semantics)
+            keep = valid & ~_member(nbrs, seen)
+            m_nbrs = jnp.where(keep, nbrs, snt)
+            m_seg = jnp.where(keep, seg, jnp.int32(-1))
+            local, local_cnt = sort_unique_count(m_nbrs, out_cap)
+            gathered = lax.all_gather(local, SHARD_AXIS)
+            fresh, mcnt = sort_unique_count(gathered.reshape(-1), out_cap)
+            seen2, scnt = sort_unique_count(
+                jnp.concatenate([seen, fresh]), seen_cap)
+            need_out = jnp.maximum(
+                need_out, jnp.maximum(mcnt, lax.pmax(local_cnt, SHARD_AXIS)))
+            need_seen = jnp.maximum(need_seen, scnt)
+            need_edge = jnp.maximum(need_edge, lax.pmax(t, SHARD_AXIS))
+            carry = (fresh, seen2, edges + lax.psum(t, SHARD_AXIS),
+                     need_out, need_seen, need_edge)
+            return carry, (m_nbrs, m_seg, edge_pos, frontier)
+
+        seen0, scnt0 = sort_unique_count(frontier, seen_cap)
+        (last, seen, edges, need_out, need_seen, need_edge), ys = lax.scan(
+            hop, (frontier, seen0, jnp.int32(0), jnp.int32(0), scnt0,
+                  jnp.int32(0)),
+            None, length=depth)
+        needs = jnp.stack([need_out, need_seen, need_edge])
+        ys_nbrs, ys_seg, ys_pos, ys_frontier = ys
+        return (last, seen, edges, needs,
+                ys_nbrs[None], ys_seg[None], ys_pos[None], ys_frontier)
+
+    fn = jax.shard_map(
+        per_device, mesh=mesh,
+        in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS), P()),
+        out_specs=(P(), P(), P(), P(),
+                   P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS), P()),
+        check_vma=False,
+    )
+    return jax.jit(fn)
+
+
+def recurse_fused_matrix(mesh: Mesh, rel: ShardedRel, frontier: jax.Array,
+                         edge_cap: int, out_cap: int, seen_cap: int,
+                         depth: int):
+    """Depth-bounded @recurse over one predicate as ONE compiled SPMD
+    program, returning the per-hop edge matrices the engine renders from:
+
+      (last_frontier[out_cap], seen[seen_cap], edges, needs[3],
+       nbrs[D, depth, edge_cap], seg[D, depth, edge_cap],
+       pos[D, depth, edge_cap], frontiers[depth, out_cap])
+
+    For hop h on shard d: slots with nbrs != sentinel are surviving edges
+    (visit-once filtered); seg indexes frontiers[h] (the hop's replicated
+    input frontier); pos + rel.pos_lo[d] is the absolute facet position.
+    Same overflow contract as recurse_fused: valid only if
+    needs <= [out_cap, seen_cap, edge_cap]."""
+    if frontier.shape[0] != out_cap:
+        raise ValueError(
+            f"frontier buffer {frontier.shape[0]} != out_cap {out_cap}")
+    return _build_recurse_matrix(mesh, edge_cap, out_cap, seen_cap, depth)(
+        rel.indptr_s, rel.indices_s, rel.row_lo, frontier)
 
 
 def recurse_fused(mesh: Mesh, rel: ShardedRel, frontier: jax.Array,

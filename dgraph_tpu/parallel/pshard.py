@@ -40,6 +40,10 @@ class ShardedRel:
     indices_s: jax.Array | np.ndarray  # [D, E]
     row_lo: jax.Array | np.ndarray  # [D]
     n_nodes: int
+    # global edge-position base per shard (host-only): local edge_pos +
+    # pos_lo[d] = absolute position in the unsharded `indices`, which is
+    # what facet columns are keyed by
+    pos_lo: np.ndarray | None = None
 
     @property
     def n_shards(self) -> int:
@@ -54,13 +58,14 @@ def shard_rel(rel: EdgeRel, n_shards: int) -> ShardedRel:
     """Split a host CSR into `n_shards` contiguous row slabs (host-side)."""
     n = rel.indptr.shape[0] - 1
     rows = -(-n // n_shards) if n else 1
-    parts_ptr, parts_idx, lows = [], [], []
+    parts_ptr, parts_idx, lows, pos_lows = [], [], [], []
     max_nnz = 0
     for d in range(n_shards):
         lo = min(d * rows, n)
         hi = min(lo + rows, n)
         ptr = rel.indptr[lo:hi + 1].astype(np.int64)
         base = ptr[0] if ptr.size else 0
+        pos_lows.append(int(base))
         local = (ptr - base).astype(np.int32)
         # Pad ghost rows (beyond n) with repeated final offset → degree 0.
         if hi - lo < rows:
@@ -81,6 +86,7 @@ def shard_rel(rel: EdgeRel, n_shards: int) -> ShardedRel:
         indices_s=indices_s,
         row_lo=np.asarray(lows, np.int32),
         n_nodes=n,
+        pos_lo=np.asarray(pos_lows, np.int64),
     )
 
 
@@ -92,6 +98,7 @@ def device_put_rel(srel: ShardedRel, mesh: Mesh) -> ShardedRel:
         indices_s=jax.device_put(srel.indices_s, sh),
         row_lo=jax.device_put(srel.row_lo, sh),
         n_nodes=srel.n_nodes,
+        pos_lo=srel.pos_lo,  # host-only: used after the kernel returns
     )
 
 

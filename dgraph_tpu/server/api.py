@@ -67,7 +67,7 @@ class Alpha:
         from dgraph_tpu.store.wal import WAL, replay
 
         base, base_ts = None, 0
-        if os.path.exists(os.path.join(p_dir, "manifest.json")):
+        if checkpoint.exists(p_dir):
             base, base_ts = checkpoint.load(p_dir)
         wal_path = os.path.join(p_dir, "wal.log")
         alpha = cls(base=base, device_threshold=device_threshold,
@@ -104,7 +104,10 @@ class Alpha:
         with self._apply_lock:
             store = self.mvcc.rollup()
             ts = self.mvcc.base_ts
-            checkpoint.save(store, p_dir, base_ts=ts)
+            # versioned write + atomic CURRENT flip: a crash mid-save
+            # leaves the previous snapshot intact; the WAL is only
+            # truncated after the flip succeeded
+            checkpoint.save_versioned(store, p_dir, base_ts=ts)
             if self.wal is not None:
                 self.wal.truncate(ts)
         return ts

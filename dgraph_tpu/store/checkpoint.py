@@ -105,8 +105,44 @@ def save(store: Store, dirname: str, base_ts: int = 0,
     os.replace(tmp, os.path.join(dirname, "manifest.json"))
 
 
+def resolve(dirname: str) -> str:
+    """Follow a CURRENT pointer (versioned-checkpoint layout written by
+    save_versioned) if present; plain snapshot dirs resolve to themselves."""
+    cur = os.path.join(dirname, "CURRENT")
+    if os.path.exists(cur):
+        with open(cur) as f:
+            return os.path.join(dirname, f.read().strip())
+    return dirname
+
+
+def exists(dirname: str) -> bool:
+    return os.path.exists(os.path.join(resolve(dirname), "manifest.json"))
+
+
+def save_versioned(store: Store, dirname: str, base_ts: int = 0) -> None:
+    """Crash-safe checkpoint: write a fresh `ckpt-<ts>` subdir, then flip
+    the CURRENT pointer atomically, then delete superseded subdirs. A kill
+    at ANY point leaves either the old or the new snapshot fully intact —
+    never a half-written mix (the durability role of Badger's MANIFEST)."""
+    os.makedirs(dirname, exist_ok=True)
+    sub = f"ckpt-{base_ts:016d}"
+    save(store, os.path.join(dirname, sub), base_ts=base_ts)
+    tmp = os.path.join(dirname, "CURRENT.tmp")
+    with open(tmp, "w") as f:
+        f.write(sub)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, os.path.join(dirname, "CURRENT"))
+    for name in os.listdir(dirname):
+        if name.startswith("ckpt-") and name != sub:
+            import shutil
+            shutil.rmtree(os.path.join(dirname, name), ignore_errors=True)
+
+
 def load(dirname: str) -> tuple[Store, int]:
-    """Load (store, base_ts). Reference: restore / bulk-load handoff."""
+    """Load (store, base_ts). Reference: restore / bulk-load handoff.
+    Accepts both plain snapshot dirs and versioned (CURRENT) layouts."""
+    dirname = resolve(dirname)
     with open(os.path.join(dirname, "manifest.json")) as f:
         manifest = json.load(f)
     if not (MIN_FORMAT_VERSION <= manifest["format_version"]

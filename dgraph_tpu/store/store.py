@@ -159,6 +159,23 @@ class Store:
                                  jax.device_put(r.indices))
         return self._device[key]
 
+    def sharded_rel(self, pred: str, reverse: bool, mesh):
+        """Row-sharded CSR placed on a mesh, cached per (pred, direction)
+        — the tablet residency of the distributed path (reference analog:
+        worker/groups.go tablet ownership; here every device owns a row
+        slab of every predicate, SURVEY §2.3 S1)."""
+        from dgraph_tpu.parallel.pshard import device_put_rel, shard_rel
+        key = (pred, "rev" if reverse else "fwd")
+        cache = getattr(self, "_sharded", None)
+        if cache is None or self._sharded_mesh is not mesh:
+            cache = {}
+            self._sharded = cache
+            self._sharded_mesh = mesh
+        if key not in cache:
+            srel = shard_rel(self.rel(pred, reverse), mesh.devices.size)
+            cache[key] = device_put_rel(srel, mesh)
+        return cache[key]
+
     # -- values -------------------------------------------------------------
     def value_col(self, pred: str, lang: str = "") -> ValueColumn | None:
         p = self.preds.get(pred)

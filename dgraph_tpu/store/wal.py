@@ -88,6 +88,16 @@ class WAL:
         self.path = path
         self.sync = sync
         os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        # A torn tail from a previous crash must be cut BEFORE appending:
+        # records written after corrupt bytes would be unreachable by
+        # replay (it stops at the first bad record) — acked-but-invisible.
+        if os.path.exists(path):
+            valid_end = _valid_end(path)
+            if valid_end < os.path.getsize(path):
+                with open(path, "r+b") as f:
+                    f.truncate(valid_end)
+                    f.flush()
+                    os.fsync(f.fileno())
         self._f = open(path, "ab")
 
     def _write(self, doc: dict) -> None:
@@ -138,6 +148,31 @@ class WAL:
         self._f.close()
 
 
+def _scan(data: bytes) -> Iterator[tuple[int, bytes]]:
+    """Yield (record_end_offset, payload) for every intact record."""
+    off = 0
+    hdr = len(MAGIC) + _HEADER.size
+    while off + hdr <= len(data):
+        if data[off:off + len(MAGIC)] != MAGIC:
+            return
+        ln, crc = _HEADER.unpack(data[off + len(MAGIC):off + hdr])
+        payload = data[off + hdr:off + hdr + ln]
+        if len(payload) < ln or zlib.crc32(payload) != crc:
+            return
+        off += hdr + ln
+        yield off, payload
+
+
+def _valid_end(path: str) -> int:
+    """Byte offset where the intact record prefix ends."""
+    with open(path, "rb") as f:
+        data = f.read()
+    end = 0
+    for off, _payload in _scan(data):
+        end = off
+    return end
+
+
 def replay(path: str) -> Iterator[tuple[int, str, object]]:
     """Yield (ts, kind, obj) in append order — kind "mut" with a Mutation,
     or "schema" with the merged schema text. Stops cleanly at a
@@ -146,15 +181,7 @@ def replay(path: str) -> Iterator[tuple[int, str, object]]:
         return
     with open(path, "rb") as f:
         data = f.read()
-    off = 0
-    hdr = len(MAGIC) + _HEADER.size
-    while off + hdr <= len(data):
-        if data[off:off + len(MAGIC)] != MAGIC:
-            break
-        ln, crc = _HEADER.unpack(data[off + len(MAGIC):off + hdr])
-        payload = data[off + hdr:off + hdr + ln]
-        if len(payload) < ln or zlib.crc32(payload) != crc:
-            break
+    for _off, payload in _scan(data):
         doc = json.loads(payload)
         if "schema" in doc:
             yield int(doc["ts"]), "schema", doc["schema"]
@@ -162,4 +189,3 @@ def replay(path: str) -> Iterator[tuple[int, str, object]]:
             yield int(doc["ts"]), "drop", None
         else:
             yield int(doc["ts"]), "mut", _doc_mut(doc["m"])
-        off += hdr + ln

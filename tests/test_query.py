@@ -74,11 +74,16 @@ def build_store():
     return b.finalize()
 
 
-@pytest.fixture(scope="module", params=["host", "device"])
+@pytest.fixture(scope="module", params=["host", "device", "mesh"])
 def engine(request):
     store = build_store()
     # host: pure-numpy expansion; device: force every hop through the
-    # jitted kernel (threshold 0 → device path even for tiny frontiers)
+    # jitted kernel (threshold 0 → device path even for tiny frontiers);
+    # mesh: every hop as a shard_map over the 8-device virtual mesh — the
+    # docker-compose analog for the distributed path (SURVEY §4)
+    if request.param == "mesh":
+        from dgraph_tpu.parallel.mesh import make_mesh
+        return Engine(store, device_threshold=0, mesh=make_mesh(8))
     thresh = 10**9 if request.param == "host" else 0
     return Engine(store, device_threshold=thresh)
 

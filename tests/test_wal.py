@@ -167,3 +167,44 @@ def test_idle_restart_preserves_base_ts(tmp_path):
     assert c.oracle.read_only_ts() > ts1
     assert c.query('{ q(func: has(name)) { name } }') == {
         "q": [{"name": "alice"}]}
+
+
+def test_torn_tail_then_append_survives_two_restarts(tmp_path):
+    """Commits acked AFTER a torn-tail recovery must still replay on the
+    NEXT restart — the WAL must cut the corrupt tail before appending
+    (code-review finding: append-after-garbage is unreachable)."""
+    p = str(tmp_path / "p")
+    a = Alpha.open(p)
+    a.alter(SCHEMA)
+    a.mutate(set_nquads='_:a <name> "alice" .')
+    wal_path = os.path.join(p, "wal.log")
+    with open(wal_path, "r+b") as f:
+        f.seek(0, 2)
+        f.write(b"DGW1\x99\x00\x00\x00")  # torn record: header, no payload
+    b = Alpha.open(p)  # restart 1: drops the torn tail
+    b.mutate(set_nquads='_:b <name> "bob" .')
+    out = b.query('{ q(func: has(name)) { name } }')
+    assert sorted(r["name"] for r in out["q"]) == ["alice", "bob"]
+    c = Alpha.open(p)  # restart 2: bob must still be there
+    out = c.query('{ q(func: has(name)) { name } }')
+    assert sorted(r["name"] for r in out["q"]) == ["alice", "bob"]
+
+
+def test_partial_checkpoint_dir_ignored(tmp_path):
+    """A checkpoint subdir that never got its CURRENT flip (crash mid-save)
+    must be invisible: the previous snapshot + WAL still load."""
+    p = str(tmp_path / "p")
+    a = Alpha.open(p)
+    a.alter(SCHEMA)
+    a.mutate(set_nquads='_:a <name> "alice" .')
+    a.checkpoint_to(p)
+    a.mutate(set_nquads='_:b <name> "bob" .')
+    # simulate a crash mid-save of a NEWER checkpoint: garbage subdir,
+    # CURRENT not flipped
+    os.makedirs(os.path.join(p, "ckpt-9999999999999999"))
+    with open(os.path.join(p, "ckpt-9999999999999999", "manifest.json"),
+              "w") as f:
+        f.write("{ this is not json")
+    b = Alpha.open(p)
+    out = b.query('{ q(func: has(name)) { name } }')
+    assert sorted(r["name"] for r in out["q"]) == ["alice", "bob"]
