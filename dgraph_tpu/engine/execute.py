@@ -372,34 +372,38 @@ class Executor:
 
     def run_child(self, sg: SubGraph, frontier: np.ndarray) -> LevelNode:
         """Expand one uid-predicate child level below `frontier`."""
-        nbrs, seg, pos = self.expand(sg.attr, sg.is_reverse, frontier)
-        nbrs, seg, pos = self.filter_edges(sg.filters, nbrs, seg, pos)
-        if not sg.is_reverse:
-            nbrs, seg, pos = self.facet_filter_edges(sg, sg.attr, nbrs,
-                                                     seg, pos)
-        # row-internal ordering (default: uid order, which CSR already gives)
-        if sg.orders or sg.facet_orders:
-            if sg.facet_orders and not sg.is_reverse:
-                order_idx = self._facet_order(sg, nbrs, seg, pos)
-            else:
-                order_idx = self.order_ranks(nbrs, sg.orders, seg=seg)
-            nbrs, seg = nbrs[order_idx], seg[order_idx]
-            pos = pos[order_idx] if len(pos) else pos
-        # per-row pagination (seg is nondecreasing: CSR construction order,
-        # preserved by masking, and lexsort uses seg as the primary key)
-        if sg.first or sg.offset or sg.after:
-            rows = np.unique(seg)
-            starts = np.searchsorted(seg, rows)
-            ends = np.searchsorted(seg, rows, "right")
-            keep_idx = []
-            for s, e in zip(starts.tolist(), ends.tolist()):
-                row_idx = np.arange(s, e)
-                keep_idx.append(
-                    row_idx[self.paginate(e - s, sg, nbrs[row_idx])])
-            if keep_idx:
-                keep_idx = np.sort(np.concatenate(keep_idx))
-                nbrs, seg = nbrs[keep_idx], seg[keep_idx]
-                pos = pos[keep_idx] if len(pos) else pos
+        fused = self._fused_level(sg, frontier)
+        if fused is not None:
+            nbrs, seg, pos = fused
+        else:
+            nbrs, seg, pos = self.expand(sg.attr, sg.is_reverse, frontier)
+            nbrs, seg, pos = self.filter_edges(sg.filters, nbrs, seg, pos)
+            if not sg.is_reverse:
+                nbrs, seg, pos = self.facet_filter_edges(sg, sg.attr, nbrs,
+                                                         seg, pos)
+            # row-internal ordering (default: uid order from the CSR)
+            if sg.orders or sg.facet_orders:
+                if sg.facet_orders and not sg.is_reverse:
+                    order_idx = self._facet_order(sg, nbrs, seg, pos)
+                else:
+                    order_idx = self.order_ranks(nbrs, sg.orders, seg=seg)
+                nbrs, seg = nbrs[order_idx], seg[order_idx]
+                pos = pos[order_idx] if len(pos) else pos
+            # per-row pagination (seg is nondecreasing: CSR construction
+            # order, preserved by masking; lexsort keys on seg first)
+            if sg.first or sg.offset or sg.after:
+                rows = np.unique(seg)
+                starts = np.searchsorted(seg, rows)
+                ends = np.searchsorted(seg, rows, "right")
+                keep_idx = []
+                for s, e in zip(starts.tolist(), ends.tolist()):
+                    row_idx = np.arange(s, e)
+                    keep_idx.append(
+                        row_idx[self.paginate(e - s, sg, nbrs[row_idx])])
+                if keep_idx:
+                    keep_idx = np.sort(np.concatenate(keep_idx))
+                    nbrs, seg = nbrs[keep_idx], seg[keep_idx]
+                    pos = pos[keep_idx] if len(pos) else pos
         nodes = np.unique(nbrs).astype(np.int32)
         node = LevelNode(sg=sg, nodes=nodes,
                          matrix_seg=seg.astype(np.int32),
@@ -413,6 +417,44 @@ class Executor:
             return node
         self._descend(node)
         return node
+
+    def _fused_level(self, sg: SubGraph, frontier: np.ndarray):
+        """Large-frontier fast path: expand → filter → paginate → dedupe
+        fused into ONE jitted program (ops.level.expand_level); the only
+        host work is evaluating the filter tree to a sorted allowed set.
+        Returns (nbrs, seg, pos) or None when ineligible (ordering, facet
+        filters and `after` cursors need per-edge host logic)."""
+        if (self.mesh is not None
+                or len(frontier) < self.device_threshold
+                or sg.orders or sg.facet_orders or sg.after
+                or sg.facet_filter is not None):
+            return None
+        rel = self.store.rel(sg.attr, sg.is_reverse)
+        if len(frontier) == 0 or rel.nnz == 0:
+            return None if rel.nnz else (EMPTY, EMPTY, EMPTY64)
+        from dgraph_tpu.ops.level import NO_LIMIT, expand_level
+
+        use_allowed = sg.filters is not None
+        if use_allowed:
+            universe = np.arange(self.store.n_nodes, dtype=np.int32)
+            allowed = self.apply_filter(sg.filters, universe)
+            allowed_d = ops.pad_to(allowed, _bucket(max(len(allowed), 1)))
+        else:
+            allowed_d = ops.pad_to(EMPTY, 1)
+        indptr, indices = self.store.device_rel(sg.attr, sg.is_reverse)
+        fcap = _bucket(len(frontier))
+        fr = ops.pad_to(frontier, fcap)
+        deg = rel.degree(frontier)
+        ecap = _bucket(max(int(deg.sum()), 1))
+        first = sg.first if sg.first else NO_LIMIT
+        c_nbrs, c_seg, c_pos, n_kept, _nxt, _nu, total = expand_level(
+            indptr, indices, fr, allowed_d,
+            np.int32(sg.offset), np.int32(first),
+            edge_cap=ecap, out_cap=ecap, use_allowed=use_allowed)
+        n = int(n_kept)
+        assert int(total) <= ecap, (int(total), ecap)
+        return (np.asarray(c_nbrs)[:n], np.asarray(c_seg)[:n],
+                np.asarray(c_pos)[:n].astype(np.int64))
 
     # -- leaves, vars, expand(_all_) ----------------------------------------
     def _concrete_children(self, parent: LevelNode) -> list[SubGraph]:

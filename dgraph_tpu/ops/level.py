@@ -1,0 +1,102 @@
+"""Fused level kernel: expand → filter → paginate → dedupe as ONE program.
+
+Reference parity: one level of `query.SubGraph.ProcessGraph` —
+posting-list expansion (worker/task.go processTask), filter intersection
+(algo.IntersectSorted over the filter SubGraph's result), and per-row
+pagination (first/offset applied to each UidMatrix row) — which the
+reference runs as separate Go passes with heap merges in between. Here the
+whole level body is a single jitted program: the only host work left for a
+filtered, paginated hop is evaluating the filter tree to a sorted
+`allowed` set (index lookups) and reading back the compacted result.
+
+Row pagination on device: after the keep-mask (validity ∧ filter), each
+edge's within-row rank among SURVIVORS is a segment-local exclusive
+cumsum; first/offset become rank-window comparisons, including the
+negative-first (last k) form via per-row survivor totals.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from dgraph_tpu.ops.hop import gather_edges
+from dgraph_tpu.ops.uidalgebra import _member, sentinel, sort_unique_count
+
+NO_LIMIT = (1 << 30)
+
+
+@functools.partial(jax.jit, static_argnames=("edge_cap", "out_cap",
+                                             "use_allowed"))
+def expand_level(indptr: jax.Array, indices: jax.Array, frontier: jax.Array,
+                 allowed: jax.Array, offset, first,
+                 edge_cap: int, out_cap: int, use_allowed: bool):
+    """One child level, fused.
+
+    Args:
+      frontier   [f_cap] sorted sentinel-padded ranks
+      allowed    [a_cap] sorted sentinel-padded filter set (ignored unless
+                 use_allowed — pass a dummy 1-element array then)
+      offset     int32: per-row survivors to skip
+      first      int32: >0 keep first k after offset; <0 keep last k;
+                 NO_LIMIT = unpaginated
+      edge_cap/out_cap: static buckets (overflow contract as ops.hop)
+
+    Returns (nbrs[edge_cap], seg[edge_cap], pos[edge_cap], n_kept,
+             next_frontier[out_cap], n_unique, total_edges):
+      the kept edges compacted to the front in CSR row order, their
+      frontier segments and absolute facet positions, plus the deduped
+      next frontier. Valid only if total_edges <= edge_cap and
+      n_unique <= out_cap.
+    """
+    nbrs, seg, edge_pos, valid, total = gather_edges(
+        indptr, indices, frontier, edge_cap)
+    keep = valid
+    if use_allowed:
+        keep = keep & _member(nbrs, allowed)
+
+    # within-row survivor rank: exclusive segment-local cumsum of `keep`
+    ksum = jnp.cumsum(keep.astype(jnp.int32))
+    excl = ksum - keep.astype(jnp.int32)        # exclusive at j
+    n_rows = frontier.shape[0]
+    # survivors before each row start (segment base)
+    row_ids = jnp.arange(n_rows, dtype=jnp.int32)
+    # first edge slot of each row: searchsorted over seg (seg nondecreasing)
+    row_start = jnp.searchsorted(seg, row_ids, side="left")
+    row_end = jnp.searchsorted(seg, row_ids, side="right")
+    base_at_row = jnp.take(excl, jnp.minimum(row_start, edge_cap - 1),
+                           mode="clip")
+    base_at_row = jnp.where(row_start < edge_cap, base_at_row, 0)
+    end_ksum = jnp.take(ksum, jnp.maximum(row_end - 1, 0), mode="clip")
+    end_ksum = jnp.where(row_end > 0, end_ksum, 0)
+    row_total = jnp.maximum(end_ksum - base_at_row, 0)  # survivors per row
+
+    rank = excl - base_at_row[seg]              # within-row survivor rank
+    lo = offset
+    k = jnp.where(first == NO_LIMIT, jnp.int32(NO_LIMIT), first)
+    hi = jnp.where(k >= 0, lo + k, jnp.int32(NO_LIMIT))
+    paged = keep & (rank >= lo) & (rank < hi)
+    # negative first: last |k| of the post-offset window
+    neg = (k < 0)
+    tail_lo = jnp.maximum(row_total[seg] + k, lo)
+    paged = jnp.where(neg, keep & (rank >= tail_lo), paged)
+
+    snt = sentinel(indices.dtype)
+    m_nbrs = jnp.where(paged, nbrs, snt)
+    m_seg = jnp.where(paged, seg, jnp.int32(2**31 - 1))
+    m_pos = jnp.where(paged, edge_pos, 0)
+    # compact kept edges to the front, preserving CSR row order (slots are
+    # already ordered by (seg, within-row)); stable order under sort of
+    # slot keys: use the slot index where paged, else edge_cap
+    slot_key = jnp.where(paged, jnp.arange(edge_cap, dtype=jnp.int32),
+                         jnp.int32(edge_cap))
+    order = jnp.argsort(slot_key)
+    c_nbrs = m_nbrs[order]
+    c_seg = m_seg[order]
+    c_pos = m_pos[order]
+    n_kept = jnp.sum(paged.astype(jnp.int32))
+
+    nxt, n_unique = sort_unique_count(m_nbrs, out_cap)
+    return c_nbrs, c_seg, c_pos, n_kept, nxt, n_unique, total
