@@ -36,6 +36,20 @@ def cmd_alpha(args) -> int:
     grpc_server, grpc_port = make_server(
         alpha, f"{cfg.http_addr}:{cfg.grpc_port}")
     grpc_server.start()
+    if args.zero:
+        # cluster mode: Zero leases + membership + tablet routing
+        from dgraph_tpu.cluster.groups import Groups
+        from dgraph_tpu.cluster.zero import RemoteOracle, ZeroClient
+        zero = ZeroClient(args.zero)
+        alpha.oracle = RemoteOracle(zero)
+        alpha.xidmap._oracle = alpha.oracle
+        base = alpha.mvcc.base
+        alpha.groups = Groups(
+            zero, f"{cfg.http_addr}:{grpc_port}", group=args.group,
+            max_ts=alpha.mvcc.base_ts,
+            max_uid=int(base.uids[-1]) if base.n_nodes else 0)
+        log.info("joined cluster: node=%d group=%d",
+                 alpha.groups.node_id, alpha.groups.gid)
     http_server = make_http_server(alpha, cfg.http_addr, cfg.http_port)
     serve_background(http_server)
     log.info("alpha up: grpc=%d http=%d", grpc_port,
@@ -49,39 +63,17 @@ def cmd_alpha(args) -> int:
 
 
 def cmd_zero(args) -> int:
-    # Standalone oracle service (reference: dgraph zero). The in-process
-    # Alpha embeds its own oracle; a standalone zero serves uid/ts leases
-    # to external loaders over gRPC.
-    import grpc
-    from concurrent import futures
-    from dgraph_tpu.cluster.oracle import Oracle
-    from dgraph_tpu.protos import task_pb2 as pb
+    # Standalone cluster manager (reference: dgraph zero): ts/uid leases,
+    # commit arbitration, membership, tablet assignment — the full
+    # pb.Zero surface (cluster/zero.py).
+    from dgraph_tpu.cluster.zero import ZeroState, make_zero_server
 
     xlog.setup(args.log_level)
     log = xlog.get("zero")
-    oracle = Oracle()
-
-    def assign(req, ctx):
-        r = oracle.assign_uids(int(req.num))
-        return pb.AssignedIds(start_id=r.start, end_id=r.stop - 1)
-
-    def timestamps(req, ctx):
-        ts = oracle.read_ts()
-        return pb.AssignedIds(start_id=ts, end_id=ts)
-
-    server = grpc.server(futures.ThreadPoolExecutor(max_workers=4))
-    server.add_generic_rpc_handlers((
-        grpc.method_handlers_generic_handler("dgraph_tpu.Zero", {
-            "AssignUids": grpc.unary_unary_rpc_method_handler(
-                assign, request_deserializer=pb.AssignRequest.FromString,
-                response_serializer=lambda m: m.SerializeToString()),
-            "Timestamps": grpc.unary_unary_rpc_method_handler(
-                timestamps, request_deserializer=pb.AssignRequest.FromString,
-                response_serializer=lambda m: m.SerializeToString()),
-        }),))
-    port = server.add_insecure_port(f"127.0.0.1:{args.port}")
+    server, port, _state = make_zero_server(
+        ZeroState(replicas=args.replicas), f"127.0.0.1:{args.port}")
     server.start()
-    log.info("zero up: grpc=%d", port)
+    log.info("zero up: grpc=%d replicas=%d", port, args.replicas)
     server.wait_for_termination()
     return 0
 
@@ -162,11 +154,17 @@ def main(argv=None) -> int:
     p.add_argument("--config", default=None)
     p.add_argument("--http_port", type=int, default=None)
     p.add_argument("--grpc_port", type=int, default=None)
+    p.add_argument("--zero", default=None,
+                   help="zero address → join a cluster")
+    p.add_argument("--group", type=int, default=0,
+                   help="raft-group analog to join (0 = zero picks)")
     p.add_argument("--log_level", default="info")
     p.set_defaults(fn=cmd_alpha)
 
-    p = sub.add_parser("zero", help="run the cluster oracle service")
+    p = sub.add_parser("zero", help="run the cluster manager service")
     p.add_argument("--port", type=int, default=5080)
+    p.add_argument("--replicas", type=int, default=1,
+                   help="replicas per group (elasticity knob)")
     p.add_argument("--log_level", default="info")
     p.set_defaults(fn=cmd_zero)
 

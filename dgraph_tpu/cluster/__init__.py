@@ -1,0 +1,25 @@
+
+def start_cluster_alpha(zero_target: str, base=None, group: int = 0,
+                        device_threshold: int = 512, addr: str = "127.0.0.1:0"):
+    """Boot one cluster-mode Alpha: grpc server + Zero connect + Groups.
+
+    Returns (alpha, grpc_server, bound_addr). Reference: alpha run() —
+    serve pb.Worker, Connect to Zero for node id + group assignment, then
+    keep membership fresh (SURVEY §3.4).
+    """
+    from dgraph_tpu.cluster.groups import Groups
+    from dgraph_tpu.cluster.zero import RemoteOracle, ZeroClient
+    from dgraph_tpu.server.api import Alpha
+    from dgraph_tpu.server.task import make_server
+
+    zero = ZeroClient(zero_target)
+    alpha = Alpha(base=base, device_threshold=device_threshold,
+                  oracle=RemoteOracle(zero))
+    server, port = make_server(alpha, addr)
+    server.start()
+    bound = f"127.0.0.1:{port}"
+    alpha.groups = Groups(
+        zero, bound, group=group, max_ts=alpha.mvcc.base_ts,
+        max_uid=int(base.uids[-1]) if base is not None and base.n_nodes
+        else 0)
+    return alpha, server, bound

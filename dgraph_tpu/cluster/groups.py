@@ -1,0 +1,102 @@
+"""Groups: cluster membership, tablet routing, connection pooling.
+
+Reference parity: `worker/groups.go` (`groups()`, `BelongsTo`, tablet map
+kept fresh from Zero's membership stream) + `conn/pool.go` (one cached
+gRPC channel per peer address, reused by every request). Membership is
+refreshed by polling Zero's counter; tablet claims go through ShouldServe
+exactly as the reference's first-asker rule.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import grpc
+
+from dgraph_tpu.cluster.zero import ZeroClient
+
+
+class Groups:
+    def __init__(self, zero: ZeroClient, my_addr: str, group: int = 0,
+                 max_ts: int = 0, max_uid: int = 0):
+        self.zero = zero
+        self.my_addr = my_addr
+        self.node_id, self.gid = zero.connect(my_addr, group,
+                                              max_ts=max_ts,
+                                              max_uid=max_uid)
+        self._lock = threading.Lock()
+        self._pools: dict[str, object] = {}
+        self._tablets: dict[str, int] = {}
+        self._groups: dict[int, dict[int, str]] = {}
+        self._counter = -1
+        self.refresh()
+
+    # -- membership ----------------------------------------------------------
+    def refresh(self) -> None:
+        st = self.zero.membership()
+        with self._lock:
+            self._counter = int(st.counter)
+            self._tablets = {}
+            self._groups = {}
+            for gid, g in st.groups.items():
+                self._groups[int(gid)] = {int(n): a
+                                          for n, a in g.nodes.items()}
+                for p in g.tablets:
+                    self._tablets[p] = int(gid)
+
+    def tablet_owner(self, pred: str, claim: bool = True) -> int | None:
+        """Owning group of a predicate; unowned predicates are claimed for
+        THIS group (reference: ShouldServe first-asker)."""
+        with self._lock:
+            owner = self._tablets.get(pred)
+        if owner is not None:
+            return owner
+        self.refresh()
+        with self._lock:
+            owner = self._tablets.get(pred)
+        if owner is None and claim:
+            owner = self.zero.should_serve(pred, self.gid)
+            self.refresh()
+        return owner
+
+    def serves(self, pred: str) -> bool:
+        return self.tablet_owner(pred) == self.gid
+
+    def group_addrs(self, gid: int) -> list[str]:
+        with self._lock:
+            return sorted(self._groups.get(gid, {}).values())
+
+    def other_addrs(self) -> list[str]:
+        """Every node in the cluster except this one (broadcast targets).
+        Always re-polls membership first: a commit must reach nodes that
+        joined after our last refresh (reference: the membership stream
+        keeps this continuously fresh; polling at each broadcast is the
+        same guarantee at our scale)."""
+        self.refresh()
+        with self._lock:
+            return sorted({a for nodes in self._groups.values()
+                           for a in nodes.values() if a != self.my_addr})
+
+    # -- conn pooling ---------------------------------------------------------
+    def pool(self, addr: str):
+        """Cached worker client per peer address (conn/pool.go)."""
+        from dgraph_tpu.server.task import Client
+        with self._lock:
+            c = self._pools.get(addr)
+            if c is None:
+                c = self._pools[addr] = Client(addr)
+            return c
+
+    def call_group(self, gid: int, fn):
+        """Run `fn(client)` against any live node of a group, trying
+        replicas in order — read failover (reference: reads served by any
+        replica; pool pick + retry)."""
+        last = None
+        for addr in self.group_addrs(gid):
+            try:
+                return fn(self.pool(addr))
+            except grpc.RpcError as e:
+                last = e
+                continue
+        raise last if last is not None else RuntimeError(
+            f"group {gid} has no nodes")

@@ -1,0 +1,62 @@
+"""Routed read views: transparent foreign-tablet access for the engine.
+
+Reference parity: the read half of `worker/task.go ProcessTaskOverNetwork`
+— a query touching a predicate another group owns goes over the wire. The
+TPU build's shared dense rank space lets the routing live BELOW the
+engine: a routed view looks exactly like a local Store, but predicate data
+the local node doesn't maintain is pulled from the owning group as a
+whole-tablet snapshot (cluster/tablet.py) and cached by version. The
+engine, kernels, and renderer are untouched — they cannot tell a pulled
+tablet from a local one.
+
+Freshness: every node learns each tablet's latest commit_ts from the
+mutation broadcast (Alpha.apply_committed), even for predicates it does
+not apply. A cached foreign tablet is valid while its version matches;
+reads at older timestamps fetch an as-of snapshot without caching.
+"""
+
+from __future__ import annotations
+
+from dgraph_tpu.store.store import Store
+
+
+class _RoutedPreds(dict):
+    """preds mapping that faults in foreign tablets on access."""
+
+    def __init__(self, local: dict, alpha, read_ts: int):
+        super().__init__(local)
+        self.alpha = alpha
+        self.read_ts = read_ts
+
+    def _fetch(self, pred):
+        pd = self.alpha._fetch_tablet(pred, self.read_ts)
+        if pd is not None:
+            super().__setitem__(pred, pd)
+        return pd
+
+    def get(self, pred, default=None):
+        present = dict.__contains__(self, pred) or None
+        if self.alpha._needs_fetch(pred, self.read_ts, present):
+            pd = self._fetch(pred)
+            return pd if pd is not None else default
+        return super().get(pred, default)
+
+    def __getitem__(self, pred):
+        out = self.get(pred)
+        if out is None:
+            raise KeyError(pred)
+        return out
+
+    def __contains__(self, pred):
+        return self.get(pred) is not None
+
+
+def routed_view(alpha, store: Store, read_ts: int) -> Store:
+    """Wrap a local read view so foreign predicates resolve remotely."""
+    rs = object.__new__(Store)
+    rs.uids = store.uids
+    rs.schema = store.schema
+    rs.preds = _RoutedPreds(store.preds, alpha, read_ts)
+    rs._device = {}
+    rs._empty_rel = store._empty_rel
+    return rs

@@ -1,0 +1,283 @@
+"""Zero: the standalone cluster manager service.
+
+Reference parity: `dgraph/cmd/zero/` — group-0 authority for timestamp and
+uid leases (assign.go), txn commit arbitration (oracle.go), Alpha
+membership (Connect + membership stream), and tablet→group assignment
+(tablet.go ShouldServe: first group to ask for an unowned predicate gets
+it). The reference replicates this state machine via group-0 Raft; here it
+is one process whose state is the cluster's source of truth — Alphas are
+stateless against it (restart = reconnect), which matches the
+reloadable-sidecar failure model (SURVEY §5).
+
+Membership is polled (`Membership` RPC + a change counter) instead of
+streamed — same information, simpler transport.
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent import futures
+
+import grpc
+
+from dgraph_tpu.cluster.oracle import Oracle, TxnAborted
+from dgraph_tpu.protos import task_pb2 as pb
+
+SERVICE_ZERO = "dgraph_tpu.Zero"
+
+
+class ZeroState:
+    """Membership + tablets + the oracle, under one lock."""
+
+    def __init__(self, replicas: int = 1):
+        self.oracle = Oracle()
+        self.replicas = replicas
+        self._lock = threading.Lock()
+        self._next_node = 1
+        self._next_group = 1
+        # group_id -> {node_id: addr}
+        self.groups: dict[int, dict[int, str]] = {}
+        # pred -> group_id
+        self.tablets: dict[str, int] = {}
+        self.counter = 0
+
+    def connect(self, addr: str, group: int = 0, max_ts: int = 0,
+                max_uid: int = 0) -> tuple[int, int]:
+        """Join the cluster (reference: zero.Server.Connect). With group=0
+        Zero fills existing groups up to `replicas` before opening a new
+        one — the --replicas elasticity model. The joiner's persisted
+        watermarks bump the lease space: a node with replayed history must
+        never see Zero hand out timestamps or uids below what it already
+        holds (reference: Zero restores these from its raft snapshot; this
+        Zero is memory-only, so joiners carry them)."""
+        self.oracle.bump_ts(max_ts)
+        if max_uid:
+            self.oracle.bump_uid(max_uid)
+        with self._lock:
+            node_id = self._next_node
+            self._next_node += 1
+            gid = group
+            if not gid:
+                for g, nodes in sorted(self.groups.items()):
+                    if len(nodes) < self.replicas:
+                        gid = g
+                        break
+                else:
+                    gid = self._next_group
+            self.groups.setdefault(gid, {})[node_id] = addr
+            self._next_group = max(self._next_group, gid + 1)
+            self.counter += 1
+            return node_id, gid
+
+    def remove_node(self, node_id: int) -> None:
+        """Operator removal (reference: /removeNode)."""
+        with self._lock:
+            for nodes in self.groups.values():
+                nodes.pop(node_id, None)
+            self.counter += 1
+
+    def should_serve(self, pred: str, group: int) -> int:
+        """Tablet assignment: first group to ask for an unowned predicate
+        gets it (reference: zero/tablet.go ShouldServe)."""
+        with self._lock:
+            owner = self.tablets.get(pred)
+            if owner is None:
+                self.tablets[pred] = owner = group
+                self.counter += 1
+            return owner
+
+    def membership(self) -> pb.MembershipState:
+        with self._lock:
+            st = pb.MembershipState(counter=self.counter)
+            for gid, nodes in self.groups.items():
+                g = pb.Group()
+                for nid, addr in nodes.items():
+                    g.nodes[nid] = addr
+                g.tablets.extend(
+                    sorted(p for p, og in self.tablets.items() if og == gid))
+                st.groups[gid].CopyFrom(g)
+            return st
+
+
+class ZeroService:
+    def __init__(self, state: ZeroState):
+        self.state = state
+
+    def Connect(self, req: pb.ConnectRequest, ctx) -> pb.ConnectResponse:
+        nid, gid = self.state.connect(req.addr, int(req.group),
+                                      int(req.max_ts), int(req.max_uid))
+        return pb.ConnectResponse(node_id=nid, group_id=gid)
+
+    def Membership(self, req: pb.Empty, ctx) -> pb.MembershipState:
+        return self.state.membership()
+
+    def ShouldServe(self, req: pb.TabletRequest, ctx) -> pb.Tablet:
+        owner = self.state.should_serve(req.pred, int(req.group))
+        return pb.Tablet(pred=req.pred, group=owner)
+
+    def Timestamps(self, req: pb.TsRequest, ctx) -> pb.AssignedIds:
+        o = self.state.oracle
+        ts = o.read_only_ts() if req.read_only else o.read_ts()
+        return pb.AssignedIds(start_id=ts, end_id=ts)
+
+    def AssignUids(self, req: pb.AssignRequest, ctx) -> pb.AssignedIds:
+        r = self.state.oracle.assign_uids(int(req.num))
+        return pb.AssignedIds(start_id=r.start, end_id=r.stop - 1)
+
+    def Commit(self, req: pb.CommitRequest, ctx) -> pb.TxnContext:
+        if req.abort:
+            self.state.oracle.abort(int(req.start_ts))
+            return pb.TxnContext(start_ts=req.start_ts, aborted=True)
+        try:
+            cts = self.state.oracle.commit(int(req.start_ts),
+                                           list(req.keys))
+        except TxnAborted as e:
+            ctx.abort(grpc.StatusCode.ABORTED, str(e))
+        return pb.TxnContext(start_ts=req.start_ts, commit_ts=cts)
+
+
+def _unary(fn, req_cls):
+    return grpc.unary_unary_rpc_method_handler(
+        fn, request_deserializer=req_cls.FromString,
+        response_serializer=lambda m: m.SerializeToString())
+
+
+def make_zero_server(state: ZeroState | None = None,
+                     addr: str = "127.0.0.1:0", max_workers: int = 8):
+    """Build (grpc server, bound port, state)."""
+    state = state or ZeroState()
+    svc = ZeroService(state)
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+    server.add_generic_rpc_handlers((
+        grpc.method_handlers_generic_handler(SERVICE_ZERO, {
+            "Connect": _unary(svc.Connect, pb.ConnectRequest),
+            "Membership": _unary(svc.Membership, pb.Empty),
+            "ShouldServe": _unary(svc.ShouldServe, pb.TabletRequest),
+            "Timestamps": _unary(svc.Timestamps, pb.TsRequest),
+            "AssignUids": _unary(svc.AssignUids, pb.AssignRequest),
+            "Commit": _unary(svc.Commit, pb.CommitRequest),
+        }),))
+    port = server.add_insecure_port(addr)
+    return server, port, state
+
+
+class ZeroClient:
+    """Client to a Zero service (reference: the zero conn every Alpha
+    holds)."""
+
+    def __init__(self, target: str):
+        self.channel = grpc.insecure_channel(target)
+
+    def _call(self, method: str, req, resp_cls):
+        rpc = self.channel.unary_unary(
+            f"/{SERVICE_ZERO}/{method}",
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=resp_cls.FromString)
+        return rpc(req)
+
+    def connect(self, addr: str, group: int = 0, max_ts: int = 0,
+                max_uid: int = 0) -> tuple[int, int]:
+        r = self._call("Connect", pb.ConnectRequest(
+            addr=addr, group=group, max_ts=max_ts, max_uid=max_uid),
+            pb.ConnectResponse)
+        return int(r.node_id), int(r.group_id)
+
+    def membership(self) -> pb.MembershipState:
+        return self._call("Membership", pb.Empty(), pb.MembershipState)
+
+    def should_serve(self, pred: str, group: int) -> int:
+        r = self._call("ShouldServe",
+                       pb.TabletRequest(pred=pred, group=group), pb.Tablet)
+        return int(r.group)
+
+    def read_ts(self) -> int:
+        r = self._call("Timestamps", pb.TsRequest(num=1), pb.AssignedIds)
+        return int(r.start_id)
+
+    def read_only_ts(self) -> int:
+        r = self._call("Timestamps", pb.TsRequest(num=1, read_only=True),
+                       pb.AssignedIds)
+        return int(r.start_id)
+
+    def assign_uids(self, n: int) -> range:
+        r = self._call("AssignUids", pb.AssignRequest(num=n),
+                       pb.AssignedIds)
+        return range(int(r.start_id), int(r.end_id) + 1)
+
+    def commit(self, start_ts: int, keys) -> int:
+        try:
+            r = self._call("Commit", pb.CommitRequest(
+                start_ts=start_ts, keys=sorted(keys)), pb.TxnContext)
+        except grpc.RpcError as e:
+            if e.code() == grpc.StatusCode.ABORTED:
+                raise TxnAborted(e.details()) from None
+            raise
+        return int(r.commit_ts)
+
+    def abort(self, start_ts: int) -> None:
+        self._call("Commit", pb.CommitRequest(start_ts=start_ts, abort=True),
+                   pb.TxnContext)
+
+    def close(self):
+        self.channel.close()
+
+
+class RemoteOracle:
+    """Oracle facade backed by a Zero service — what an Alpha's txn path
+    talks to in cluster mode (reference: Alphas never arbitrate commits
+    themselves; Zero's oracle does). Local bookkeeping only tracks which
+    timestamps THIS node handed out, for its own gc watermark."""
+
+    def __init__(self, zero: ZeroClient):
+        self.zero = zero
+        self._lock = threading.Lock()
+        self._local_pending: set[int] = set()
+        self._max_seen = 0
+
+    def read_ts(self) -> int:
+        ts = self.zero.read_ts()
+        with self._lock:
+            self._local_pending.add(ts)
+            self._max_seen = max(self._max_seen, ts)
+        return ts
+
+    def read_only_ts(self) -> int:
+        ts = self.zero.read_only_ts()
+        with self._lock:
+            self._max_seen = max(self._max_seen, ts)
+        return ts
+
+    def assign_uids(self, n: int) -> range:
+        return self.zero.assign_uids(n)
+
+    def commit(self, start_ts: int, conflict_keys) -> int:
+        cts = self.zero.commit(start_ts, list(conflict_keys))
+        with self._lock:
+            self._local_pending.discard(start_ts)
+            self._max_seen = max(self._max_seen, cts)
+        return cts
+
+    def abort(self, start_ts: int) -> None:
+        with self._lock:
+            self._local_pending.discard(start_ts)
+        self.zero.abort(start_ts)
+
+    def min_active_ts(self) -> int:
+        with self._lock:
+            return (min(self._local_pending) if self._local_pending
+                    else self._max_seen + 1)
+
+    def gc(self) -> int:
+        return self.min_active_ts()
+
+    @property
+    def max_assigned(self) -> int:
+        with self._lock:
+            return self._max_seen
+
+    def bump_ts(self, ts: int) -> None:
+        with self._lock:
+            self._max_seen = max(self._max_seen, ts)
+
+    def bump_uid(self, uid: int) -> None:
+        pass  # Zero owns the uid lease space

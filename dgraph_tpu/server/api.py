@@ -39,13 +39,21 @@ class Alpha:
     """Single-process data server: oracle + MVCC store + query engine."""
 
     def __init__(self, base: Store | None = None,
-                 device_threshold: int = 512, wal=None, base_ts: int = 0):
-        self.oracle = Oracle()
+                 device_threshold: int = 512, wal=None, base_ts: int = 0,
+                 oracle=None, groups=None):
+        self.oracle = oracle if oracle is not None else Oracle()
         self.mvcc = MVCCStore(base=base, base_ts=base_ts)
         self.oracle.bump_ts(base_ts)
         self.xidmap = XidMap(self.oracle)
         self.device_threshold = device_threshold
         self.wal = wal  # store.wal.WAL | None: fsync'd commit log
+        self.groups = groups  # cluster.groups.Groups | None
+        # tablet freshness learned from the mutation broadcast: pred →
+        # latest commit_ts anywhere; _stale_preds = foreign tablets whose
+        # latest version this node has NOT applied locally
+        self.tablet_versions: dict[str, int] = {}
+        self._stale_preds: set[str] = set()
+        self._tablet_cache: dict[tuple[str, int], object] = {}
         self._apply_lock = threading.Lock()
         self._state_lock = threading.Lock()
         self._open_txns: dict[int, Txn] = {}
@@ -151,6 +159,9 @@ class Alpha:
         best-effort/read-only txn)."""
         with self._reading(read_ts) as ts:
             store = self.mvcc.read_view(ts)
+            if self.groups is not None:
+                from dgraph_tpu.cluster.routed import routed_view
+                store = routed_view(self, store, ts)
             out = Engine(store, device_threshold=self.device_threshold
                          ).query(dql, variables)
         self._maybe_gc()
@@ -198,14 +209,14 @@ class Alpha:
         schema.Update + posting.RebuildIndex). The new snapshot is built
         under the merged schema and swapped in atomically, so concurrent
         queries see either fully-old or fully-new index state."""
-        new = parse_schema(schema_text)
-        with self._apply_lock:
-            merged = self.mvcc.schema.clone()
-            merged.update(new)
-            if self.wal is not None:
-                self.wal.append_schema(schema_text,
-                                       self.oracle.read_only_ts())
-            self.mvcc.rebuild_base(schema=merged)
+        self.apply_schema_broadcast(schema_text)
+        if self.groups is not None:
+            import grpc as _grpc
+            for addr in self.groups.other_addrs():
+                try:
+                    self.groups.pool(addr).apply_schema(schema_text)
+                except _grpc.RpcError:
+                    continue
 
     def drop_all(self) -> None:
         """reference: api.Operation{DropAll}."""
@@ -222,6 +233,9 @@ class Alpha:
         with self._apply_lock:
             commit_ts = self.oracle.commit(
                 txn.start_ts, txn.mutation.conflict_keys(self.mvcc.schema))
+            if self.groups is not None:
+                self._apply_and_broadcast(txn.mutation, commit_ts)
+                return commit_ts
             # write-ahead: on disk before the in-memory apply, so a crash
             # between the two replays the record (reference: raft entry
             # fsync before posting-list apply)
@@ -229,6 +243,130 @@ class Alpha:
                 self.wal.append(txn.mutation, commit_ts)
             self.mvcc.apply(txn.mutation, commit_ts)
             return commit_ts
+
+    # -- cluster write/read plumbing (worker/draft.go + task.go analogs) -----
+    def _apply_and_broadcast(self, mut: Mutation, commit_ts: int) -> None:
+        """Synchronous log shipping: apply the owned subset locally, then
+        send the full mutation to every other node — each applies its own
+        group's tablets plus the vocab touches, so replicas of a group
+        converge and the dense rank space stays cluster-wide identical
+        (reference: MutateOverNetwork fan-out + raft replication within
+        each group, collapsed into one broadcast)."""
+        import grpc as _grpc
+
+        from dgraph_tpu.store.wal import mut_to_bytes
+        self.apply_committed(mut, commit_ts)
+        payload = mut_to_bytes(mut)
+        for addr in self.groups.other_addrs():
+            try:
+                self.groups.pool(addr).apply_mutation(payload, commit_ts)
+            except _grpc.RpcError as e:
+                # v1: a dead node misses the record and must rejoin from a
+                # fresh snapshot (no raft catch-up log yet); reads keep
+                # serving from surviving replicas
+                from dgraph_tpu.utils import logging as xlog
+                xlog.get("alpha").warning(
+                    "broadcast of commit_ts %d to %s failed: %s",
+                    commit_ts, addr, e.code() if hasattr(e, "code") else e)
+                continue
+
+    def apply_committed(self, mut: Mutation, commit_ts: int) -> None:
+        """Install a committed mutation on THIS node: the subset of
+        predicates this group serves plus the vocabulary touches. Also the
+        receive path of the broadcast (WorkerService.ApplyMutation)."""
+        if self.groups is None:
+            if self.wal is not None:
+                self.wal.append(mut, commit_ts)
+            self.mvcc.apply(mut, commit_ts)
+            return
+        touched = {e[1] for e in mut.edge_sets + mut.edge_dels} | \
+                  {v[1] for v in mut.val_sets + mut.val_dels}
+        owned = {p for p in touched if self.groups.serves(p)}
+        sub = mut.restrict(owned)
+        with self._state_lock:
+            for p in touched:
+                self.tablet_versions[p] = max(
+                    self.tablet_versions.get(p, 0), commit_ts)
+                if p not in owned:
+                    self._stale_preds.add(p)
+        try:
+            if self.wal is not None:
+                self.wal.append(sub, commit_ts)
+            self.mvcc.apply(sub, commit_ts)
+        except ValueError:
+            # straggler below a fold point (another coordinator's commit
+            # raced a local rollup/alter). Foreign tablets recover via the
+            # owner refetch path; OWNED data in this record is lost until
+            # a snapshot resync — log loudly (v1: no raft catch-up log).
+            from dgraph_tpu.utils import logging as xlog
+            xlog.get("alpha").error(
+                "straggler commit_ts %d below fold point; marking %s stale",
+                commit_ts, sorted(touched))
+            with self._state_lock:
+                self._stale_preds.update(touched)
+
+    def _needs_fetch(self, pred: str, read_ts: int,
+                     present_locally) -> bool:
+        """Does a routed view need to pull this tablet from its owner?"""
+        if self.groups is None:
+            return False
+        with self._state_lock:
+            stale = pred in self._stale_preds
+        if stale:
+            return True
+        return present_locally is None and not self.groups.serves(pred)
+
+    def _fetch_tablet(self, pred: str, read_ts: int):
+        """Pull a foreign tablet snapshot as-of read_ts from its owning
+        group (any live replica), caching latest-version pulls
+        (reference: Badger Stream tablet snapshot shipping).
+
+        Cache keys carry the read view's vocabulary size: tablet blobs are
+        rank-indexed, and ANY commit can grow the (monotone) vocabulary
+        and shift ranks — a blob fetched under an older vocab must never
+        serve a newer read view. Equal sizes on one node imply equal
+        vocabularies because growth is append-only-set monotone."""
+        gid = self.groups.tablet_owner(pred, claim=False)
+        if gid is None or gid == self.groups.gid:
+            return None
+        n_vocab = self.mvcc.read_view(read_ts).n_nodes
+        with self._state_lock:
+            version = self.tablet_versions.get(pred, 0)
+            if read_ts >= version:
+                cached = self._tablet_cache.get((pred, version, n_vocab))
+                if cached is not None:
+                    return cached
+        from dgraph_tpu.cluster.tablet import unpack_tablet
+        blob, got_version = self.groups.call_group(
+            gid, lambda c: c.tablet_snapshot(pred, read_ts))
+        if not blob:
+            return None
+        pd = unpack_tablet(blob, pred, self.mvcc.schema)
+        with self._state_lock:
+            # trust the OWNER's version: a broadcast still in flight (or
+            # dropped) may have produced a blob newer than we knew — such
+            # a blob must not be cached under the stale local version or
+            # an older-ts reader would see future writes
+            version = max(version, got_version)
+            self.tablet_versions[pred] = max(
+                self.tablet_versions.get(pred, 0), got_version)
+            if read_ts >= version:
+                self._tablet_cache[(pred, version, n_vocab)] = pd
+                for k in [k for k in self._tablet_cache
+                          if k[0] == pred and k[1:] != (version, n_vocab)]:
+                    del self._tablet_cache[k]
+        return pd
+
+    def apply_schema_broadcast(self, schema_text: str) -> None:
+        """Receive an Alter from another coordinator (no re-broadcast)."""
+        new = parse_schema(schema_text)
+        with self._apply_lock:
+            merged = self.mvcc.schema.clone()
+            merged.update(new)
+            if self.wal is not None:
+                self.wal.append_schema(schema_text,
+                                       self.oracle.read_only_ts())
+            self.mvcc.rebuild_base(schema=merged)
 
     def _txn_done(self, txn: "Txn") -> None:
         with self._state_lock:

@@ -137,6 +137,30 @@ class WorkerService:
                 uids=store.uid_of(flat).astype(np.uint64).tolist()),
             edges_traversed=int(len(nbrs)))
 
+    # -- cluster seams (worker/draft.go apply + snapshot shipping) ----------
+    def ApplyMutation(self, req: pb.MutationMsg, ctx) -> pb.Payload:
+        """Receive a committed-mutation broadcast (log shipping)."""
+        if req.schema:
+            self.alpha.apply_schema_broadcast(req.schema)
+            return pb.Payload(data=b"ok")
+        from dgraph_tpu.store.wal import mut_from_bytes
+        self.alpha.apply_committed(mut_from_bytes(req.mut_json),
+                                   int(req.commit_ts))
+        return pb.Payload(data=b"ok")
+
+    def TabletSnapshot(self, req: pb.TabletSnapshotRequest,
+                       ctx) -> pb.TabletSnapshot:
+        """Serve a whole-tablet snapshot as-of read_ts (reference: Badger
+        Stream snapshot / tablet move source)."""
+        from dgraph_tpu.cluster.tablet import pack_tablet
+        with self.alpha._reading(int(req.read_ts) or None) as ts:
+            store = self.alpha.mvcc.read_view(ts)
+            pd = store.preds.get(req.attr)
+            version = self.alpha.tablet_versions.get(req.attr, 0)
+            if pd is None:
+                return pb.TabletSnapshot(blob=b"", version=version)
+            return pb.TabletSnapshot(blob=pack_tablet(pd), version=version)
+
 
 def _unary(fn, req_cls):
     return grpc.unary_unary_rpc_method_handler(
@@ -160,6 +184,9 @@ def make_server(alpha: Alpha, addr: str = "127.0.0.1:0",
         }),
         grpc.method_handlers_generic_handler(SERVICE_WORKER, {
             "ServeTask": _unary(w.ServeTask, pb.TaskQuery),
+            "ApplyMutation": _unary(w.ApplyMutation, pb.MutationMsg),
+            "TabletSnapshot": _unary(w.TabletSnapshot,
+                                     pb.TabletSnapshotRequest),
         }),
     ))
     port = server.add_insecure_port(addr)
@@ -204,6 +231,21 @@ class Client:
     def serve_task(self, **kw) -> pb.TaskResult:
         return self._call(SERVICE_WORKER, "ServeTask",
                           pb.TaskQuery(**kw), pb.TaskResult)
+
+    def apply_mutation(self, mut_json: bytes, commit_ts: int) -> None:
+        self._call(SERVICE_WORKER, "ApplyMutation",
+                   pb.MutationMsg(mut_json=mut_json, commit_ts=commit_ts),
+                   pb.Payload)
+
+    def apply_schema(self, schema_text: str) -> None:
+        self._call(SERVICE_WORKER, "ApplyMutation",
+                   pb.MutationMsg(schema=schema_text), pb.Payload)
+
+    def tablet_snapshot(self, attr: str, read_ts: int = 0):
+        r = self._call(SERVICE_WORKER, "TabletSnapshot",
+                       pb.TabletSnapshotRequest(attr=attr, read_ts=read_ts),
+                       pb.TabletSnapshot)
+        return bytes(r.blob), int(r.version)
 
     def close(self):
         self.channel.close()

@@ -40,6 +40,31 @@ class Mutation:
     edge_dels: list = field(default_factory=list)   # (s, pred, o|None)
     val_sets: list = field(default_factory=list)    # (s, pred, v, lang[, facets])
     val_dels: list = field(default_factory=list)    # (s, pred, None, lang)
+    # uids to register in the vocabulary even without local postings —
+    # cluster mode ships these to every group so the shared dense rank
+    # space stays identical on all nodes (SURVEY §7 hard part 2)
+    touch_uids: list = field(default_factory=list)
+
+    def all_uids(self) -> set:
+        """Every uid this mutation mentions (vocab sync set)."""
+        out = set(self.touch_uids)
+        for s, _p, o, *_ in self.edge_sets:
+            out.add(s)
+            out.add(o)
+        for s, _p, *_ in self.edge_dels + self.val_sets + self.val_dels:
+            out.add(s)
+        return out
+
+    def restrict(self, preds) -> "Mutation":
+        """Subset for the tablets in `preds`, carrying the FULL vocab set
+        (reference: per-group pb.Mutations split in MutateOverNetwork)."""
+        return Mutation(
+            edge_sets=[e for e in self.edge_sets if e[1] in preds],
+            edge_dels=[e for e in self.edge_dels if e[1] in preds],
+            val_sets=[v for v in self.val_sets if v[1] in preds],
+            val_dels=[v for v in self.val_dels if v[1] in preds],
+            touch_uids=sorted(self.all_uids()),
+        )
 
     def conflict_keys(self, schema=None):
         """Keys Zero arbitrates on, as deterministic serialized strings
@@ -68,7 +93,7 @@ class Mutation:
 
     def is_empty(self) -> bool:
         return not (self.edge_sets or self.edge_dels
-                    or self.val_sets or self.val_dels)
+                    or self.val_sets or self.val_dels or self.touch_uids)
 
 
 @dataclass
@@ -107,13 +132,17 @@ class MVCCStore:
     # -- write path ---------------------------------------------------------
     def apply(self, mut: Mutation, commit_ts: int) -> None:
         """Install a committed delta layer (reference: oracle watermark
-        moving a txn's mutable layer to committed at commit_ts)."""
+        moving a txn's mutable layer to committed at commit_ts). Layers
+        may arrive OUT OF ORDER in cluster mode (broadcasts from multiple
+        coordinators race); they are kept sorted by commit_ts."""
         with self._lock:
-            if self.layers and commit_ts <= self.layers[-1].commit_ts:
-                raise ValueError("commit_ts must be monotonic")
             if commit_ts <= self._history[-1][0]:
                 raise ValueError("commit_ts below newest fold point")
-            self.layers.append(_Layer(commit_ts, mut))
+            if any(l.commit_ts == commit_ts for l in self.layers):
+                raise ValueError(f"duplicate commit_ts {commit_ts}")
+            import bisect
+            bisect.insort(self.layers, _Layer(commit_ts, mut),
+                          key=lambda l: l.commit_ts)
 
     # -- read path ----------------------------------------------------------
     def read_view(self, read_ts: int) -> Store:
@@ -125,7 +154,9 @@ class MVCCStore:
                        if fold_ts < l.commit_ts <= read_ts]
             if not pending:
                 return fold_store
-            key = (fold_ts, pending[-1].commit_ts)
+            # key on the exact layer set: a late out-of-order arrival
+            # below an already-cached newest ts must not serve stale views
+            key = (fold_ts, tuple(l.commit_ts for l in pending))
             view = self._views.get(key)
             if view is None:
                 view = _materialize(fold_store, pending)
@@ -187,6 +218,10 @@ class MVCCStore:
             self._views = {k: v for k, v in self._views.items()
                            if k[0] >= floor}
 
+    # -- vocabulary ----------------------------------------------------------
+    # (rank-space contract: once a uid is in the vocabulary it never
+    # leaves — the reference likewise never reuses uids)
+
 
 def _materialize(base: Store, layers: list[_Layer],
                  schema: Schema | None = None) -> Store:
@@ -195,6 +230,12 @@ def _materialize(base: Store, layers: list[_Layer],
     import numpy as np
     b = StoreBuilder(schema=(schema if schema is not None
                              else base.schema.clone()))
+    # vocabulary is monotone: nodes with no local postings (cluster mode:
+    # foreign-tablet-only nodes) must keep their rank — preserve the whole
+    # base vocab plus every uid the deltas mention
+    b.touch_many(base.uids)
+    for layer_ in layers:
+        b.touch_many(sorted(layer_.mut.all_uids()))
 
     # live edges/values from base, as dicts for delete application
     edges: dict[str, set] = {}

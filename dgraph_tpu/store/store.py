@@ -298,6 +298,15 @@ class StoreBuilder:
         self._known_uids.add(subj)
         self._known_uids.add(obj)
 
+    def touch(self, uid: int) -> None:
+        """Register a uid in the vocabulary without any posting (cluster
+        vocab sync: nodes whose data lives on other groups still occupy a
+        rank so the dense rank space is identical everywhere)."""
+        self._known_uids.add(int(uid))
+
+    def touch_many(self, uids) -> None:
+        self._known_uids.update(int(u) for u in uids)
+
     def add_value(self, subj: int, pred: str, value, lang: str = "",
                   facets: dict | None = None) -> None:
         ps = self.schema.get(pred)

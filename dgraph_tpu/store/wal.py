@@ -21,6 +21,7 @@ from __future__ import annotations
 import json
 import os
 import struct
+import threading
 import zlib
 from typing import Iterator
 
@@ -59,7 +60,7 @@ def _enc_facets(f):
 
 
 def _mut_doc(mut: Mutation) -> dict:
-    return {
+    doc = {
         "es": [[s, p, o, _enc_facets(f)]
                for s, p, o, *rest in mut.edge_sets
                for f in [rest[0] if rest else None]],
@@ -69,6 +70,9 @@ def _mut_doc(mut: Mutation) -> dict:
                for f in [rest[0] if rest else None]],
         "vd": [[s, p, None, lang] for s, p, _v, lang in mut.val_dels],
     }
+    if mut.touch_uids:
+        doc["tu"] = [int(u) for u in mut.touch_uids]
+    return doc
 
 
 def _doc_mut(doc: dict) -> Mutation:
@@ -78,7 +82,18 @@ def _doc_mut(doc: dict) -> Mutation:
         val_sets=[(s, p, dec_scalar(v), lang, f)
                   for s, p, v, lang, f in doc["vs"]],
         val_dels=[(s, p, None, lang) for s, p, _v, lang in doc["vd"]],
+        touch_uids=list(doc.get("tu", [])),
     )
+
+
+def mut_to_bytes(mut: Mutation) -> bytes:
+    """Standalone Mutation codec (cluster broadcast payloads reuse the WAL
+    JSON encoding)."""
+    return json.dumps(_mut_doc(mut), separators=(",", ":")).encode()
+
+
+def mut_from_bytes(b: bytes) -> Mutation:
+    return _doc_mut(json.loads(b))
 
 
 class WAL:
@@ -98,16 +113,20 @@ class WAL:
                     f.truncate(valid_end)
                     f.flush()
                     os.fsync(f.fileno())
+        self._wlock = threading.Lock()
         self._f = open(path, "ab")
 
     def _write(self, doc: dict) -> None:
         payload = json.dumps(doc, separators=(",", ":")).encode()
         rec = MAGIC + _HEADER.pack(len(payload),
                                    zlib.crc32(payload)) + payload
-        self._f.write(rec)
-        self._f.flush()
-        if self.sync:
-            os.fsync(self._f.fileno())
+        # concurrent appenders (apply broadcasts race local commits) must
+        # not interleave record bytes
+        with self._wlock:
+            self._f.write(rec)
+            self._f.flush()
+            if self.sync:
+                os.fsync(self._f.fileno())
 
     def append(self, mut: Mutation, commit_ts: int) -> None:
         """Durably record a committed mutation. Called AFTER the oracle
@@ -126,23 +145,27 @@ class WAL:
 
     def truncate(self, upto_ts: int) -> None:
         """Drop records with commit_ts ≤ upto_ts (checkpoint just absorbed
-        them). Rewrites via temp file + atomic rename; the tail survives."""
-        keep = [(ts, kind, obj) for ts, kind, obj in replay(self.path)
-                if ts > upto_ts]
-        tmp = self.path + ".tmp"
-        with open(tmp, "wb") as f:
-            for ts, kind, obj in keep:
-                doc = ({"ts": ts, "m": _mut_doc(obj)} if kind == "mut"
-                       else {"ts": ts, "drop": 1} if kind == "drop"
-                       else {"ts": ts, "schema": obj})
-                payload = json.dumps(doc, separators=(",", ":")).encode()
-                f.write(MAGIC + _HEADER.pack(len(payload),
-                                             zlib.crc32(payload)) + payload)
-            f.flush()
-            os.fsync(f.fileno())
-        self._f.close()
-        os.replace(tmp, self.path)
-        self._f = open(self.path, "ab")
+        them). Rewrites via temp file + atomic rename; the tail survives.
+        Holds the write lock for the whole rewrite — a concurrent append
+        (broadcast receive path) must neither hit a closed file nor land
+        on the replaced inode."""
+        with self._wlock:
+            keep = [(ts, kind, obj) for ts, kind, obj in replay(self.path)
+                    if ts > upto_ts]
+            tmp = self.path + ".tmp"
+            with open(tmp, "wb") as f:
+                for ts, kind, obj in keep:
+                    doc = ({"ts": ts, "m": _mut_doc(obj)} if kind == "mut"
+                           else {"ts": ts, "drop": 1} if kind == "drop"
+                           else {"ts": ts, "schema": obj})
+                    payload = json.dumps(doc, separators=(",", ":")).encode()
+                    f.write(MAGIC + _HEADER.pack(
+                        len(payload), zlib.crc32(payload)) + payload)
+                f.flush()
+                os.fsync(f.fileno())
+            self._f.close()
+            os.replace(tmp, self.path)
+            self._f = open(self.path, "ab")
 
     def close(self) -> None:
         self._f.close()
