@@ -157,6 +157,10 @@ class Parser:
                 args.numpaths = int(val)
             elif key == "depth":
                 args.depth = int(val)
+            elif key == "minweight":
+                args.minweight = float(val)
+            elif key == "maxweight":
+                args.maxweight = float(val)
             else:
                 raise ParseError(f"unknown shortest arg {key!r}")
             self.accept(",")

@@ -342,9 +342,11 @@ class Executor:
             return node
         ranks = self.root_ranks(sg)
         ranks = self.apply_filter(sg.filters, ranks)
-        order_idx = (self.order_ranks(ranks, sg.orders)
-                     if sg.orders else np.arange(len(ranks)))
-        display = ranks[order_idx]
+        display = self._mesh_order_topk(sg, ranks)
+        if display is None:
+            order_idx = (self.order_ranks(ranks, sg.orders)
+                         if sg.orders else np.arange(len(ranks)))
+            display = ranks[order_idx]
         page = self.paginate(len(display), sg, display)
         display = display[page]
         nodes = np.unique(display).astype(np.int32)
@@ -417,6 +419,23 @@ class Executor:
             return node
         self._descend(node)
         return node
+
+    def _mesh_order_topk(self, sg: SubGraph, ranks: np.ndarray):
+        """Order-by pushdown on the mesh (reference: SortOverNetwork):
+        single-key `orderasc/orderdesc` with a result cap runs as per-shard
+        top-k + on-mesh merge. Returns the ordered (truncated) display
+        list, or None → host ordering path."""
+        if (self.mesh is None or len(sg.orders) != 1 or not sg.first
+                or sg.first < 0 or sg.after
+                or len(ranks) < self.device_threshold):
+            return None
+        o = sg.orders[0]
+        if o.is_val_var:
+            return None
+        from dgraph_tpu.parallel.dsort import mesh_topk
+        k = sg.first + max(sg.offset, 0)
+        return mesh_topk(self.mesh, self.store, o.attr, o.lang,
+                         ranks, k, desc=o.desc)
 
     def _fused_level(self, sg: SubGraph, frontier: np.ndarray):
         """Large-frontier fast path: expand → filter → paginate → dedupe

@@ -259,7 +259,7 @@ class _Renderer:
         if data is None or not data.paths:
             return []
         out = []
-        for path in data.paths:
+        for pi_, path in enumerate(data.paths):
             cur: dict | None = None
             for rank, pred_i in reversed(path):
                 o = {"uid": _uid_str(self.store.uid_of(rank))}
@@ -270,6 +270,8 @@ class _Renderer:
                     o[name] = cur
                 cur = o
                 next_pred_i = pred_i
+            if data.weights:
+                cur["_weight_"] = data.weights[pi_]
             out.append(cur)
         return out
 

@@ -25,10 +25,15 @@ class PathData:
     paths: list[list[tuple[int, int]]] = field(default_factory=list)
     edge_sgs: list = field(default_factory=list)
     nodes: np.ndarray = field(default_factory=lambda: np.zeros(0, np.int32))
+    # total path cost per path (weighted mode only; rendered as _weight_)
+    weights: list[float] = field(default_factory=list)
 
 
 def shortest_path(ex, sg) -> PathData:
-    """BFS from sg.shortest.from_uid to to_uid over the block's edge preds."""
+    """BFS from sg.shortest.from_uid to to_uid over the block's edge preds.
+    When an edge block names a facet (`friend @facets(weight)`), edges are
+    relaxed by that facet's value instead of uniform cost — reference:
+    query/shortest.go facet-weight relaxation."""
     args = sg.shortest
     store = ex.store
     src = store.rank_of(np.array([args.from_uid], np.int64))[0]
@@ -36,6 +41,8 @@ def shortest_path(ex, sg) -> PathData:
     data = PathData(edge_sgs=[c for c in sg.children if ex._expands(c)])
     if src < 0 or dst < 0:
         return data
+    if any(c.facet_keys for c in data.edge_sgs):
+        return _dijkstra(ex, sg, data, int(src), int(dst))
     max_depth = args.depth or MAX_PATH_DEPTH
 
     # parents[rank] = all (parent_rank, pred_index) found at rank's first
@@ -76,4 +83,75 @@ def shortest_path(ex, sg) -> PathData:
     if data.paths:
         data.nodes = np.unique(np.array([r for p in data.paths for r, _ in p],
                                         np.int32))
+    return data
+
+
+def _dijkstra(ex, sg, data: PathData, src: int, dst: int) -> PathData:
+    """Facet-weight uniform-cost search. Parent lists keep every
+    equal-cost predecessor, so numpaths > 1 enumerates the minimal-cost
+    path DAG the way the BFS path does. Edges without the named facet
+    relax at weight 1 (uniform). maxweight prunes the search frontier;
+    minweight filters the final answer."""
+    import heapq
+
+    args = sg.shortest
+    store = ex.store
+    wkeys = [(c.facet_keys[0][1] if c.facet_keys else None)
+             for c in data.edge_sgs]
+    EPS = 1e-9
+    dist: dict[int, float] = {src: 0.0}
+    parents: dict[int, list[tuple[int, int]]] = {src: []}
+    settled: set[int] = set()
+    heap: list[tuple[float, int]] = [(0.0, src)]
+    while heap:
+        d, u = heapq.heappop(heap)
+        if u in settled:
+            continue
+        settled.add(u)
+        if u == dst:
+            break
+        frontier = np.array([u], np.int32)
+        for i, esg in enumerate(data.edge_sgs):
+            nbrs, seg, pos = ex.expand(esg.attr, esg.is_reverse, frontier)
+            nbrs, seg, pos = ex.filter_edges(esg.filters, nbrs, seg, pos)
+            if not len(nbrs):
+                continue
+            if wkeys[i] and not esg.is_reverse and len(pos):
+                fvals = store.edge_facets(
+                    esg.attr, pos, [wkeys[i]]).get(wkeys[i],
+                                                   [None] * len(pos))
+                ws = [float(v) if isinstance(v, (int, float, np.integer,
+                                                 np.floating)) else 1.0
+                      for v in fvals]
+            else:
+                ws = [1.0] * len(nbrs)
+            for v, w in zip(nbrs.tolist(), ws):
+                nd = d + w
+                if nd > args.maxweight:
+                    continue
+                old = dist.get(v)
+                if old is None or nd < old - EPS:
+                    dist[v] = nd
+                    parents[v] = [(u, i)]
+                    heapq.heappush(heap, (nd, v))
+                elif abs(nd - old) <= EPS and (u, i) not in parents[v]:
+                    parents[v].append((u, i))
+
+    if dst in dist and args.minweight <= dist[dst] <= args.maxweight:
+        def walk(rank: int):
+            plist = parents[rank]
+            if not plist:
+                yield [(rank, -1)]
+                return
+            for p, pi in plist:
+                for prefix in walk(p):
+                    yield prefix + [(rank, pi)]
+
+        import itertools
+        data.paths = list(itertools.islice(walk(dst),
+                                           max(1, args.numpaths)))
+        data.weights = [dist[dst]] * len(data.paths)
+    if data.paths:
+        data.nodes = np.unique(np.array(
+            [r for p in data.paths for r, _ in p], np.int32))
     return data

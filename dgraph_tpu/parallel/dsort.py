@@ -1,0 +1,138 @@
+"""Distributed order-by: per-shard top-k + on-mesh k-way merge.
+
+Reference parity: `worker/sort.go SortOverNetwork` — order-by is pushed to
+the group holding the index, each group returns its ordered slice, and
+the coordinator k-way merges (`algo.MergeSorted`). On the mesh the same
+shape is one SPMD program: every device ranks the candidates living in
+its row slab against a dense sort-key column, takes its local top-k, and
+an all_gather + second sort produces the merged global top-k on every
+device — no host merge loop at all.
+
+Keys are float64 with +inf for missing values (missing sorts last, as the
+reference does) and are negated host-side for descending order; ties
+break by rank ascending (the uid tiebreak of the host path).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from dgraph_tpu.ops.uidalgebra import valid_mask
+from dgraph_tpu.parallel.mesh import SHARD_AXIS, shard_leading
+
+
+@functools.lru_cache(maxsize=64)
+def _build_topk(mesh: Mesh, cap: int, k: int, rows: int):
+    def per_device(keys_b, row_lo_b, cand):
+        from dgraph_tpu.ops.uidalgebra import sentinel
+        keys, row_lo = keys_b[0], row_lo_b[0]
+        local = cand - row_lo
+        mine = valid_mask(cand) & (local >= 0) & (local < rows)
+        ck = jnp.where(mine, keys[jnp.clip(local, 0, rows - 1)], jnp.inf)
+        # candidates another shard owns must drop out entirely (each rank
+        # is "mine" on exactly one shard) — sentinel-cand rows sort after
+        # every real row, including real missing-value (+inf-key) rows
+        cand_m = jnp.where(mine, cand, sentinel(cand.dtype))
+        order = jnp.lexsort((cand_m, ck))    # (key, rank-tiebreak)
+        top_r = cand_m[order[:k]]
+        top_v = ck[order[:k]]
+        gr = lax.all_gather(top_r, SHARD_AXIS).reshape(-1)
+        gv = lax.all_gather(top_v, SHARD_AXIS).reshape(-1)
+        o2 = jnp.lexsort((gr, gv))           # k-way merge, one sort
+        return gr[o2[:k]], gv[o2[:k]]
+
+    fn = jax.shard_map(
+        per_device, mesh=mesh,
+        in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P()),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+    return jax.jit(fn)
+
+
+def _key_column(store, pred: str, lang: str, mesh: Mesh):
+    """Dense float64 sort-key column sharded over the mesh, cached on the
+    store. None when the predicate's values are not numerically
+    orderable (strings fall back to the host sort)."""
+    cache = getattr(store, "_key_cols", None)
+    if cache is None or getattr(store, "_key_cols_mesh", None) is not mesh:
+        cache = {}
+        store._key_cols = cache
+        store._key_cols_mesh = mesh
+    ck = (pred, lang)
+    if ck in cache:
+        return cache[ck]
+    col = store.value_col(pred, lang)
+    result = None
+    if col is not None and len(col.subj):
+        vals = col.vals
+        if vals.dtype == object:
+            first = next((v for v in vals if v is not None), None)
+            if isinstance(first, (bool, np.bool_, int, np.integer, float,
+                                  np.floating, np.datetime64)):
+                vals = np.array([_to_key(v) for v in vals], np.float64)
+            else:
+                vals = None
+        elif np.issubdtype(vals.dtype, np.datetime64):
+            vals = vals.astype("datetime64[us]").astype(np.int64
+                                                        ).astype(np.float64)
+        elif np.issubdtype(vals.dtype, np.number) or vals.dtype == bool:
+            vals = vals.astype(np.float64)
+        else:
+            vals = None
+        if vals is not None:
+            n = store.n_nodes
+            d = mesh.devices.size
+            rows = -(-max(n, 1) // d)
+            dense = np.full(d * rows, np.inf)     # missing → last
+            # first value per subject wins (col.subj sorted; keep first)
+            subj, idx = np.unique(col.subj, return_index=True)
+            dense[subj] = vals[idx]
+            keys_s = jax.device_put(dense.reshape(d, rows),
+                                    shard_leading(mesh))
+            row_lo = jax.device_put(
+                (np.arange(d, dtype=np.int32) * rows), shard_leading(mesh))
+            result = (keys_s, row_lo, rows)
+    cache[ck] = result
+    return result
+
+
+def _to_key(v) -> float:
+    if isinstance(v, np.datetime64):
+        return float(v.astype("datetime64[us]").astype("int64"))
+    return float(v)
+
+
+def mesh_topk(mesh: Mesh, store, pred: str, lang: str, ranks: np.ndarray,
+              k: int, desc: bool = False) -> np.ndarray | None:
+    """Global top-k of `ranks` ordered by a value predicate, on-mesh.
+    Returns the ordered rank array (missing-valued ranks last), or None
+    when the key column is not device-orderable."""
+    col = _key_column(store, pred, lang, mesh)
+    if col is None:
+        return None
+    keys_s, row_lo, rows = col
+    if desc:
+        # negate finite keys only: missing (+inf) must still sort last
+        keys_s = jnp.where(jnp.isinf(keys_s), keys_s, -keys_s)
+    cap = 64
+    while cap < len(ranks):
+        cap <<= 1
+    from dgraph_tpu import ops
+    cand = ops.pad_to(np.asarray(ranks, np.int32), cap)
+    kk = min(k, cap)
+    top_r, top_v = _build_topk(mesh, cap, kk, rows)(keys_s, row_lo, cand)
+    top_r = np.asarray(top_r)
+    out = top_r[np.asarray(valid_mask_np(top_r))]
+    return out[:min(k, len(ranks))]
+
+
+def valid_mask_np(a: np.ndarray) -> np.ndarray:
+    from dgraph_tpu.ops.uidalgebra import SENTINEL32
+    return a != SENTINEL32
