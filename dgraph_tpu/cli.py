@@ -40,14 +40,19 @@ def cmd_alpha(args) -> int:
         # cluster mode: Zero leases + membership + tablet routing
         from dgraph_tpu.cluster.groups import Groups
         from dgraph_tpu.cluster.zero import RemoteOracle, ZeroClient
+        # capture the REPLAYED watermarks before swapping oracles: the
+        # local oracle was bumped past every WAL-tail commit_ts/uid during
+        # Alpha.open, and handing Zero anything lower would let it lease
+        # duplicate timestamps/uids after a crash-restart rejoin
+        replayed_ts = alpha.oracle.max_assigned
+        replayed_uid = alpha.oracle.max_uid
         zero = ZeroClient(args.zero)
         alpha.oracle = RemoteOracle(zero)
         alpha.xidmap._oracle = alpha.oracle
-        base = alpha.mvcc.base
         alpha.groups = Groups(
             zero, f"{cfg.http_addr}:{grpc_port}", group=args.group,
-            max_ts=alpha.mvcc.base_ts,
-            max_uid=int(base.uids[-1]) if base.n_nodes else 0)
+            max_ts=max(alpha.mvcc.base_ts, replayed_ts),
+            max_uid=replayed_uid)
         log.info("joined cluster: node=%d group=%d",
                  alpha.groups.node_id, alpha.groups.gid)
     http_server = make_http_server(alpha, cfg.http_addr, cfg.http_port)

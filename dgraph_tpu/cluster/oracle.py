@@ -125,6 +125,14 @@ class Oracle:
         with self._lock:
             self._next_uid = max(self._next_uid, uid + 1)
 
+    @property
+    def max_uid(self) -> int:
+        """Highest uid ever leased or bumped — the watermark a rejoining
+        node must hand Zero so leases never reuse uids minted in a WAL
+        tail (reference: zero assign.go lease restore)."""
+        with self._lock:
+            return self._next_uid - 1
+
     # -- commit arbitration -------------------------------------------------
     def commit(self, start_ts: int, conflict_keys) -> int:
         """First-committer-wins commit; returns commit_ts or raises
