@@ -138,13 +138,29 @@ class Alpha:
     @contextlib.contextmanager
     def _reading(self, ts: int | None = None):
         """Track in-flight reads so gc never drops a snapshot under them.
-        With ts=None a fresh read-only ts is issued INSIDE the state lock —
-        registration is atomic with issuance, so a concurrent gc sweep can
-        never miss a ts that exists but isn't registered yet."""
-        with self._state_lock:
-            if ts is None:
+
+        The ts is issued OUTSIDE the state lock — in cluster mode that is
+        a gRPC round-trip to Zero, and holding the Alpha-wide lock across
+        it would serialize every read behind network latency. The gc race
+        (a sweep running between issuance and registration) is closed by
+        re-checking the mvcc floor after registering: if the snapshot was
+        collected under us, unregister and retry with a fresh ts (the new
+        ts is ≥ every commit the sweep could have folded)."""
+        issued = ts is None
+        for attempt in range(8):
+            if issued:
                 ts = self.oracle.read_only_ts()
-            self._active_reads[ts] = self._active_reads.get(ts, 0) + 1
+            with self._state_lock:
+                self._active_reads[ts] = self._active_reads.get(ts, 0) + 1
+            # last attempt keeps its registration either way: read_view
+            # raises a clear error if the snapshot truly is gone
+            if (not issued or attempt == 7
+                    or self.mvcc.floor_ts() <= ts):
+                break
+            with self._state_lock:
+                self._active_reads[ts] -= 1
+                if not self._active_reads[ts]:
+                    del self._active_reads[ts]
         try:
             yield ts
         finally:
@@ -219,7 +235,21 @@ class Alpha:
                     continue
 
     def drop_all(self) -> None:
-        """reference: api.Operation{DropAll}."""
+        """reference: api.Operation{DropAll}. Broadcast like Alter: every
+        node must drop or spanning queries diverge against survivors."""
+        self.apply_drop_broadcast()
+        if self.groups is not None:
+            import grpc as _grpc
+            for addr in self.groups.other_addrs():
+                try:
+                    self.groups.pool(addr).apply_drop()
+                except _grpc.RpcError:
+                    continue
+
+    def apply_drop_broadcast(self) -> None:
+        """Receive a DropAll from another coordinator (no re-broadcast).
+        Tablet caches must reset too — a cached foreign tablet would keep
+        serving pre-drop data locally."""
         with self._apply_lock:
             if self.wal is not None:
                 self.wal.append_drop(self.oracle.read_only_ts())
@@ -227,6 +257,9 @@ class Alpha:
             self.xidmap = XidMap(self.oracle)
             with self._state_lock:
                 self._open_txns.clear()
+                self.tablet_versions.clear()
+                self._stale_preds.clear()
+                self._tablet_cache.clear()
 
     # -- commit path (worker/draft.go applyMutations analog) ----------------
     def _commit(self, txn: "Txn") -> int:

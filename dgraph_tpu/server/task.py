@@ -140,6 +140,9 @@ class WorkerService:
     # -- cluster seams (worker/draft.go apply + snapshot shipping) ----------
     def ApplyMutation(self, req: pb.MutationMsg, ctx) -> pb.Payload:
         """Receive a committed-mutation broadcast (log shipping)."""
+        if req.drop_all:
+            self.alpha.apply_drop_broadcast()
+            return pb.Payload(data=b"ok")
         if req.schema:
             self.alpha.apply_schema_broadcast(req.schema)
             return pb.Payload(data=b"ok")
@@ -240,6 +243,10 @@ class Client:
     def apply_schema(self, schema_text: str) -> None:
         self._call(SERVICE_WORKER, "ApplyMutation",
                    pb.MutationMsg(schema=schema_text), pb.Payload)
+
+    def apply_drop(self) -> None:
+        self._call(SERVICE_WORKER, "ApplyMutation",
+                   pb.MutationMsg(drop_all=True), pb.Payload)
 
     def tablet_snapshot(self, attr: str, read_ts: int = 0):
         r = self._call(SERVICE_WORKER, "TabletSnapshot",

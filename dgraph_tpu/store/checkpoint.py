@@ -126,6 +126,17 @@ def save_versioned(store: Store, dirname: str, base_ts: int = 0) -> None:
     never a half-written mix (the durability role of Badger's MANIFEST)."""
     os.makedirs(dirname, exist_ok=True)
     sub = f"ckpt-{base_ts:016d}"
+    cur = os.path.join(dirname, "CURRENT")
+    if os.path.exists(cur):
+        with open(cur) as f:
+            if (f.read().strip() == sub and os.path.exists(
+                    os.path.join(dirname, sub, "manifest.json"))):
+                # CURRENT already names a fully-written ckpt-<base_ts>:
+                # re-saving would scribble over the live snapshot in place
+                # and a crash mid-save would leave NO intact snapshot. The
+                # MVCC contract makes base_ts identify the content, so the
+                # existing snapshot is exactly what we'd write — no-op.
+                return
     save(store, os.path.join(dirname, sub), base_ts=base_ts)
     tmp = os.path.join(dirname, "CURRENT.tmp")
     with open(tmp, "w") as f:

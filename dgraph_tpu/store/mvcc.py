@@ -205,6 +205,11 @@ class MVCCStore:
             self._views.clear()
             return store
 
+    def floor_ts(self) -> int:
+        """Oldest retained fold point — reads below this would fail."""
+        with self._lock:
+            return self._history[0][0]
+
     def gc(self, min_active_ts: int) -> None:
         """Drop snapshots/layers unreachable by any ts ≥ min_active_ts."""
         with self._lock:

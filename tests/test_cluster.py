@@ -256,3 +256,21 @@ def test_zero_restart_watermark_resync():
     assert len({r["uid"] for r in uids}) == 2
     zs2.stop(None)
     sa.stop(None)
+
+
+def test_drop_all_broadcast(cluster):
+    """DropAll must reach every node (like Alter) and reset tablet caches,
+    or spanning queries diverge against survivors (code-review finding)."""
+    a1, a2 = cluster
+    load_fixture(a1)
+    # warm a2's foreign-tablet cache with a spanning query first
+    assert a2.query(SPAN_Q) == SPAN_WANT
+    a1.drop_all()
+    assert a2.query('{ q(func: has(name)) { name } }') == {"q": []}
+    assert a2.query(SPAN_Q) == {"q": []}
+    assert not a2.tablet_versions and not a2._tablet_cache
+    # the cluster is usable again after the wipe
+    a1.alter(SCHEMA)
+    a2.mutate(set_nquads='_:n <name> "dora" .')
+    out = a1.query('{ q(func: eq(name, "dora")) { name } }')
+    assert out == {"q": [{"name": "dora"}]}

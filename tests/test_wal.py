@@ -208,3 +208,24 @@ def test_partial_checkpoint_dir_ignored(tmp_path):
     b = Alpha.open(p)
     out = b.query('{ q(func: has(name)) { name } }')
     assert sorted(r["name"] for r in out["q"]) == ["alice", "bob"]
+
+
+def test_idle_recheckpoint_is_noop(tmp_path):
+    """save_versioned at an unchanged base_ts must not rewrite the live
+    snapshot in place — a crash mid-save would otherwise leave no intact
+    snapshot (code-review finding)."""
+    import os
+    from dgraph_tpu.server.api import Alpha
+    from dgraph_tpu.store import checkpoint
+
+    p = str(tmp_path / "p")
+    a = Alpha.open(p)
+    a.alter("name: string .")
+    a.mutate(set_nquads='_:x <name> "x" .')
+    ts = a.checkpoint_to(p)
+    sub = tmp_path / "p" / f"ckpt-{ts:016d}"
+    mtime = os.path.getmtime(sub / "manifest.json")
+    assert a.checkpoint_to(p) == ts
+    assert os.path.getmtime(sub / "manifest.json") == mtime
+    store, bts = checkpoint.load(p)
+    assert bts == ts and store.n_nodes == 1
