@@ -24,13 +24,24 @@ def cmd_alpha(args) -> int:
 
     cfg = load_config(AlphaConfig, args.config, {
         "p_dir": args.p, "http_port": args.http_port,
-        "grpc_port": args.grpc_port, "log_level": args.log_level})
+        "grpc_port": args.grpc_port, "log_level": args.log_level,
+        "mesh_devices": args.mesh_devices})
     xlog.setup(cfg.log_level)
     log = xlog.get("alpha")
 
+    mesh = None
+    if cfg.mesh_devices:
+        # SPMD serving: the query engine runs its hops sharded over the
+        # device mesh (reference: the sidecar seam, SURVEY §3.1)
+        from dgraph_tpu.parallel.mesh import make_mesh
+        mesh = make_mesh(None if cfg.mesh_devices < 0
+                         else cfg.mesh_devices)
+        log.info("device mesh: %d devices", mesh.devices.size)
+
     # checkpoint + WAL replay boot: every commit that reached disk before
     # a crash is recovered (reference: badger open + raft WAL restore)
-    alpha = Alpha.open(cfg.p_dir, device_threshold=cfg.device_threshold)
+    alpha = Alpha.open(cfg.p_dir, device_threshold=cfg.device_threshold,
+                       mesh=mesh)
     log.info("opened %s: %d nodes", cfg.p_dir, alpha.mvcc.base.n_nodes)
 
     grpc_server, grpc_port = make_server(
@@ -159,6 +170,9 @@ def main(argv=None) -> int:
     p.add_argument("--config", default=None)
     p.add_argument("--http_port", type=int, default=None)
     p.add_argument("--grpc_port", type=int, default=None)
+    p.add_argument("--mesh-devices", type=int, default=None,
+                   dest="mesh_devices",
+                   help="SPMD engine over N devices (-1 = all, 0 = off)")
     p.add_argument("--zero", default=None,
                    help="zero address → join a cluster")
     p.add_argument("--group", type=int, default=0,

@@ -99,34 +99,28 @@ class Executor:
             (np.arange(total, dtype=np.int64) - base)
         return rel.indices[pos], seg, pos
 
-    def _expand_mesh(self, pred: str, reverse: bool, frontier: np.ndarray):
-        """SPMD expansion over the device mesh: every device expands the
-        row slab it owns, outputs stay sharded, the host reassembles the
-        edge matrix (reference: ProcessTaskOverNetwork scatter/gather —
-        SURVEY §3.1 — with gRPC replaced by residency + one shard_map)."""
-        from dgraph_tpu.parallel.dhop import matrix_hop
-
-        srel = self.store.sharded_rel(pred, reverse, self.mesh)
-        fcap = _bucket(len(frontier))
-        fr = ops.pad_to(frontier, fcap)
-        deg = self.store.rel(pred, reverse).degree(frontier)
-        # per-shard edge caps: rows partition over shards, so each shard
-        # needs only ITS slab's degree sum
-        rows_per = srel.rows_per_shard
-        shard_of = np.minimum(frontier // rows_per, srel.n_shards - 1)
+    def _shard_edge_cap(self, srel, frontier: np.ndarray,
+                        deg: np.ndarray) -> int:
+        """Per-shard edge-cap bucket: rows partition over shards, so each
+        shard needs only ITS slab's degree sum."""
+        shard_of = np.minimum(frontier // srel.rows_per_shard,
+                              srel.n_shards - 1)
         per_shard = np.bincount(shard_of, weights=deg,
                                 minlength=srel.n_shards)
-        edge_cap = _bucket(max(int(per_shard.max()), 1))
-        nbrs_s, seg_s, pos_s, totals, max_shard = matrix_hop(
-            self.mesh, srel, fr, edge_cap)
-        assert int(max_shard) <= edge_cap, (int(max_shard), edge_cap)
-        nbrs_s = np.asarray(nbrs_s)
-        seg_s = np.asarray(seg_s)
-        pos_s = np.asarray(pos_s)
-        totals = np.asarray(totals)
+        return _bucket(max(int(per_shard.max()), 1))
+
+    @staticmethod
+    def _reassemble_shards(srel, nbrs_s, seg_s, pos_s, counts):
+        """Stitch per-shard edge slots back into one global edge matrix.
+        Each frontier row lives on exactly one shard, so a stable sort by
+        seg recovers global CSR row order; pos is shard-local and offsets
+        by pos_lo into the absolute facet position space."""
+        nbrs_s, seg_s, pos_s = (np.asarray(nbrs_s), np.asarray(seg_s),
+                                np.asarray(pos_s))
+        counts = np.asarray(counts)
         parts_n, parts_s, parts_p = [], [], []
         for d in range(srel.n_shards):
-            t = int(totals[d])
+            t = int(counts[d])
             if not t:
                 continue
             parts_n.append(nbrs_s[d, :t])
@@ -138,10 +132,24 @@ class Executor:
         nbrs = np.concatenate(parts_n)
         seg = np.concatenate(parts_s)
         pos = np.concatenate(parts_p)
-        # each frontier row lives on exactly one shard, so a stable sort by
-        # seg recovers global CSR row order
         order = np.argsort(seg, kind="stable")
         return nbrs[order], seg[order], pos[order]
+
+    def _expand_mesh(self, pred: str, reverse: bool, frontier: np.ndarray):
+        """SPMD expansion over the device mesh: every device expands the
+        row slab it owns, outputs stay sharded, the host reassembles the
+        edge matrix (reference: ProcessTaskOverNetwork scatter/gather —
+        SURVEY §3.1 — with gRPC replaced by residency + one shard_map)."""
+        from dgraph_tpu.parallel.dhop import matrix_hop
+
+        srel = self.store.sharded_rel(pred, reverse, self.mesh)
+        fr = ops.pad_to(frontier, _bucket(len(frontier)))
+        deg = self.store.rel(pred, reverse).degree(frontier)
+        edge_cap = self._shard_edge_cap(srel, frontier, deg)
+        nbrs_s, seg_s, pos_s, totals, max_shard = matrix_hop(
+            self.mesh, srel, fr, edge_cap)
+        assert int(max_shard) <= edge_cap, (int(max_shard), edge_cap)
+        return self._reassemble_shards(srel, nbrs_s, seg_s, pos_s, totals)
 
     def _expand_device(self, pred: str, reverse: bool, frontier: np.ndarray):
         indptr, indices = self.store.device_rel(pred, reverse)
@@ -168,6 +176,27 @@ class Executor:
         out = parts[0]
         for p in parts[1:]:
             out = np.intersect1d(out, p) if tree.op == "and" else np.union1d(out, p)
+        return out.astype(np.int32)
+
+    def filter_set(self, tree: FilterNode | None) -> np.ndarray | None:
+        """Evaluate a filter tree to its allowed set WITHOUT a universe —
+        index lookups only, so host cost scales with the result, never with
+        n_nodes (reference: index-backed filter SubGraphs). Returns None
+        when the tree needs a complement (`not`), which only a universe can
+        answer; callers then filter against gathered neighbors instead."""
+        if tree is None:
+            return None
+        if tree.op == "leaf":
+            return self._leaf_set(tree.func, EMPTY).astype(np.int32)
+        if tree.op == "not":
+            return None
+        parts = [self.filter_set(c) for c in tree.children]
+        if any(p is None for p in parts):
+            return None
+        out = parts[0]
+        for p in parts[1:]:
+            out = (np.intersect1d(out, p) if tree.op == "and"
+                   else np.union1d(out, p))
         return out.astype(np.int32)
 
     def _var_ranks(self, name: str) -> np.ndarray:
@@ -443,8 +472,7 @@ class Executor:
         host work is evaluating the filter tree to a sorted allowed set.
         Returns (nbrs, seg, pos) or None when ineligible (ordering, facet
         filters and `after` cursors need per-edge host logic)."""
-        if (self.mesh is not None
-                or len(frontier) < self.device_threshold
+        if (len(frontier) < self.device_threshold
                 or sg.orders or sg.facet_orders or sg.after
                 or sg.facet_filter is not None):
             return None
@@ -455,17 +483,23 @@ class Executor:
 
         use_allowed = sg.filters is not None
         if use_allowed:
-            universe = np.arange(self.store.n_nodes, dtype=np.int32)
-            allowed = self.apply_filter(sg.filters, universe)
+            # universe-free allowed set: index lookups only, so host cost
+            # tracks the filter's selectivity, not n_nodes. Complement-
+            # shaped trees (`not`) fall back to the gathered-neighbor path.
+            allowed = self.filter_set(sg.filters)
+            if allowed is None:
+                return None
             allowed_d = ops.pad_to(allowed, _bucket(max(len(allowed), 1)))
         else:
             allowed_d = ops.pad_to(EMPTY, 1)
-        indptr, indices = self.store.device_rel(sg.attr, sg.is_reverse)
-        fcap = _bucket(len(frontier))
-        fr = ops.pad_to(frontier, fcap)
-        deg = rel.degree(frontier)
-        ecap = _bucket(max(int(deg.sum()), 1))
         first = sg.first if sg.first else NO_LIMIT
+        fr = ops.pad_to(frontier, _bucket(len(frontier)))
+        deg = rel.degree(frontier)
+        if self.mesh is not None:
+            return self._fused_level_mesh(sg, frontier, fr, deg, allowed_d,
+                                          first, use_allowed)
+        indptr, indices = self.store.device_rel(sg.attr, sg.is_reverse)
+        ecap = _bucket(max(int(deg.sum()), 1))
         c_nbrs, c_seg, c_pos, n_kept, _nxt, _nu, total = expand_level(
             indptr, indices, fr, allowed_d,
             np.int32(sg.offset), np.int32(first),
@@ -474,6 +508,21 @@ class Executor:
         assert int(total) <= ecap, (int(total), ecap)
         return (np.asarray(c_nbrs)[:n], np.asarray(c_seg)[:n],
                 np.asarray(c_pos)[:n].astype(np.int64))
+
+    def _fused_level_mesh(self, sg: SubGraph, frontier, fr, deg, allowed_d,
+                          first, use_allowed: bool):
+        """Fused level on the mesh: expand+filter+paginate per shard in one
+        SPMD program, host only reassembles row order (the served-mesh
+        seam; reference: pushdown into each group's processTask)."""
+        from dgraph_tpu.parallel.dhop import matrix_level
+
+        srel = self.store.sharded_rel(sg.attr, sg.is_reverse, self.mesh)
+        edge_cap = self._shard_edge_cap(srel, frontier, deg)
+        nbrs_s, seg_s, pos_s, kept, _totals, max_shard = matrix_level(
+            self.mesh, srel, fr, allowed_d, sg.offset, first,
+            edge_cap, use_allowed)
+        assert int(max_shard) <= edge_cap, (int(max_shard), edge_cap)
+        return self._reassemble_shards(srel, nbrs_s, seg_s, pos_s, kept)
 
     # -- leaves, vars, expand(_all_) ----------------------------------------
     def _concrete_children(self, parent: LevelNode) -> list[SubGraph]:

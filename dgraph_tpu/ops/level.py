@@ -28,6 +28,58 @@ from dgraph_tpu.ops.uidalgebra import _member, sentinel, sort_unique_count
 NO_LIMIT = (1 << 30)
 
 
+def filter_paginate(nbrs, seg, edge_pos, valid, allowed, offset, first,
+                    n_rows: int, use_allowed: bool):
+    """The filter+paginate+compact body shared by the single-device fused
+    level and its per-shard SPMD form (parallel/dhop.py matrix_level).
+    Inputs are one device's gathered edge slots; `seg` must be
+    nondecreasing (CSR row order). Returns (nbrs, seg, pos, n_kept) with
+    kept edges compacted to the front in row order."""
+    edge_cap = nbrs.shape[0]
+    keep = valid
+    if use_allowed:
+        keep = keep & _member(nbrs, allowed)
+
+    # within-row survivor rank: exclusive segment-local cumsum of `keep`
+    ksum = jnp.cumsum(keep.astype(jnp.int32))
+    excl = ksum - keep.astype(jnp.int32)        # exclusive at j
+    # survivors before each row start (segment base)
+    row_ids = jnp.arange(n_rows, dtype=jnp.int32)
+    # first edge slot of each row: searchsorted over seg (seg nondecreasing)
+    row_start = jnp.searchsorted(seg, row_ids, side="left")
+    row_end = jnp.searchsorted(seg, row_ids, side="right")
+    base_at_row = jnp.take(excl, jnp.minimum(row_start, edge_cap - 1),
+                           mode="clip")
+    base_at_row = jnp.where(row_start < edge_cap, base_at_row, 0)
+    end_ksum = jnp.take(ksum, jnp.maximum(row_end - 1, 0), mode="clip")
+    end_ksum = jnp.where(row_end > 0, end_ksum, 0)
+    row_total = jnp.maximum(end_ksum - base_at_row, 0)  # survivors per row
+
+    safe_seg = jnp.clip(seg, 0, n_rows - 1)
+    rank = excl - base_at_row[safe_seg]         # within-row survivor rank
+    lo = offset
+    k = jnp.where(first == NO_LIMIT, jnp.int32(NO_LIMIT), first)
+    hi = jnp.where(k >= 0, lo + k, jnp.int32(NO_LIMIT))
+    paged = keep & (rank >= lo) & (rank < hi)
+    # negative first: last |k| of the post-offset window
+    neg = (k < 0)
+    tail_lo = jnp.maximum(row_total[safe_seg] + k, lo)
+    paged = jnp.where(neg, keep & (rank >= tail_lo), paged)
+
+    snt = sentinel(nbrs.dtype)
+    m_nbrs = jnp.where(paged, nbrs, snt)
+    m_seg = jnp.where(paged, seg, jnp.int32(2**31 - 1))
+    m_pos = jnp.where(paged, edge_pos, 0)
+    # compact kept edges to the front, preserving CSR row order (slots are
+    # already ordered by (seg, within-row)); stable order under sort of
+    # slot keys: use the slot index where paged, else edge_cap
+    slot_key = jnp.where(paged, jnp.arange(edge_cap, dtype=jnp.int32),
+                         jnp.int32(edge_cap))
+    order = jnp.argsort(slot_key)
+    n_kept = jnp.sum(paged.astype(jnp.int32))
+    return m_nbrs[order], m_seg[order], m_pos[order], n_kept, m_nbrs
+
+
 @functools.partial(jax.jit, static_argnames=("edge_cap", "out_cap",
                                              "use_allowed"))
 def expand_level(indptr: jax.Array, indices: jax.Array, frontier: jax.Array,
@@ -53,50 +105,8 @@ def expand_level(indptr: jax.Array, indices: jax.Array, frontier: jax.Array,
     """
     nbrs, seg, edge_pos, valid, total = gather_edges(
         indptr, indices, frontier, edge_cap)
-    keep = valid
-    if use_allowed:
-        keep = keep & _member(nbrs, allowed)
-
-    # within-row survivor rank: exclusive segment-local cumsum of `keep`
-    ksum = jnp.cumsum(keep.astype(jnp.int32))
-    excl = ksum - keep.astype(jnp.int32)        # exclusive at j
-    n_rows = frontier.shape[0]
-    # survivors before each row start (segment base)
-    row_ids = jnp.arange(n_rows, dtype=jnp.int32)
-    # first edge slot of each row: searchsorted over seg (seg nondecreasing)
-    row_start = jnp.searchsorted(seg, row_ids, side="left")
-    row_end = jnp.searchsorted(seg, row_ids, side="right")
-    base_at_row = jnp.take(excl, jnp.minimum(row_start, edge_cap - 1),
-                           mode="clip")
-    base_at_row = jnp.where(row_start < edge_cap, base_at_row, 0)
-    end_ksum = jnp.take(ksum, jnp.maximum(row_end - 1, 0), mode="clip")
-    end_ksum = jnp.where(row_end > 0, end_ksum, 0)
-    row_total = jnp.maximum(end_ksum - base_at_row, 0)  # survivors per row
-
-    rank = excl - base_at_row[seg]              # within-row survivor rank
-    lo = offset
-    k = jnp.where(first == NO_LIMIT, jnp.int32(NO_LIMIT), first)
-    hi = jnp.where(k >= 0, lo + k, jnp.int32(NO_LIMIT))
-    paged = keep & (rank >= lo) & (rank < hi)
-    # negative first: last |k| of the post-offset window
-    neg = (k < 0)
-    tail_lo = jnp.maximum(row_total[seg] + k, lo)
-    paged = jnp.where(neg, keep & (rank >= tail_lo), paged)
-
-    snt = sentinel(indices.dtype)
-    m_nbrs = jnp.where(paged, nbrs, snt)
-    m_seg = jnp.where(paged, seg, jnp.int32(2**31 - 1))
-    m_pos = jnp.where(paged, edge_pos, 0)
-    # compact kept edges to the front, preserving CSR row order (slots are
-    # already ordered by (seg, within-row)); stable order under sort of
-    # slot keys: use the slot index where paged, else edge_cap
-    slot_key = jnp.where(paged, jnp.arange(edge_cap, dtype=jnp.int32),
-                         jnp.int32(edge_cap))
-    order = jnp.argsort(slot_key)
-    c_nbrs = m_nbrs[order]
-    c_seg = m_seg[order]
-    c_pos = m_pos[order]
-    n_kept = jnp.sum(paged.astype(jnp.int32))
-
+    c_nbrs, c_seg, c_pos, n_kept, m_nbrs = filter_paginate(
+        nbrs, seg, edge_pos, valid, allowed, offset, first,
+        frontier.shape[0], use_allowed)
     nxt, n_unique = sort_unique_count(m_nbrs, out_cap)
     return c_nbrs, c_seg, c_pos, n_kept, nxt, n_unique, total

@@ -36,12 +36,20 @@ from dgraph_tpu.parallel.mesh import SHARD_AXIS
 from dgraph_tpu.parallel.pshard import ShardedRel
 
 
-def _local_expand(indptr, indices, row_lo, frontier, edge_cap):
-    """Expand the slice of a (global-rank) frontier this shard owns."""
+def _local_expand_full(indptr, indices, row_lo, frontier, edge_cap):
+    """Expand the slice of a (global-rank) frontier this shard owns.
+    Returns the full gather_edges tuple; `seg` indexes the GLOBAL
+    frontier (rows not owned by this shard simply contribute no edges)."""
     n_rows = indptr.shape[0] - 1
-    mine = valid_mask(frontier) & (frontier >= row_lo) & (frontier < row_lo + n_rows)
+    mine = (valid_mask(frontier) & (frontier >= row_lo)
+            & (frontier < row_lo + n_rows))
     local_f = jnp.where(mine, frontier - row_lo, sentinel(frontier.dtype))
-    nbrs, seg, edge_pos, valid, total = gather_edges(indptr, indices, local_f, edge_cap)
+    return gather_edges(indptr, indices, local_f, edge_cap)
+
+
+def _local_expand(indptr, indices, row_lo, frontier, edge_cap):
+    nbrs, _seg, _pos, _valid, total = _local_expand_full(
+        indptr, indices, row_lo, frontier, edge_cap)
     return nbrs, total
 
 
@@ -88,13 +96,8 @@ def scatter_gather_hop(mesh: Mesh, rel: ShardedRel, frontier: jax.Array,
 @functools.lru_cache(maxsize=64)
 def _build_matrix_hop(mesh: Mesh, edge_cap: int):
     def per_device(indptr_b, indices_b, row_lo_b, frontier):
-        indptr, indices, row_lo = indptr_b[0], indices_b[0], row_lo_b[0]
-        n_rows = indptr.shape[0] - 1
-        mine = (valid_mask(frontier) & (frontier >= row_lo)
-                & (frontier < row_lo + n_rows))
-        local_f = jnp.where(mine, frontier - row_lo, sentinel(frontier.dtype))
-        nbrs, seg, edge_pos, valid, total = gather_edges(
-            indptr, indices, local_f, edge_cap)
+        nbrs, seg, edge_pos, valid, total = _local_expand_full(
+            indptr_b[0], indices_b[0], row_lo_b[0], frontier, edge_cap)
         max_shard = lax.pmax(total, SHARD_AXIS)
         return (nbrs[None], seg[None], edge_pos[None], total[None],
                 max_shard)
@@ -127,6 +130,54 @@ def matrix_hop(mesh: Mesh, rel: ShardedRel, frontier: jax.Array,
     edge_cap; otherwise re-run at a bigger bucket."""
     return _build_matrix_hop(mesh, edge_cap)(
         rel.indptr_s, rel.indices_s, rel.row_lo, frontier)
+
+
+@functools.lru_cache(maxsize=64)
+def _build_matrix_level(mesh: Mesh, edge_cap: int, use_allowed: bool):
+    from dgraph_tpu.ops.level import filter_paginate
+
+    def per_device(indptr_b, indices_b, row_lo_b, frontier, allowed,
+                   offset, first):
+        nbrs, seg, edge_pos, valid, total = _local_expand_full(
+            indptr_b[0], indices_b[0], row_lo_b[0], frontier, edge_cap)
+        # rows partition over shards, so per-row filter+pagination is
+        # shard-local; `allowed` is replicated (it is an index lookup set,
+        # small next to the edge set)
+        c_nbrs, c_seg, c_pos, n_kept, _ = filter_paginate(
+            nbrs, seg, edge_pos, valid, allowed, offset, first,
+            frontier.shape[0], use_allowed)
+        max_shard = lax.pmax(total, SHARD_AXIS)
+        return (c_nbrs[None], c_seg[None], c_pos[None], n_kept[None],
+                total[None], max_shard)
+
+    fn = jax.shard_map(
+        per_device, mesh=mesh,
+        in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS), P(), P(),
+                  P(), P()),
+        out_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS),
+                   P(SHARD_AXIS), P(SHARD_AXIS), P()),
+        check_vma=False,
+    )
+    return jax.jit(fn, static_argnames=())
+
+
+def matrix_level(mesh: Mesh, rel: ShardedRel, frontier: jax.Array,
+                 allowed: jax.Array, offset, first, edge_cap: int,
+                 use_allowed: bool):
+    """The fused level (expand → filter → paginate → compact) as ONE SPMD
+    program — matrix_hop and ops.level.expand_level combined, so the served
+    mesh engine gets the same fused fast path as the single-device one
+    (reference: ProcessTaskOverNetwork with the filter/pagination pushed
+    into each group's processTask rather than applied at the coordinator).
+
+    Returns (nbrs[D, edge_cap], seg[D, edge_cap], pos[D, edge_cap],
+    kept[D], totals[D], max_shard_edges): per shard d the first kept[d]
+    slots are its surviving edges in CSR row order; seg indexes the GLOBAL
+    frontier; pos is local (add rel.pos_lo[d]). Valid only if
+    max_shard_edges ≤ edge_cap."""
+    return _build_matrix_level(mesh, edge_cap, use_allowed)(
+        rel.indptr_s, rel.indices_s, rel.row_lo, frontier, allowed,
+        jnp.int32(offset), jnp.int32(first))
 
 
 @functools.lru_cache(maxsize=64)

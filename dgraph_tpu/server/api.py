@@ -40,12 +40,13 @@ class Alpha:
 
     def __init__(self, base: Store | None = None,
                  device_threshold: int = 512, wal=None, base_ts: int = 0,
-                 oracle=None, groups=None):
+                 oracle=None, groups=None, mesh=None):
         self.oracle = oracle if oracle is not None else Oracle()
         self.mvcc = MVCCStore(base=base, base_ts=base_ts)
         self.oracle.bump_ts(base_ts)
         self.xidmap = XidMap(self.oracle)
         self.device_threshold = device_threshold
+        self.mesh = mesh  # jax.sharding.Mesh | None: served SPMD engine
         self.wal = wal  # store.wal.WAL | None: fsync'd commit log
         self.groups = groups  # cluster.groups.Groups | None
         # tablet freshness learned from the mutation broadcast: pred →
@@ -64,7 +65,7 @@ class Alpha:
 
     @classmethod
     def open(cls, p_dir: str, device_threshold: int = 512,
-             sync: bool = True) -> "Alpha":
+             sync: bool = True, mesh=None) -> "Alpha":
         """Boot from a persistence dir: newest checkpoint + WAL replay
         (reference: Badger open + raft WAL restore on alpha start). Every
         commit that reached the WAL before a crash is recovered."""
@@ -79,7 +80,7 @@ class Alpha:
             base, base_ts = checkpoint.load(p_dir)
         wal_path = os.path.join(p_dir, "wal.log")
         alpha = cls(base=base, device_threshold=device_threshold,
-                    base_ts=base_ts)
+                    base_ts=base_ts, mesh=mesh)
         max_ts, max_uid = base_ts, 0
         for ts, kind, obj in replay(wal_path):
             if ts <= base_ts:
@@ -178,8 +179,8 @@ class Alpha:
             if self.groups is not None:
                 from dgraph_tpu.cluster.routed import routed_view
                 store = routed_view(self, store, ts)
-            out = Engine(store, device_threshold=self.device_threshold
-                         ).query(dql, variables)
+            out = Engine(store, device_threshold=self.device_threshold,
+                         mesh=self.mesh).query(dql, variables)
         self._maybe_gc()
         return out
 

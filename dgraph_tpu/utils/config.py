@@ -38,7 +38,7 @@ class AlphaConfig:
     http_port: int = 8080
     grpc_port: int = 9080
     device_threshold: int = 512   # frontier size that moves a hop on-device
-    mesh_devices: int = 0         # 0 = all visible devices
+    mesh_devices: int = 0         # 0 = no mesh; -1 = all devices; N = N
     rollup_every: int = 64        # commits between automatic rollups
     log_level: str = "info"
 
