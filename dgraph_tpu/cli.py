@@ -66,6 +66,11 @@ def cmd_alpha(args) -> int:
             max_uid=replayed_uid)
         log.info("joined cluster: node=%d group=%d",
                  alpha.groups.node_id, alpha.groups.gid)
+        # rejoin catch-up: pull any WAL tail we missed while down, then
+        # force freshness re-checks on every foreign tablet (reference:
+        # restarted follower replays the leader's log + snapshot)
+        if alpha.groups.other_addrs():
+            alpha.resync_on_join()
     http_server = make_http_server(alpha, cfg.http_addr, cfg.http_port)
     serve_background(http_server)
     log.info("alpha up: grpc=%d http=%d", grpc_port,

@@ -66,6 +66,20 @@ class Groups:
         with self._lock:
             return sorted(self._groups.get(gid, {}).values())
 
+    def addr_of_node(self, node_id: int) -> str | None:
+        """Address of a node id anywhere in the cluster (broadcast-chain
+        catch-up needs the origin's address)."""
+        with self._lock:
+            for nodes in self._groups.values():
+                if node_id in nodes:
+                    return nodes[node_id]
+        self.refresh()
+        with self._lock:
+            for nodes in self._groups.values():
+                if node_id in nodes:
+                    return nodes[node_id]
+        return None
+
     def other_addrs(self) -> list[str]:
         """Every node in the cluster except this one (broadcast targets).
         Always re-polls membership first: a commit must reach nodes that
@@ -87,12 +101,31 @@ class Groups:
                 c = self._pools[addr] = Client(addr)
             return c
 
-    def call_group(self, gid: int, fn):
+    def invalidate(self, addr: str) -> None:
+        """Drop a pooled channel after a failure: a cached grpc channel
+        sits in reconnect backoff and fails fast long after the peer is
+        healthy again; a fresh dial on the next call finds it immediately
+        (reference: conn/pool.go re-dials dead connections)."""
+        with self._lock:
+            c = self._pools.pop(addr, None)
+        if c is not None:
+            try:
+                c.close()
+            except Exception:  # noqa: BLE001 — already broken
+                pass
+
+    def call_group(self, gid: int, fn, exclude=()):
         """Run `fn(client)` against any live node of a group, trying
         replicas in order — read failover (reference: reads served by any
-        replica; pool pick + retry)."""
+        replica; pool pick + retry). `exclude` skips peers known to be
+        lagging (suspects from a failed broadcast); if every replica is
+        excluded they are retried anyway — a possibly-stale answer beats
+        none."""
         last = None
-        for addr in self.group_addrs(gid):
+        addrs = self.group_addrs(gid)
+        ordered = ([a for a in addrs if a not in exclude]
+                   + [a for a in addrs if a in exclude])
+        for addr in ordered:
             try:
                 return fn(self.pool(addr))
             except grpc.RpcError as e:

@@ -55,6 +55,16 @@ class Alpha:
         self.tablet_versions: dict[str, int] = {}
         self._stale_preds: set[str] = set()
         self._tablet_cache: dict[tuple[str, int], object] = {}
+        # broadcast chaining (replica catch-up): what we last APPLIED from
+        # each origin node, what we last SENT, and peers that missed one of
+        # our broadcasts (excluded from read failover until a later chained
+        # broadcast succeeds — the receiver catches up before acking)
+        self._last_from: dict[int, int] = {}
+        self._last_sent_ts = 0
+        self._suspect_peers: dict[str, int] = {}
+        # oldest ts the local WAL still covers (records at or below were
+        # absorbed by a checkpoint); FetchLog answers "complete" only above
+        self._wal_floor = base_ts
         self._apply_lock = threading.Lock()
         self._state_lock = threading.Lock()
         self._open_txns: dict[int, Txn] = {}
@@ -92,6 +102,8 @@ class Alpha:
             elif kind == "drop":
                 alpha.mvcc = MVCCStore()
                 alpha.xidmap = XidMap(alpha.oracle)
+            elif alpha.mvcc.has_applied(ts):
+                continue  # duplicate record (catch-up raced a broadcast)
             else:
                 alpha.mvcc.apply(obj, ts)
                 for s, _p, o, *_ in obj.edge_sets:
@@ -103,6 +115,11 @@ class Alpha:
         alpha.oracle.bump_ts(max_ts)
         if max_uid:
             alpha.oracle.bump_uid(max_uid)
+        # seed the broadcast chain at the replayed horizon: prev_ts on our
+        # first post-restart broadcast must not regress to 0 (a receiver
+        # would miss the gap check); a too-HIGH prev only triggers a
+        # harmless spurious catch-up on peers
+        alpha._last_sent_ts = max_ts
         alpha.wal = WAL(wal_path, sync=sync)
         return alpha
 
@@ -119,6 +136,7 @@ class Alpha:
             checkpoint.save_versioned(store, p_dir, base_ts=ts)
             if self.wal is not None:
                 self.wal.truncate(ts)
+            self._wal_floor = max(self._wal_floor, ts)
         return ts
 
     # -- public api surface (api.Dgraph analog) -----------------------------
@@ -225,35 +243,36 @@ class Alpha:
         """Schema mutation + index rebuild (reference: Server.Alter →
         schema.Update + posting.RebuildIndex). The new snapshot is built
         under the merged schema and swapped in atomically, so concurrent
-        queries see either fully-old or fully-new index state."""
-        self.apply_schema_broadcast(schema_text)
+        queries see either fully-old or fully-new index state. The
+        broadcast rides the same chain as mutations, so a peer that
+        misses an Alter pulls it (the schema record is in our WAL) on the
+        next chained message instead of diverging forever."""
+        ts = self.apply_schema_broadcast(schema_text)
         if self.groups is not None:
-            import grpc as _grpc
-            for addr in self.groups.other_addrs():
-                try:
-                    self.groups.pool(addr).apply_schema(schema_text)
-                except _grpc.RpcError:
-                    continue
+            with self._apply_lock:
+                self._broadcast_chained(
+                    ts, lambda c, origin, prev: c.apply_schema(
+                        schema_text, ts=ts, origin=origin, prev_ts=prev))
 
     def drop_all(self) -> None:
         """reference: api.Operation{DropAll}. Broadcast like Alter: every
         node must drop or spanning queries diverge against survivors."""
-        self.apply_drop_broadcast()
+        ts = self.apply_drop_broadcast()
         if self.groups is not None:
-            import grpc as _grpc
-            for addr in self.groups.other_addrs():
-                try:
-                    self.groups.pool(addr).apply_drop()
-                except _grpc.RpcError:
-                    continue
+            with self._apply_lock:
+                self._broadcast_chained(
+                    ts, lambda c, origin, prev: c.apply_drop(
+                        ts=ts, origin=origin, prev_ts=prev))
 
-    def apply_drop_broadcast(self) -> None:
-        """Receive a DropAll from another coordinator (no re-broadcast).
-        Tablet caches must reset too — a cached foreign tablet would keep
-        serving pre-drop data locally."""
+    def apply_drop_broadcast(self, ts: int = 0) -> int:
+        """Receive/apply a DropAll (no re-broadcast). Tablet caches must
+        reset too — a cached foreign tablet would keep serving pre-drop
+        data locally. Returns the drop's ts (chained broadcasts key on
+        it)."""
         with self._apply_lock:
+            ts = ts or self.oracle.read_only_ts()
             if self.wal is not None:
-                self.wal.append_drop(self.oracle.read_only_ts())
+                self.wal.append_drop(ts)
             self.mvcc = MVCCStore()
             self.xidmap = XidMap(self.oracle)
             with self._state_lock:
@@ -261,6 +280,7 @@ class Alpha:
                 self.tablet_versions.clear()
                 self._stale_preds.clear()
                 self._tablet_cache.clear()
+        return ts
 
     # -- commit path (worker/draft.go applyMutations analog) ----------------
     def _commit(self, txn: "Txn") -> int:
@@ -285,24 +305,173 @@ class Alpha:
         group's tablets plus the vocab touches, so replicas of a group
         converge and the dense rank space stays cluster-wide identical
         (reference: MutateOverNetwork fan-out + raft replication within
-        each group, collapsed into one broadcast)."""
-        import grpc as _grpc
+        each group, collapsed into one broadcast).
 
+        Each broadcast chains to the sender's previous one (origin +
+        prev_ts): a receiver that missed a record detects the gap on the
+        next chained message and pulls the tail via FetchLog BEFORE
+        applying/acking. A peer that misses a broadcast is marked suspect
+        (skipped by read failover); a later successful chained broadcast
+        clears it, because the ack implies the peer converged first."""
         from dgraph_tpu.store.wal import mut_to_bytes
         self.apply_committed(mut, commit_ts)
-        payload = mut_to_bytes(mut)
+        self._broadcast_chained(
+            commit_ts, lambda c, origin, prev: c.apply_mutation(
+                mut_to_bytes(mut), commit_ts, origin=origin, prev_ts=prev))
+
+    def _broadcast_chained(self, ts: int, send) -> None:
+        """Send one chained record to every peer; track suspects. Callers
+        hold _apply_lock, which serializes the prev/_last_sent_ts chain."""
+        import grpc as _grpc
+        prev = self._last_sent_ts
+        self._last_sent_ts = ts
         for addr in self.groups.other_addrs():
             try:
-                self.groups.pool(addr).apply_mutation(payload, commit_ts)
+                send(self.groups.pool(addr), self.groups.node_id, prev)
+                with self._state_lock:
+                    self._suspect_peers.pop(addr, None)
             except _grpc.RpcError as e:
-                # v1: a dead node misses the record and must rejoin from a
-                # fresh snapshot (no raft catch-up log yet); reads keep
-                # serving from surviving replicas
+                # the peer missed this record: its tablets may serve stale
+                # reads — exclude it from failover until it resyncs (the
+                # chained gap triggers that on our next broadcast). Drop
+                # the pooled channel so the retry isn't stuck in backoff.
+                with self._state_lock:
+                    self._suspect_peers.setdefault(addr, ts)
+                self.groups.invalidate(addr)
                 from dgraph_tpu.utils import logging as xlog
                 xlog.get("alpha").warning(
-                    "broadcast of commit_ts %d to %s failed: %s",
-                    commit_ts, addr, e.code() if hasattr(e, "code") else e)
+                    "broadcast of ts %d to %s failed (%s); peer marked "
+                    "suspect until it catches up",
+                    ts, addr, e.code() if hasattr(e, "code") else e)
                 continue
+
+    def receive_broadcast(self, kind: str, obj, ts: int,
+                          origin: int, prev_ts: int) -> None:
+        """Broadcast receive path with gap detection: if the sender's
+        chain skips past what we last saw from it, pull the missed WAL
+        tail from the origin BEFORE applying this record. Applies are
+        idempotent against duplicates (catch-up may have just pulled the
+        very record being delivered)."""
+        if origin:
+            last = self._last_from.get(origin, 0)
+            if prev_ts > last:
+                # we missed (last, prev_ts] from this origin
+                addr = self.groups.addr_of_node(origin)
+                if addr is not None:
+                    self.catch_up(addr, since_ts=last)
+            self._last_from[origin] = max(
+                self._last_from.get(origin, 0), ts)
+        if kind == "schema":
+            self.apply_schema_broadcast(obj, ts=ts)
+        elif kind == "drop":
+            self.apply_drop_broadcast(ts=ts)
+        elif not self.mvcc.has_applied(ts):
+            self.apply_committed(obj, ts)
+
+    def catch_up(self, addr: str, since_ts: int) -> bool:
+        """Pull and apply the peer's WAL records above since_ts
+        (reference: raft log replay for a lagging follower). Returns False
+        when the peer's WAL no longer covers since_ts — the caller falls
+        back to snapshot resync (mark tablets stale / TabletSnapshot).
+
+        since_ts is clamped to our own fold floor: records at or below it
+        are already inside our snapshots, and re-absorbing them would
+        duplicate @list values (apply is set-idempotent per layer, not
+        against folded history)."""
+        from dgraph_tpu.utils import logging as xlog
+        log = xlog.get("alpha")
+        since_ts = max(since_ts, self.mvcc.base_ts)
+        records, complete = self.groups.pool(addr).fetch_log(since_ts)
+        applied = 0
+        for ts, kind, obj in records:
+            if kind == "schema":
+                self.apply_schema_broadcast(obj, ts=ts)
+                continue
+            if kind == "drop":
+                self.apply_drop_broadcast(ts=ts)
+                continue
+            if self.mvcc.has_applied(ts):
+                continue
+            self.apply_committed(obj, ts)
+            applied += 1
+        if applied:
+            log.info("caught up %d records > ts %d from %s",
+                     applied, since_ts, addr)
+        if not complete:
+            # records older than the peer's WAL floor may be missing from
+            # us entirely: snapshot-level resync — foreign tablets go
+            # stale (re-validated on next read), owned tablets re-pull
+            # from a group replica when one exists
+            log.warning("peer %s WAL truncated above since_ts %d; "
+                        "snapshot-level resync", addr, since_ts)
+            self.mark_all_stale()
+            self.resync_owned_tablets()
+        return complete
+
+    def mark_all_stale(self) -> None:
+        """Force freshness checks: every known foreign predicate must
+        re-validate against its owner before serving (rejoin / deep-gap
+        path)."""
+        with self._state_lock:
+            preds = set(self.mvcc.base.preds) | set(self.tablet_versions)
+            for p in preds:
+                if self.groups is None or not self.groups.serves(p):
+                    self._stale_preds.add(p)
+            self._tablet_cache.clear()
+
+    def resync_owned_tablets(self) -> None:
+        """Replace every OWNED tablet with a fresh snapshot from a group
+        replica (reference: Badger Stream snapshot from the leader). A
+        sole-replica group has nobody to pull from — records truncated
+        out of every peer's WAL are lost for it; logged loudly (the
+        reference's quorum write would have refused the commit instead)."""
+        import grpc as _grpc
+
+        from dgraph_tpu.cluster.tablet import unpack_tablet
+        from dgraph_tpu.utils import logging as xlog
+        log = xlog.get("alpha")
+        replicas = [a for a in self.groups.group_addrs(self.groups.gid)
+                    if a != self.groups.my_addr]
+        owned = [p for p in set(self.mvcc.base.preds)
+                 | set(self.tablet_versions) if self.groups.serves(p)]
+        if not replicas:
+            if owned:
+                log.error(
+                    "no group replica to resync owned tablets %s from; "
+                    "records truncated from peer WALs are unrecoverable",
+                    sorted(owned))
+            return
+        ts = self.oracle.read_only_ts()
+        for pred in owned:
+            for addr in replicas:
+                try:
+                    blob, _v = self.groups.pool(addr).tablet_snapshot(
+                        pred, ts)
+                except _grpc.RpcError:
+                    continue
+                if blob:
+                    pd = unpack_tablet(blob, pred, self.mvcc.schema)
+                    self.mvcc.install_tablet(pred, pd)
+                    log.info("owned tablet %s resynced from %s", pred, addr)
+                break
+
+    def resync_on_join(self, peer_addrs=None) -> None:
+        """Rejoin catch-up (reference: restarted follower replaying the
+        leader's log + snapshot): pull WAL tails from peers, then mark
+        foreign tablets stale so reads re-validate freshness."""
+        addrs = (peer_addrs if peer_addrs is not None
+                 else self.groups.other_addrs())
+        # fetch from our fold floor, NOT our newest layer: commits by other
+        # coordinators interleave with our replayed tail, so anything above
+        # the floor could be missing; has_applied() skips what we do have
+        since = self.mvcc.base_ts
+        for addr in addrs:
+            try:
+                self.catch_up(addr, since_ts=since)
+                break
+            except Exception:  # noqa: BLE001 — any live peer will do
+                continue
+        self.mark_all_stale()
 
     def apply_committed(self, mut: Mutation, commit_ts: int) -> None:
         """Install a committed mutation on THIS node: the subset of
@@ -323,21 +492,23 @@ class Alpha:
                     self.tablet_versions.get(p, 0), commit_ts)
                 if p not in owned:
                     self._stale_preds.add(p)
+        # the WAL stores the FULL record (not the owned subset): it doubles
+        # as the replication log FetchLog serves to lagging peers, who need
+        # every predicate to extract their own subset
+        if self.wal is not None:
+            self.wal.append(mut, commit_ts)
         try:
-            if self.wal is not None:
-                self.wal.append(sub, commit_ts)
             self.mvcc.apply(sub, commit_ts)
         except ValueError:
-            # straggler below a fold point (another coordinator's commit
-            # raced a local rollup/alter). Foreign tablets recover via the
-            # owner refetch path; OWNED data in this record is lost until
-            # a snapshot resync — log loudly (v1: no raft catch-up log).
+            # commit below a fold point (another coordinator's commit
+            # raced a local rollup/alter, or catch-up recovered an old
+            # record): fold it into the affected snapshots in place —
+            # no data loss, reads at ts >= commit_ts see it
             from dgraph_tpu.utils import logging as xlog
-            xlog.get("alpha").error(
-                "straggler commit_ts %d below fold point; marking %s stale",
-                commit_ts, sorted(touched))
-            with self._state_lock:
-                self._stale_preds.update(touched)
+            xlog.get("alpha").warning(
+                "absorbing straggler commit_ts %d below fold point %d",
+                commit_ts, self.mvcc.base_ts)
+            self.mvcc.absorb_straggler(sub, commit_ts)
 
     def _needs_fetch(self, pred: str, read_ts: int,
                      present_locally) -> bool:
@@ -372,7 +543,8 @@ class Alpha:
                     return cached
         from dgraph_tpu.cluster.tablet import unpack_tablet
         blob, got_version = self.groups.call_group(
-            gid, lambda c: c.tablet_snapshot(pred, read_ts))
+            gid, lambda c: c.tablet_snapshot(pred, read_ts),
+            exclude=set(self._suspect_peers))
         if not blob:
             return None
         pd = unpack_tablet(blob, pred, self.mvcc.schema)
@@ -391,16 +563,18 @@ class Alpha:
                     del self._tablet_cache[k]
         return pd
 
-    def apply_schema_broadcast(self, schema_text: str) -> None:
-        """Receive an Alter from another coordinator (no re-broadcast)."""
+    def apply_schema_broadcast(self, schema_text: str,
+                               ts: int = 0) -> int:
+        """Receive/apply an Alter (no re-broadcast). Returns its ts."""
         new = parse_schema(schema_text)
         with self._apply_lock:
+            ts = ts or self.oracle.read_only_ts()
             merged = self.mvcc.schema.clone()
             merged.update(new)
             if self.wal is not None:
-                self.wal.append_schema(schema_text,
-                                       self.oracle.read_only_ts())
+                self.wal.append_schema(schema_text, ts)
             self.mvcc.rebuild_base(schema=merged)
+        return ts
 
     def _txn_done(self, txn: "Txn") -> None:
         with self._state_lock:

@@ -140,17 +140,43 @@ class WorkerService:
 
     # -- cluster seams (worker/draft.go apply + snapshot shipping) ----------
     def ApplyMutation(self, req: pb.MutationMsg, ctx) -> pb.Payload:
-        """Receive a committed-mutation broadcast (log shipping)."""
-        if req.drop_all:
-            self.alpha.apply_drop_broadcast()
-            return pb.Payload(data=b"ok")
-        if req.schema:
-            self.alpha.apply_schema_broadcast(req.schema)
-            return pb.Payload(data=b"ok")
+        """Receive a broadcast (log shipping) — mutation, Alter, or
+        DropAll, all riding one chain. Chained origin/prev_ts trigger gap
+        catch-up BEFORE applying (the ack then certifies the receiver
+        converged through this record's ts)."""
         from dgraph_tpu.store.wal import mut_from_bytes
-        self.alpha.apply_committed(mut_from_bytes(req.mut_json),
-                                   int(req.commit_ts))
+        if req.drop_all:
+            kind, obj = "drop", None
+        elif req.schema:
+            kind, obj = "schema", req.schema
+        else:
+            kind, obj = "mut", mut_from_bytes(req.mut_json)
+        self.alpha.receive_broadcast(kind, obj, int(req.commit_ts),
+                                     int(req.origin), int(req.prev_ts))
         return pb.Payload(data=b"ok")
+
+    def FetchLog(self, req: pb.FetchLogRequest, ctx) -> pb.LogRecords:
+        """Serve the local WAL tail above since_ts (reference: raft log
+        replay to a lagging follower / Badger Stream). Records are FULL
+        mutations (apply_committed logs them unrestricted), so any peer
+        can extract its own subset."""
+        from dgraph_tpu.store.wal import mut_to_bytes, replay
+        since = int(req.since_ts)
+        out = pb.LogRecords(complete=since >= self.alpha._wal_floor)
+        if self.alpha.wal is None:
+            out.complete = False
+            return out
+        for ts, kind, obj in replay(self.alpha.wal.path):
+            if ts <= since:
+                continue
+            if kind == "mut":
+                out.records.append(pb.LogRecord(
+                    ts=ts, mut_json=mut_to_bytes(obj)))
+            elif kind == "schema":
+                out.records.append(pb.LogRecord(ts=ts, schema=obj))
+            else:
+                out.records.append(pb.LogRecord(ts=ts, drop=True))
+        return out
 
     def TabletSnapshot(self, req: pb.TabletSnapshotRequest,
                        ctx) -> pb.TabletSnapshot:
@@ -189,6 +215,7 @@ def make_server(alpha: Alpha, addr: str = "127.0.0.1:0",
         grpc.method_handlers_generic_handler(SERVICE_WORKER, {
             "ServeTask": _unary(w.ServeTask, pb.TaskQuery),
             "ApplyMutation": _unary(w.ApplyMutation, pb.MutationMsg),
+            "FetchLog": _unary(w.FetchLog, pb.FetchLogRequest),
             "TabletSnapshot": _unary(w.TabletSnapshot,
                                      pb.TabletSnapshotRequest),
         }),
@@ -236,18 +263,42 @@ class Client:
         return self._call(SERVICE_WORKER, "ServeTask",
                           pb.TaskQuery(**kw), pb.TaskResult)
 
-    def apply_mutation(self, mut_json: bytes, commit_ts: int) -> None:
+    def apply_mutation(self, mut_json: bytes, commit_ts: int,
+                       origin: int = 0, prev_ts: int = 0) -> None:
         self._call(SERVICE_WORKER, "ApplyMutation",
-                   pb.MutationMsg(mut_json=mut_json, commit_ts=commit_ts),
+                   pb.MutationMsg(mut_json=mut_json, commit_ts=commit_ts,
+                                  origin=origin, prev_ts=prev_ts),
                    pb.Payload)
 
-    def apply_schema(self, schema_text: str) -> None:
-        self._call(SERVICE_WORKER, "ApplyMutation",
-                   pb.MutationMsg(schema=schema_text), pb.Payload)
+    def fetch_log(self, since_ts: int):
+        """Returns ([(ts, kind, obj)...], complete) mirroring wal.replay."""
+        from dgraph_tpu.store.wal import mut_from_bytes
+        r = self._call(SERVICE_WORKER, "FetchLog",
+                       pb.FetchLogRequest(since_ts=since_ts), pb.LogRecords)
+        out = []
+        for rec in r.records:
+            if rec.drop:
+                out.append((int(rec.ts), "drop", None))
+            elif rec.schema:
+                out.append((int(rec.ts), "schema", rec.schema))
+            else:
+                out.append((int(rec.ts), "mut",
+                            mut_from_bytes(rec.mut_json)))
+        return out, bool(r.complete)
 
-    def apply_drop(self) -> None:
+    def apply_schema(self, schema_text: str, ts: int = 0, origin: int = 0,
+                     prev_ts: int = 0) -> None:
         self._call(SERVICE_WORKER, "ApplyMutation",
-                   pb.MutationMsg(drop_all=True), pb.Payload)
+                   pb.MutationMsg(schema=schema_text, commit_ts=ts,
+                                  origin=origin, prev_ts=prev_ts),
+                   pb.Payload)
+
+    def apply_drop(self, ts: int = 0, origin: int = 0,
+                   prev_ts: int = 0) -> None:
+        self._call(SERVICE_WORKER, "ApplyMutation",
+                   pb.MutationMsg(drop_all=True, commit_ts=ts,
+                                  origin=origin, prev_ts=prev_ts),
+                   pb.Payload)
 
     def tablet_snapshot(self, attr: str, read_ts: int = 0):
         r = self._call(SERVICE_WORKER, "TabletSnapshot",

