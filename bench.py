@@ -3,24 +3,30 @@
 Reference parity: BASELINE.json's north star — @recurse traversal
 throughput (query/recurse.go expandRecurse), measured the way the
 reference's benchmarks run it: a CONCURRENT MIX of queries (LDBC SNB IC
-style, BASELINE.json configs[4]), not one query at a time. The reference
-serves the mix with per-query goroutines walking posting lists
-(posting/list.go List.Uids); the CPU baseline here is the same algorithm
-vectorised per query in numpy — a stronger per-query engine than Go
-per-uid loops — and is measured DIRECTLY over all B queries (no
-extrapolation; the measured window is multiple seconds).
+style), not one query at a time. The reference serves the mix with
+per-query goroutines walking posting lists; the CPU baseline here is the
+same algorithm vectorised per query in numpy — a stronger per-query
+engine than Go per-uid loops — measured DIRECTLY over all B queries at
+the SAME concurrency as the device run (no extrapolation).
 
-The device numerator is ops/bfs.py::bitmap_recurse: B=256 traversals
-packed into the lanes of a frontier bitmap, the whole depth-4 batch as ONE
-fused XLA program (per hop: one wide row-gather + one row-scatter over the
-COO edge list + a deg·mask MXU matvec for the edge counters). Useful-edge
-counts are identical on both sides; wall-clock is what differs.
+The device numerator is ops/bfs.py::ell_recurse: B traversals packed into
+the bit-lanes of a frontier mask, the whole depth-4 batch as ONE fused XLA
+program. Per hop: pure ELL gathers + bitwise ORs (no scatter — measured
+~10 ns per random row access on v5e regardless of row width, so the
+kernel amortises each access over B=2048 lanes) + one MXU matvec for the
+exact per-query edge counters.
 
-Robustness contract (the driver grades this file): all device work runs in
-a SUBPROCESS under a deadline — a wedged TPU backend (which hangs inside
-uninterruptible XLA init) cannot poison the parent. On TPU failure the
-parent re-runs the child on the XLA CPU backend so a real kernel number
-still comes out, marked platform=cpu. One parseable JSON line is printed
+Robustness contract (the driver grades this file): device work runs in a
+SUBPROCESS in STAGES, each with its own deadline and its own JSON line on
+the child's stdout —
+    stage0  backend init + 128^2 matmul smoke
+    stage1  small-graph ell_recurse (tiny compile)
+    stage2  full workload
+so the graded output distinguishes "init hung" from "compile slow" from a
+real number, and a partial result (stage1) is still reported if stage2
+dies. XLA compile artifacts persist in .jax_cache, so re-runs skip the
+compile cost entirely. On TPU failure the parent re-runs the child on the
+XLA CPU backend, marked platform=cpu. One parseable JSON line is printed
 in every outcome; errors ride along in an "error" field.
 
 Prints ONE JSON line:
@@ -38,18 +44,21 @@ import time
 
 import numpy as np
 
+ROOT = os.path.dirname(os.path.abspath(__file__))
+
 N_NODES = 1 << 20          # ~1M nodes
 AVG_DEG = 16.0             # ~16M directed edges
-B = 256                    # concurrent queries (bitmap lanes)
-SEEDS_PER_QUERY = 4
 DEPTH = 4
-DEV_REPS = 5
+SEEDS_PER_QUERY = 4
+B_DEV = 2048               # device lanes (64 uint32 words per row)
+B_CPU_FALLBACK = 256       # smaller batch for the XLA-CPU fallback child
+SMALL_N = 1 << 16          # stage1 graph
+DEV_REPS = 4
 
-METRIC = f"edges_traversed_per_sec_{DEPTH}hop_recurse_{B}q"
-GLOBAL_DEADLINE_S = 780    # parent ceiling: emit JSON before any external
-                           # timeout can kill us silently
-CHILD_TPU_S = 420          # graph rebuild + init + transfer + compile + reps
-CHILD_CPU_S = 300
+METRIC = f"edges_traversed_per_sec_{DEPTH}hop_recurse_{B_DEV}q"
+GLOBAL_DEADLINE_S = 780
+STAGE_DEADLINES = {"stage0": 150.0, "stage1": 240.0, "stage2": 330.0}
+HBM_PEAK_GBPS = 819.0      # v5e single chip
 
 _emitted = threading.Event()
 
@@ -59,27 +68,25 @@ def log(*a):
 
 
 def emit(obj) -> None:
-    """Print the single graded JSON line exactly once, then hard-exit is
-    the caller's job (abandoned XLA threads may hold locks)."""
     if _emitted.is_set():
         return
     _emitted.set()
     print(json.dumps(obj), flush=True)
 
 
-def build_workload():
+def build_graph(n, avg, seed=42):
     from dgraph_tpu.models.synthetic import powerlaw_rel
+    return powerlaw_rel(n, avg, seed=seed)
 
-    rel = powerlaw_rel(N_NODES, AVG_DEG, seed=42)
-    rng = np.random.default_rng(7)
-    seed_lists = [rng.integers(0, N_NODES, SEEDS_PER_QUERY)
-                  for _ in range(B)]
-    return rel, seed_lists
+
+def make_seeds(n, B, seed=7):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, n, SEEDS_PER_QUERY) for _ in range(B)]
 
 
 def cpu_recurse(indptr, indices, seeds, depth):
-    """Vectorised numpy loop=false recurse for ONE query (the per-goroutine
-    walk of the reference). Returns edges traversed."""
+    """Vectorised numpy loop=false recurse for ONE query (the reference's
+    per-goroutine walk). Returns edges traversed."""
     frontier = np.unique(seeds).astype(np.int64)
     seen_mask = np.zeros(indptr.shape[0] - 1, bool)
     seen_mask[frontier] = True
@@ -102,9 +109,14 @@ def cpu_recurse(indptr, indices, seeds, depth):
 
 
 # ---------------------------------------------------------------------------
-# child: one device measurement on the requested platform
+# child: staged device measurement; one JSON line per stage on stdout
 
-def child_main(platform: str) -> None:
+def _stage(obj) -> None:
+    print(json.dumps(obj), flush=True)
+
+
+def child_main(platform: str, expect_path: str) -> None:
+    B = B_DEV if platform == "default" else B_CPU_FALLBACK
     if platform == "cpu":
         os.environ["JAX_PLATFORMS"] = "cpu"
 
@@ -112,73 +124,171 @@ def child_main(platform: str) -> None:
 
     if platform == "cpu":
         jax.config.update("jax_platforms", "cpu")
+    # persistent compile cache: the expensive gather programs compile once
+    # per environment; later runs (incl. the driver's graded one) hit disk
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.join(ROOT, ".jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
 
+    import jax.numpy as jnp
+    from dgraph_tpu.ops.bfs import (build_ell, make_ell_recurse,
+                                    pack_seed_masks)
+
+    # -- stage0: backend alive + MXU smoke ----------------------------------
     t0 = time.perf_counter()
     plat = jax.devices()[0].platform
-    log(f"child backend: {plat} ({time.perf_counter() - t0:.1f}s)")
+    x = jnp.ones((128, 128), jnp.bfloat16)
+    np.asarray(x @ x)
+    _stage({"stage": "stage0", "platform": plat,
+            "secs": round(time.perf_counter() - t0, 2)})
 
-    rel, seed_lists = build_workload()
-    cpu_edges = [cpu_recurse(rel.indptr, rel.indices, s, DEPTH)
-                 for s in seed_lists]
-
-    from dgraph_tpu.ops.bfs import bitmap_recurse, ranks_to_bitmap
-
-    deg = (rel.indptr[1:] - rel.indptr[:-1]).astype(np.int32)
-    src = np.repeat(np.arange(N_NODES, dtype=np.int32), deg)
-    mask0 = ranks_to_bitmap(seed_lists, N_NODES)
-
+    # -- stage1: small graph, small compile ---------------------------------
     t0 = time.perf_counter()
-    src_d = jax.device_put(src)
-    dst_d = jax.device_put(rel.indices)
-    deg_d = jax.device_put(deg)
-    mask_d = jax.device_put(mask0)
-    jax.block_until_ready((src_d, dst_d, deg_d, mask_d))
-    log(f"child device_put: {time.perf_counter() - t0:.1f}s")
-
-    def run():
-        _l, _s, edges = bitmap_recurse(src_d, dst_d, deg_d, mask_d,
-                                       depth=DEPTH)
-        return np.asarray(edges)  # forces full sync
-
-    t0 = time.perf_counter()
-    edges_dev = run()
-    log(f"child compile+first run: {time.perf_counter() - t0:.1f}s")
-
-    # identical-work check: kernel per-query counts vs the CPU walks
-    for q in range(B):
-        assert int(edges_dev[q]) == cpu_edges[q], (
-            q, int(edges_dev[q]), cpu_edges[q])
-    total_edges = int(edges_dev.astype(np.int64).sum())
-
-    reps = DEV_REPS if plat != "cpu" else 2
+    rel_s = build_graph(SMALL_N, AVG_DEG, seed=5)
+    g_s = build_ell(rel_s.indptr, rel_s.indices)
+    seeds_s = make_seeds(SMALL_N, 256, seed=3)
+    mask_s = pack_seed_masks(g_s, seeds_s)
+    ells_d = [jax.device_put(e) for e in g_s.ells]
+    fn_s = make_ell_recurse(ells_d, jax.device_put(g_s.outdeg), g_s.n,
+                            mask_s.shape[1])
+    t_c = time.perf_counter()
+    _l, _s, edges_s = fn_s(jax.device_put(mask_s), DEPTH)
+    edges_s = np.asarray(edges_s)
+    compile_s = time.perf_counter() - t_c
+    want = cpu_recurse(rel_s.indptr, rel_s.indices, seeds_s[17], DEPTH)
+    assert int(edges_s[17]) == want, (int(edges_s[17]), want)
     ts = []
-    for _ in range(reps):
+    for _ in range(3):
+        t_r = time.perf_counter()
+        _l, _s, e2 = fn_s(jax.device_put(mask_s), DEPTH)
+        np.asarray(e2)
+        ts.append(time.perf_counter() - t_r)
+    small_edges = int(edges_s.astype(np.int64).sum())
+    _stage({"stage": "stage1", "secs": round(time.perf_counter() - t0, 2),
+            "compile_secs": round(compile_s, 2),
+            "run_ms": round(min(ts) * 1e3, 1),
+            "edges_per_sec": round(small_edges / min(ts))})
+    del ells_d, fn_s
+
+    # -- stage2: full workload ----------------------------------------------
+    t0 = time.perf_counter()
+    rel = build_graph(N_NODES, AVG_DEG)
+    g = build_ell(rel.indptr, rel.indices)
+    seeds = make_seeds(N_NODES, B)
+    mask0 = pack_seed_masks(g, seeds)
+    build_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    ells_d = [jax.device_put(e) for e in g.ells]
+    outdeg_d = jax.device_put(g.outdeg)
+    mask_d = jax.device_put(mask0)
+    jax.block_until_ready(ells_d + [outdeg_d, mask_d])
+    put_s = time.perf_counter() - t0
+
+    fn = make_ell_recurse(ells_d, outdeg_d, g.n, mask0.shape[1])
+    t0 = time.perf_counter()
+    _l, _s, edges = fn(mask_d, DEPTH)
+    edges = np.asarray(edges).astype(np.int64)
+    compile_s = time.perf_counter() - t0
+
+    # identical-work check against the parent's numpy walks
+    expect = np.load(expect_path)["edges"][:B]
+    assert np.array_equal(edges, expect), "device/cpu edge counts diverge"
+
+    ts = []
+    for _ in range(DEV_REPS):
         t0 = time.perf_counter()
-        run()
+        _l, _s, e2 = fn(mask_d, DEPTH)
+        np.asarray(e2)
         ts.append(time.perf_counter() - t0)
     dev_s = min(ts)
-    log(f"child {plat}: {total_edges} edges in {dev_s * 1e3:.0f}ms")
-    print(json.dumps({"platform": plat, "total_edges": total_edges,
-                      "dev_s": dev_s}), flush=True)
+    total_edges = int(edges.sum())
+    W = mask0.shape[1]
+    # HBM traffic model per hop: ELL index reads + mask-row gathers +
+    # mask elementwise (4 arrays) + unpack/matvec streams
+    gather_bytes = g.padded_edges * (4 + W * 4)
+    elem_bytes = 4 * (g.n + 1) * W * 4
+    matvec_bytes = g.n * W * 32 * 4
+    bytes_per_run = DEPTH * (gather_bytes + elem_bytes + matvec_bytes)
+    _stage({"stage": "stage2", "platform": plat, "B": B,
+            "build_secs": round(build_s, 2),
+            "device_put_secs": round(put_s, 2),
+            "compile_secs": round(compile_s, 2),
+            "dev_s": round(dev_s, 4),
+            "total_edges": total_edges,
+            "edges_per_sec": round(total_edges / dev_s),
+            "hbm_gbps": round(bytes_per_run / dev_s / 1e9, 1),
+            "hbm_frac_of_peak": round(
+                bytes_per_run / dev_s / 1e9 / HBM_PEAK_GBPS, 3),
+            "padded_edges": g.padded_edges})
     os._exit(0)
 
 
-def run_child(platform: str, timeout_s: float) -> dict:
-    """Run one device measurement out-of-process. Raises on any failure."""
-    t0 = time.perf_counter()
-    proc = subprocess.run(
-        [sys.executable, os.path.abspath(__file__), "--child", platform],
-        capture_output=True, text=True, timeout=timeout_s,
-        cwd=os.path.dirname(os.path.abspath(__file__)))
-    for line in proc.stderr.splitlines()[-6:]:
-        log(f"  [{platform}] {line}")
-    if proc.returncode != 0:
-        tail = proc.stderr.strip().splitlines()[-1] if proc.stderr else "?"
-        raise RuntimeError(
-            f"child({platform}) rc={proc.returncode}: {tail}")
-    out = json.loads(proc.stdout.strip().splitlines()[-1])
-    log(f"child({platform}) done in {time.perf_counter() - t0:.1f}s")
-    return out
+# ---------------------------------------------------------------------------
+# parent: staged child supervision
+
+def run_child_staged(platform: str, expect_path: str,
+                     budget_s: float) -> tuple[dict, str | None]:
+    """Run the staged child; returns (stages dict, error|None). Reads the
+    child's stdout line by line so a later-stage hang still leaves the
+    earlier stages' results in hand. Per-stage deadlines are clamped so
+    the whole child fits in `budget_s` (the parent's remaining time minus
+    what a fallback still needs)."""
+    import tempfile
+    errf = tempfile.NamedTemporaryFile(
+        mode="w+", suffix=".benchlog", delete=False)
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--child", platform,
+         expect_path],
+        stdout=subprocess.PIPE, stderr=errf, text=True, cwd=ROOT)
+    stages: dict[str, dict] = {}
+    err = None
+    t_start = time.perf_counter()
+    try:
+        for name in ("stage0", "stage1", "stage2"):
+            remaining = budget_s - (time.perf_counter() - t_start)
+            deadline = min(STAGE_DEADLINES[name], max(remaining, 1.0))
+            line = _read_line(proc, deadline)
+            if line is None:
+                err = (f"{name} produced no output within {deadline:.0f}s "
+                       f"(rc={proc.poll()})")
+                errf.flush()
+                with open(errf.name) as f:
+                    tail = [ln.strip() for ln in f.readlines()[-4:]
+                            if ln.strip()]
+                if tail:
+                    err += "; child stderr: " + " | ".join(tail)
+                break
+            doc = json.loads(line)
+            stages[doc.get("stage", name)] = doc
+            log(f"  [{platform}] {line.strip()}")
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+        proc.wait()
+        errf.close()
+        try:
+            os.unlink(errf.name)
+        except OSError:
+            pass
+    return stages, err
+
+
+def _read_line(proc, timeout_s: float):
+    """Blocking line read with a timeout (portable via a reader thread)."""
+    result = []
+    done = threading.Event()
+
+    def reader():
+        line = proc.stdout.readline()
+        if line:
+            result.append(line)
+        done.set()
+
+    t = threading.Thread(target=reader, daemon=True)
+    t.start()
+    done.wait(timeout_s)
+    return result[0] if result else None
 
 
 def main() -> None:
@@ -192,65 +302,82 @@ def main() -> None:
     watchdog = threading.Timer(GLOBAL_DEADLINE_S, last_resort)
     watchdog.daemon = True
     watchdog.start()
+    t_main = time.perf_counter()
 
-    log(f"graph: {N_NODES} nodes, avg_deg {AVG_DEG} ...")
-    rel, seed_lists = build_workload()
-    log(f"graph: {rel.nnz} edges; workload: {B} queries x depth-{DEPTH} "
-        f"recurse, {SEEDS_PER_QUERY} seeds each")
-
-    # -- CPU baseline: ALL B queries measured directly (no extrapolation) ---
     t0 = time.perf_counter()
-    cpu_edges = [cpu_recurse(rel.indptr, rel.indices, s, DEPTH)
-                 for s in seed_lists]
+    rel = build_graph(N_NODES, AVG_DEG)
+    seeds = make_seeds(N_NODES, B_DEV)
+    log(f"graph: {N_NODES} nodes, {rel.nnz} edges ({time.perf_counter()-t0:.1f}s); "
+        f"workload: {B_DEV} concurrent depth-{DEPTH} recurses")
+
+    # -- CPU baseline: ALL B queries measured directly ----------------------
+    t0 = time.perf_counter()
+    cpu_edges = np.array([cpu_recurse(rel.indptr, rel.indices, s, DEPTH)
+                          for s in seeds], np.int64)
     cpu_s = time.perf_counter() - t0
-    total_edges = int(sum(cpu_edges))
+    total_edges = int(cpu_edges.sum())
     cpu_eps = total_edges / cpu_s
-    log(f"cpu baseline: {B} queries, {total_edges} edges in {cpu_s:.2f}s "
-        f"= {cpu_eps:,.0f} edges/s")
+    log(f"cpu baseline: {B_DEV} queries, {total_edges} edges in "
+        f"{cpu_s:.2f}s = {cpu_eps:,.0f} edges/s")
 
-    # -- device measurement, subprocess-isolated ----------------------------
-    err = None
-    res = None
-    try:
-        res = run_child("default", CHILD_TPU_S)
-    except Exception as e:  # noqa: BLE001 — fall back, report
-        err = f"tpu child failed: {type(e).__name__}: {e}"
-        log(err)
-        try:
-            res = run_child("cpu", CHILD_CPU_S)
-        except Exception as e2:  # noqa: BLE001
-            emit({"metric": METRIC, "value": 0, "unit": "edges/s",
-                  "vs_baseline": 0.0,
-                  "error": f"{err}; cpu fallback failed: {e2}",
-                  "cpu_edges_per_sec": round(cpu_eps)})
-            os._exit(2)
+    expect_path = os.path.join(ROOT, ".bench_expect.npz")
+    np.savez(expect_path, edges=cpu_edges)
 
-    assert res["total_edges"] == total_edges, (res["total_edges"],
-                                               total_edges)
-    dev_eps = total_edges / res["dev_s"]
-    log(f"{res['platform']}: {total_edges} edges in "
-        f"{res['dev_s'] * 1e3:.0f}ms = {dev_eps:,.0f} edges/s "
-        f"(cpu baseline {cpu_eps:,.0f})")
+    t_children = time.perf_counter()
+    elapsed = t_children - t_main
+    # reserve enough of the global budget for a full CPU fallback child
+    fallback_reserve = 280.0
+    budget = GLOBAL_DEADLINE_S - elapsed - fallback_reserve - 20.0
+    stages, err = run_child_staged("default", expect_path, budget)
+    platform = stages.get("stage0", {}).get("platform", "none")
+    if "stage2" not in stages:
+        # always retry at the smaller fallback batch — covers both a dead
+        # TPU and a TPU-less host where "default" resolved to cpu but the
+        # full-size workload blew its budget
+        remaining = GLOBAL_DEADLINE_S - (time.perf_counter() - t_main) - 15.0
+        cpu_stages, cpu_err = run_child_staged("cpu", expect_path,
+                                               remaining)
+        if "stage2" in cpu_stages:
+            stages, platform = cpu_stages, "cpu"
+            err = f"tpu failed ({err}); measured on XLA cpu backend"
+        else:
+            err = f"tpu: {err}; cpu fallback: {cpu_err}"
 
-    out = {
-        "metric": METRIC,
-        "value": round(dev_eps),
-        "unit": "edges/s",
-        "vs_baseline": round(dev_eps / cpu_eps, 2),
-        "platform": res["platform"],
-        "cpu_edges_per_sec": round(cpu_eps),
-    }
-    if err:
-        out["error"] = f"measured on XLA cpu backend; {err}"
+    out = {"metric": METRIC, "unit": "edges/s",
+           "cpu_edges_per_sec": round(cpu_eps),
+           "stages": {k: v for k, v in stages.items()}}
+    s2 = stages.get("stage2")
+    if s2 is not None:
+        b = s2["B"]
+        dev_total = s2["total_edges"]
+        dev_eps = dev_total / s2["dev_s"]
+        # baseline at the SAME concurrency (per-query numpy cost is
+        # B-independent; measured counts prove identical work)
+        base_eps = (cpu_edges[:b].sum() / cpu_s * (len(cpu_edges) / b)
+                    if b != len(cpu_edges) else cpu_eps)
+        out.update(value=round(dev_eps), platform=s2["platform"],
+                   vs_baseline=round(dev_eps / base_eps, 2),
+                   hbm_gbps=s2["hbm_gbps"],
+                   hbm_frac_of_peak=s2["hbm_frac_of_peak"])
+    elif "stage1" in stages:
+        s1 = stages["stage1"]
+        out.update(value=s1["edges_per_sec"], platform=platform,
+                   vs_baseline=0.0,
+                   error=(err or "") + "; value is the SMALL-graph stage1 "
+                   "number (stage2 did not complete)")
+    else:
+        out.update(value=0, platform=platform, vs_baseline=0.0, error=err)
+    if err and "error" not in out:
+        out["error"] = err
     emit(out)
     watchdog.cancel()
     sys.stdout.flush()
-    sys.stderr.flush()
     os._exit(0)
 
 
 if __name__ == "__main__":
     if len(sys.argv) >= 3 and sys.argv[1] == "--child":
-        child_main(sys.argv[2])
+        child_main(sys.argv[2], sys.argv[3] if len(sys.argv) > 3
+                   else os.path.join(ROOT, ".bench_expect.npz"))
     else:
         main()
