@@ -26,6 +26,13 @@ class Engine:
         self.mesh = mesh  # jax.sharding.Mesh | None → SPMD expansion
 
     def query(self, q: str, variables: dict | None = None) -> dict:
+        out, _ex = self.query_with_vars(q, variables)
+        return out
+
+    def query_with_vars(self, q: str, variables: dict | None = None):
+        """(json, executor): the executor carries the bound uid/val vars —
+        the seam upsert blocks substitute from (reference: edgraph
+        doQueryInUpsert returns the query's var map)."""
         from dgraph_tpu.dql.parser import parse
         from dgraph_tpu.engine.varorder import execution_order
 
@@ -36,7 +43,7 @@ class Engine:
         for i in execution_order(blocks):
             results[i] = ex.run_block(blocks[i])
         roots = [results[i] for i in range(len(blocks))]  # textual order out
-        return to_json(ex, roots)
+        return to_json(ex, roots), ex
 
 
 __all__ = [

@@ -564,6 +564,12 @@ class Executor:
         """Bind value/count vars declared on leaves (a as age, c as count(p))."""
         if not sg.var_name:
             return
+        if sg.is_uid_leaf and not sg.is_count:
+            # `v as uid` binds the enclosing block's uid set (reference:
+            # gql uid var on the uid field — the upsert-block idiom);
+            # `c as count(uid)` stays a value var (the count branch below)
+            self.uid_vars[sg.var_name] = parent.nodes
+            return
         if sg.is_count:
             rel = self.store.rel(sg.attr, sg.is_reverse)
             deg = rel.degree(parent.nodes)

@@ -231,6 +231,117 @@ class Alpha:
                 txn.discard()
             raise
 
+    def _bind_upsert_vars(self, txn: "Txn", query_src: str):
+        """Run the upsert's query at the txn's read snapshot and convert
+        the executor's rank-space var bindings to uid space."""
+        import numpy as np
+
+        with self._reading(txn.start_ts) as ts:
+            store = self.mvcc.read_view(ts)
+            if self.groups is not None:
+                from dgraph_tpu.cluster.routed import routed_view
+                store = routed_view(self, store, ts)
+            out, ex = Engine(
+                store, device_threshold=self.device_threshold,
+                mesh=self.mesh).query_with_vars(query_src)
+        uid_vars = {
+            name: store.uid_of(np.asarray(ranks, np.int32)).tolist()
+            for name, ranks in ex.uid_vars.items()}
+        val_vars = {}
+        for name, env in ex.val_vars.items():
+            ranks = np.fromiter(env.keys(), np.int32, len(env))
+            uids = store.uid_of(ranks)
+            val_vars[name] = dict(zip(uids.tolist(), env.values()))
+        counts = {n: len(u) for n, u in uid_vars.items()}
+        for n, env in val_vars.items():
+            counts.setdefault(n, len(env))
+        return out, uid_vars, val_vars, counts
+
+    def _run_upsert(self, commit_now: bool, start_ts: int | None,
+                    run) -> dict:
+        """Txn bookkeeping shared by the RDF and JSON upsert forms;
+        `run(txn)` performs query + substitution + buffered mutates and
+        returns (queries_json, uids, applied)."""
+        created = not start_ts
+        txn = self.txn(start_ts) if start_ts else self.new_txn()
+        try:
+            out, uids, applied = run(txn)
+            if commit_now:
+                txn.commit()
+            return {"uids": uids, "queries": out, "applied": applied,
+                    "txn": {"start_ts": txn.start_ts,
+                            "commit_ts": txn.commit_ts}}
+        except TxnAborted:
+            txn.discard()
+            raise
+        except Exception:
+            if commit_now or created:
+                txn.discard()
+            raise
+
+    def upsert(self, src: str, commit_now: bool = True,
+               start_ts: int | None = None) -> dict:
+        """Upsert block: run the query at the txn's read_ts, bind vars,
+        evaluate @if conditions, substitute uid(v)/val(v) into the
+        mutations, commit through the normal conflict path (reference:
+        edgraph upsert semantics, SURVEY L10)."""
+        from dgraph_tpu.dql.upsert import (eval_cond, parse_upsert,
+                                           substitute)
+
+        req = parse_upsert(src)
+
+        def run(txn):
+            out, uid_vars, val_vars, counts = self._bind_upsert_vars(
+                txn, req.query_src)
+            uids: dict[str, str] = {}
+            applied = 0
+            for m in req.mutations:
+                if not eval_cond(m.cond, counts):
+                    continue
+                set_rdf = substitute(m.set_rdf, uid_vars, val_vars)
+                del_rdf = substitute(m.del_rdf, uid_vars, val_vars)
+                if set_rdf or del_rdf:
+                    uids.update(txn.mutate(set_nquads=set_rdf or None,
+                                           del_nquads=del_rdf or None))
+                    applied += 1
+            return out, uids, applied
+
+        return self._run_upsert(commit_now, start_ts, run)
+
+    def upsert_json(self, query: str, cond: str = "",
+                    set_json=None, del_json=None, commit_now: bool = True,
+                    start_ts: int | None = None) -> dict:
+        """The HTTP JSON upsert form: {"query", "cond", "set"/"delete" as
+        JSON mutation lists with uid(v)/val(v) references} (reference:
+        Dgraph HTTP /mutate JSON upsert)."""
+        from dgraph_tpu.dql.upsert import (_parse_cond, eval_cond,
+                                           substitute_json)
+
+        cond_tree = None
+        if cond:
+            inner = cond.strip()
+            if inner.startswith("@if"):
+                inner = inner[3:].strip()
+            cond_tree = _parse_cond(inner)
+
+        def run(txn):
+            out, uid_vars, val_vars, counts = self._bind_upsert_vars(
+                txn, query)
+            uids: dict[str, str] = {}
+            applied = 0
+            if eval_cond(cond_tree, counts):
+                set_sub = (substitute_json(set_json, uid_vars, val_vars)
+                           if set_json else None)
+                del_sub = (substitute_json(del_json, uid_vars, val_vars)
+                           if del_json else None)
+                if set_sub or del_sub:
+                    uids.update(txn.mutate(set_json=set_sub or None,
+                                           del_json=del_sub or None))
+                    applied += 1
+            return out, uids, applied
+
+        return self._run_upsert(commit_now, start_ts, run)
+
     def commit_or_abort(self, start_ts: int, abort: bool = False) -> int:
         """reference: Server.CommitOrAbort. Returns commit_ts (0 on abort)."""
         txn = self.txn(start_ts)

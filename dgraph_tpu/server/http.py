@@ -14,6 +14,7 @@ import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from dgraph_tpu.dql.upsert import is_upsert as _is_upsert
 from dgraph_tpu.server.api import Alpha, TxnAborted
 from dgraph_tpu.utils.metrics import METRICS
 
@@ -90,12 +91,41 @@ def make_http_server(alpha: Alpha, addr: str = "127.0.0.1",
                         (self.headers.get("X-Dgraph-CommitNow") == "true")
                     if "application/json" in ctype:
                         req = json.loads(body)
-                        res = alpha.mutate(
-                            set_json=req.get("set"),
-                            del_json=req.get("delete"),
-                            commit_now=(commit_now or
-                                        req.get("commitNow", False)),
-                            start_ts=start_ts)
+                        if req.get("query"):
+                            # upsert: set/delete may be JSON mutation
+                            # lists (upsert_json) or RDF strings (the
+                            # block form, via Alpha.upsert)
+                            cn = commit_now or req.get("commitNow", False)
+                            if any(isinstance(req.get(k), str)
+                                   for k in ("set", "delete")):
+                                parts = [
+                                    "%s { %s }" % (k if k != "delete"
+                                                   else "delete", req[k])
+                                    for k in ("set", "delete")
+                                    if isinstance(req.get(k), str)]
+                                src = ("upsert { query %s mutation %s "
+                                       "{ %s } }"
+                                       % (req["query"],
+                                          req.get("cond", ""),
+                                          "\n".join(parts)))
+                                res = alpha.upsert(src, commit_now=cn,
+                                                   start_ts=start_ts)
+                            else:
+                                res = alpha.upsert_json(
+                                    req["query"], req.get("cond", ""),
+                                    set_json=req.get("set"),
+                                    del_json=req.get("delete"),
+                                    commit_now=cn, start_ts=start_ts)
+                        else:
+                            res = alpha.mutate(
+                                set_json=req.get("set"),
+                                del_json=req.get("delete"),
+                                commit_now=(commit_now or
+                                            req.get("commitNow", False)),
+                                start_ts=start_ts)
+                    elif _is_upsert(body):
+                        res = alpha.upsert(body, commit_now=commit_now,
+                                           start_ts=start_ts)
                     else:
                         res = alpha.mutate(set_nquads=body,
                                            commit_now=commit_now,

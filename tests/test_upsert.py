@@ -1,0 +1,244 @@
+"""Upsert blocks (reference: edgraph upsert + dgo upsert API)."""
+
+import pytest
+
+from dgraph_tpu.cluster.oracle import TxnAborted
+from dgraph_tpu.dql.upsert import (
+    UpsertError, eval_cond, parse_upsert, substitute)
+from dgraph_tpu.server.api import Alpha
+
+SCHEMA = """
+email: string @index(exact) @upsert .
+name: string @index(exact) .
+visits: int .
+follows: [uid] .
+"""
+
+
+@pytest.fixture()
+def alpha():
+    a = Alpha(device_threshold=10**9)
+    a.alter(SCHEMA)
+    return a
+
+
+class TestParse:
+    def test_split(self):
+        req = parse_upsert('''
+        upsert {
+          query { q(func: eq(email, "a@x")) { v as uid } }
+          mutation @if(eq(len(v), 0)) { set { _:n <email> "a@x" . } }
+          mutation @if(gt(len(v), 0)) {
+            set { uid(v) <name> "seen" . }
+            delete { uid(v) <visits> * . }
+          }
+        }''')
+        assert 'eq(email, "a@x")' in req.query_src
+        assert len(req.mutations) == 2
+        assert req.mutations[0].cond.cmp == "eq"
+        assert "delete" not in req.mutations[1].set_rdf
+        assert "uid(v) <visits> *" in req.mutations[1].del_rdf
+
+    def test_cond_tree(self):
+        req = parse_upsert('''
+        upsert { query { q(func: has(name)) { v as uid } }
+          mutation @if(eq(len(v), 0) AND not gt(len(v), 5)) { set { _:x <name> "n" . } } }''')
+        c = req.mutations[0].cond
+        assert c.op == "and"
+        assert eval_cond(c, {"v": 0}) is True
+        assert eval_cond(c, {"v": 1}) is False
+
+    def test_errors(self):
+        with pytest.raises(UpsertError):
+            parse_upsert("upsert { mutation { set { _:a <p> \"v\" . } } }")
+        with pytest.raises(UpsertError):
+            parse_upsert("upsert { query { q(func: has(p)) { uid } } }")
+
+    def test_substitute_cartesian_and_val(self):
+        rdf = 'uid(a) <follows> uid(b) .'
+        out = substitute(rdf, {"a": [1, 2], "b": [5]}, {})
+        assert out.splitlines() == ['<0x1> <follows> <0x5> .',
+                                    '<0x2> <follows> <0x5> .']
+        # val(v) keyed by the line's subject uid
+        out = substitute('uid(a) <visits> val(c) .', {"a": [1, 2]},
+                         {"c": {1: 7}})
+        assert out.splitlines() == ['<0x1> <visits> "7"^^<xs:int> .']
+        # empty var -> line drops
+        assert substitute(rdf, {"a": [], "b": [5]}, {}) == ""
+
+
+class TestExec:
+    UPSERT = '''
+    upsert {
+      query { q(func: eq(email, "a@x")) { v as uid n as visits } }
+      mutation @if(eq(len(v), 0)) {
+        set { _:new <email> "a@x" .
+              _:new <visits> "1"^^<xs:int> . }
+      }
+      mutation @if(gt(len(v), 0)) {
+        set { uid(v) <name> "returning" . }
+      }
+    }'''
+
+    def test_insert_then_update(self, alpha):
+        r1 = alpha.upsert(self.UPSERT)
+        assert r1["applied"] == 1 and r1["uids"]
+        out = alpha.query('{ q(func: eq(email, "a@x")) { email visits } }')
+        assert out == {"q": [{"email": "a@x", "visits": 1}]}
+
+        r2 = alpha.upsert(self.UPSERT)
+        assert r2["applied"] == 1 and not r2["uids"]
+        out = alpha.query(
+            '{ q(func: eq(email, "a@x")) { name visits } }')
+        assert out == {"q": [{"name": "returning", "visits": 1}]}
+        # still exactly one node with that email
+        uids = alpha.query('{ q(func: eq(email, "a@x")) { uid } }')["q"]
+        assert len(uids) == 1
+
+    def test_val_substitution(self, alpha):
+        alpha.mutate(set_nquads='_:u <email> "b@x" .\n'
+                                '_:u <visits> "3"^^<xs:int> .')
+        alpha.upsert('''
+        upsert {
+          query { q(func: eq(email, "b@x")) { v as uid c as visits } }
+          mutation { set { uid(v) <name> "bumped" .
+                           uid(v) <visits> val(c) . } }
+        }''')
+        out = alpha.query('{ q(func: eq(email, "b@x")) { name visits } }')
+        assert out == {"q": [{"name": "bumped", "visits": 3}]}
+
+    def test_concurrent_upsert_conflict(self, alpha):
+        """Two racing inserts of the same @upsert email: one commits, the
+        other aborts at the oracle (reference: @upsert index conflict
+        keys)."""
+        ins = '''
+        upsert {
+          query { q(func: eq(email, "race@x")) { v as uid } }
+          mutation @if(eq(len(v), 0)) { set { _:n <email> "race@x" . } }
+        }'''
+        t1 = alpha.new_txn()
+        t2 = alpha.new_txn()
+        r1 = alpha.upsert(ins, commit_now=False, start_ts=t1.start_ts)
+        r2 = alpha.upsert(ins, commit_now=False, start_ts=t2.start_ts)
+        assert r1["applied"] == r2["applied"] == 1
+        t1.commit()
+        with pytest.raises(TxnAborted):
+            t2.commit()
+        uids = alpha.query('{ q(func: eq(email, "race@x")) { uid } }')["q"]
+        assert len(uids) == 1
+
+    def test_delete_branch(self, alpha):
+        alpha.mutate(set_nquads='_:u <email> "d@x" .\n'
+                                '_:u <visits> "9"^^<xs:int> .')
+        alpha.upsert('''
+        upsert {
+          query { q(func: eq(email, "d@x")) { v as uid } }
+          mutation @if(ge(len(v), 1)) { delete { uid(v) <visits> * . } }
+        }''')
+        out = alpha.query('{ q(func: eq(email, "d@x")) { email visits } }')
+        assert out == {"q": [{"email": "d@x"}]}
+
+
+def test_http_upsert_paths():
+    from dgraph_tpu.server.http import make_http_server, serve_background
+    import json as _json
+    import urllib.request
+
+    a = Alpha(device_threshold=10**9)
+    a.alter(SCHEMA)
+    srv = make_http_server(a, "127.0.0.1", 0)
+    serve_background(srv)
+    port = srv.server_address[1]
+
+    def post(path, body, ctype):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}{path}", data=body.encode(),
+            headers={"Content-Type": ctype})
+        return _json.load(urllib.request.urlopen(req, timeout=30))
+
+    rdf = '''upsert {
+      query { q(func: eq(email, "h@x")) { v as uid } }
+      mutation @if(eq(len(v), 0)) { set { _:n <email> "h@x" . } } }'''
+    out = post("/mutate?commitNow=true", rdf, "application/rdf")
+    assert out["data"]["applied"] == 1
+
+    jbody = _json.dumps({
+        "query": '{ q(func: eq(email, "h@x")) { v as uid } }',
+        "cond": "@if(gt(len(v), 0))",
+        "set": 'uid(v) <name> "via-json" .',
+        "commitNow": True})
+    out = post("/mutate", jbody, "application/json")
+    assert out["data"]["applied"] == 1
+    got = post("/query", '{ q(func: eq(email, "h@x")) { name } }',
+               "application/dql")
+    assert got["data"]["q"] == [{"name": "via-json"}]
+    srv.shutdown()
+
+
+class TestJsonUpsert:
+    def test_json_list_form(self, alpha):
+        alpha.mutate(set_nquads='_:u <email> "j@x" .')
+        res = alpha.upsert_json(
+            '{ q(func: eq(email, "j@x")) { v as uid } }',
+            cond="@if(gt(len(v), 0))",
+            set_json=[{"uid": "uid(v)", "name": "from-json",
+                       "visits": 4}])
+        assert res["applied"] == 1
+        out = alpha.query('{ q(func: eq(email, "j@x")) { name visits } }')
+        assert out == {"q": [{"name": "from-json", "visits": 4}]}
+
+    def test_json_val_and_empty_var(self, alpha):
+        alpha.mutate(set_nquads='_:u <email> "k@x" .\n'
+                                '_:u <visits> "6"^^<xs:int> .')
+        res = alpha.upsert_json(
+            '{ q(func: eq(email, "k@x")) { v as uid c as visits } }',
+            set_json=[{"uid": "uid(v)", "name": "n", "visits": "val(c)"},
+                      {"uid": "uid(none)", "name": "ghost"}])
+        assert res["applied"] == 1
+        out = alpha.query('{ q(func: has(email)) { email name visits } }')
+        assert out == {"q": [{"email": "k@x", "name": "n", "visits": 6}]}
+
+    def test_http_json_list(self):
+        import json as _json
+        import urllib.request
+        from dgraph_tpu.server.http import (make_http_server,
+                                            serve_background)
+        a = Alpha(device_threshold=10**9)
+        a.alter(SCHEMA)
+        a.mutate(set_nquads='_:u <email> "hl@x" .')
+        srv = make_http_server(a, "127.0.0.1", 0)
+        serve_background(srv)
+        port = srv.server_address[1]
+        body = _json.dumps({
+            "query": '{ q(func: eq(email, "hl@x")) { v as uid } }',
+            "set": [{"uid": "uid(v)", "name": "list-form"}],
+            "commitNow": True})
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/mutate", data=body.encode(),
+            headers={"Content-Type": "application/json"})
+        out = _json.load(urllib.request.urlopen(req, timeout=30))
+        assert out["data"]["applied"] == 1
+        got = a.query('{ q(func: eq(email, "hl@x")) { name } }')
+        assert got == {"q": [{"name": "list-form"}]}
+        srv.shutdown()
+
+
+def test_val_with_backslashes(alpha):
+    """Regex-replacement escaping must not corrupt string values
+    (code-review finding)."""
+    alpha.mutate(set_nquads='_:u <email> "s@x" .')
+    tricky = 'say "hi" \\ ok'
+    # bind the tricky value through a val var round-trip
+    alpha.upsert('''
+    upsert {
+      query { q(func: eq(email, "s@x")) { v as uid } }
+      mutation { set { uid(v) <name> "say \\"hi\\" \\\\ ok" . } }
+    }''')
+    alpha.upsert('''
+    upsert {
+      query { q(func: eq(email, "s@x")) { v as uid n as name } }
+      mutation { set { uid(v) <title> val(n) . } }
+    }''')
+    out = alpha.query('{ q(func: eq(email, "s@x")) { name title } }')
+    assert out["q"][0]["name"] == tricky
+    assert out["q"][0]["title"] == tricky
