@@ -202,7 +202,10 @@ class Parser:
         return attr, lang
 
     def _lang_chain(self) -> str:
-        parts = [self.name()]
+        if self.accept("."):
+            parts = ["."]       # bare `name@.`: any language
+        else:
+            parts = [self.name()]
         while self.accept(":"):
             if self.accept("."):
                 parts.append(".")
@@ -269,6 +272,7 @@ class Parser:
             else:
                 v = self._subst(t.text)
                 f.args.append(v)
+        _check_arity(f)
         return f
 
     # -- filter trees -------------------------------------------------------
@@ -485,9 +489,12 @@ class Parser:
                 sg.is_reverse = True
                 attr = attr[1:]
             sg.attr = attr
-        if self.peek().text == "@" and self.peek(1).kind == "name" and \
-                self.peek(1).text not in ("filter", "recurse", "cascade",
-                                          "normalize", "groupby", "facets"):
+        if self.peek().text == "@" and \
+                (self.peek(1).text == "." or
+                 (self.peek(1).kind == "name" and
+                  self.peek(1).text not in ("filter", "recurse", "cascade",
+                                            "normalize", "groupby",
+                                            "facets"))):
             self.next()
             sg.lang = self._lang_chain()
         if self.accept("("):
@@ -568,6 +575,26 @@ _MATH_PREC = {"||": 1, "&&": 2, "==": 3, "!=": 3, "<": 3, ">": 3, "<=": 3,
 
 
 _ESCAPES = {"n": "\n", "t": "\t", "r": "\r", '"': '"', "\\": "\\", "/": "/"}
+
+
+_ARITY = {  # args after the attr: (min, max)
+    "between": (2, 2), "le": (1, 1), "lt": (1, 1), "ge": (1, 1),
+    "gt": (1, 1), "eq": (1, 10**9), "anyofterms": (1, 10**9),
+    "allofterms": (1, 10**9), "regexp": (1, 2), "match": (1, 2),
+    "has": (0, 0),
+}
+
+
+def _check_arity(f) -> None:
+    lim = _ARITY.get(f.name)
+    if lim is None:
+        return
+    lo, hi = lim
+    if not lo <= len(f.args) <= hi:
+        want = str(lo) if lo == hi else f"{lo}..{hi}"
+        raise ParseError(
+            f"{f.name}() takes {want} argument(s) after the attribute, "
+            f"got {len(f.args)}")
 
 
 def _unquote(t: Token) -> str:

@@ -77,10 +77,10 @@ class Executor:
     # -- frontier expansion (the hot op) ------------------------------------
     def expand(self, pred: str, reverse: bool, frontier: np.ndarray):
         """Whole-frontier CSR expansion → (neighbors, seg, edge_pos) host
-        arrays. `edge_pos` indexes the FORWARD `indices` array only when
-        reverse=False (facet columns are forward-aligned); for reverse
-        expansion it indexes the reverse CSR (facets unsupported on ~pred,
-        as in the reference)."""
+        arrays. `edge_pos` indexes the CSR of the expansion direction;
+        facet consumers map reverse positions through facet_positions()
+        (forward-aligned) AT USE so facet-free reverse hops — the hot
+        distributed-task path — never pay for the rev→fwd table."""
         rel = self.store.rel(pred, reverse)
         if len(frontier) == 0 or rel.nnz == 0:
             return EMPTY, EMPTY, EMPTY64
@@ -98,6 +98,14 @@ class Executor:
         pos = np.repeat(starts.astype(np.int64), deg) + \
             (np.arange(total, dtype=np.int64) - base)
         return rel.indices[pos], seg, pos
+
+    def facet_positions(self, sg: SubGraph, pos: np.ndarray) -> np.ndarray:
+        """Edge positions in the forward-CSR space facet columns key on
+        (reference: facets live on the forward posting but render on
+        reverse edges too)."""
+        if sg.is_reverse:
+            return self.store.rev_to_fwd_pos(sg.attr, pos)
+        return pos
 
     def _shard_edge_cap(self, srel, frontier: np.ndarray,
                         deg: np.ndarray) -> int:
@@ -205,7 +213,9 @@ class Executor:
             return self.uid_vars[name]
         if name in self.val_vars:
             return np.array(sorted(self.val_vars[name]), np.int32)
-        return EMPTY
+        # reference: referencing an undefined variable is a request error,
+        # not an empty result (gql validateResult var checks)
+        raise ValueError(f"variable {name!r} is used but not defined")
 
     def filter_edges(self, filters: FilterNode | None, nbrs: np.ndarray,
                      seg: np.ndarray, pos: np.ndarray | None = None):
@@ -226,7 +236,8 @@ class Executor:
         (reference: facets filtering in worker facetsFilter)."""
         if sg.facet_filter is None or not len(nbrs):
             return nbrs, seg, pos
-        keep = self._eval_facet_tree(sg.facet_filter, pred, pos)
+        keep = self._eval_facet_tree(sg.facet_filter, pred,
+                                     self.facet_positions(sg, pos))
         return nbrs[keep], seg[keep], pos[keep]
 
     def _eval_facet_tree(self, tree: FilterNode, pred: str,
@@ -330,8 +341,9 @@ class Executor:
         """Row-internal ordering by facet values (@facets(orderasc: k));
         edges without the facet sort last."""
         keys = [np.asarray(nbrs)]
+        fpos = self.facet_positions(sg, pos)
         for o in reversed(sg.facet_orders):
-            fvals = self.store.edge_facets(sg.attr, pos, [o.attr]).get(
+            fvals = self.store.edge_facets(sg.attr, fpos, [o.attr]).get(
                 o.attr, [None] * len(pos))
             has = np.array([v is not None for v in fvals], bool)
             present = [_orderable(v) for v in fvals if v is not None]
@@ -409,12 +421,11 @@ class Executor:
         else:
             nbrs, seg, pos = self.expand(sg.attr, sg.is_reverse, frontier)
             nbrs, seg, pos = self.filter_edges(sg.filters, nbrs, seg, pos)
-            if not sg.is_reverse:
-                nbrs, seg, pos = self.facet_filter_edges(sg, sg.attr, nbrs,
-                                                         seg, pos)
+            nbrs, seg, pos = self.facet_filter_edges(sg, sg.attr, nbrs,
+                                                     seg, pos)
             # row-internal ordering (default: uid order from the CSR)
             if sg.orders or sg.facet_orders:
-                if sg.facet_orders and not sg.is_reverse:
+                if sg.facet_orders:
                     order_idx = self._facet_order(sg, nbrs, seg, pos)
                 else:
                     order_idx = self.order_ranks(nbrs, sg.orders, seg=seg)

@@ -67,7 +67,9 @@ def _group_population(ex, sg, pop: np.ndarray) -> GroupResult:
             elif c.is_agg:
                 var = ex.val_vars.get(c.attr, {})
                 vals = [var[m] for m in members.tolist() if m in var]
-                aggs[label] = _aggregate(c.agg_func, vals)
+                v = _aggregate(c.agg_func, vals)
+                if v is not None:  # min/max over no values: omit
+                    aggs[label] = v
         res.groups.append(({a: k for a, k in zip(sg.groupby, key)}, aggs,
                            members))
     return res
@@ -87,7 +89,8 @@ def _key_values(store, attr: str, rank: int) -> list:
 
 def _aggregate(fn: str, vals: list):
     if not vals:
-        return None
+        # reference: sum/avg over an empty set render 0; min/max omit
+        return 0 if fn in ("sum", "avg") else None
     if fn == "min":
         return min(vals)
     if fn == "max":

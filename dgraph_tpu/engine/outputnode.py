@@ -68,7 +68,14 @@ class _Renderer:
         for leaf in node.leaf_sgs:
             if leaf.is_agg:
                 var = self.ex.val_vars.get(leaf.attr, {})
-                vals = [var[int(r)] for r in node.nodes.tolist() if int(r) in var]
+                if node.sg.func is None:
+                    # func-less aggregation block (`s() { min(val(a)) }`):
+                    # the domain is the var's whole binding (reference:
+                    # root-level aggregation with an empty block)
+                    vals = list(var.values())
+                else:
+                    vals = [var[int(r)] for r in node.nodes.tolist()
+                            if int(r) in var]
                 v = _aggregate(leaf.agg_func, vals)
                 if v is not None:
                     name = leaf.alias or f"{leaf.agg_func}(val({leaf.attr}))"
@@ -145,12 +152,13 @@ class _Renderer:
                 obj[name] = [{"@groupby": self._groups_list(g)}]
             return
         facet_cols = None
-        if child.sg.facet_keys is not None and not child.sg.is_reverse \
-                and len(child.matrix_pos):
+        if child.sg.facet_keys is not None and len(child.matrix_pos):
             keys = [k for _, k in child.sg.facet_keys] or None
             aliases = {k: a for a, k in (child.sg.facet_keys or []) if a}
             facet_cols = (self.store.edge_facets(
-                child.sg.attr, child.matrix_pos, keys), aliases)
+                child.sg.attr,
+                self.ex.facet_positions(child.sg, child.matrix_pos),
+                keys), aliases)
         lst = []
         for j, cr in enumerate(rows.tolist()):
             o = self.node_obj(child, int(cr), aliased_only)

@@ -80,9 +80,8 @@ def expand_recurse(ex, root) -> None:
         for i, esg in enumerate(data.edge_sgs):
             nbrs, seg, pos = ex.expand(esg.attr, esg.is_reverse, frontier)
             nbrs, seg, pos = ex.filter_edges(esg.filters, nbrs, seg, pos)
-            if not esg.is_reverse:
-                nbrs, seg, pos = ex.facet_filter_edges(esg, esg.attr, nbrs,
-                                                       seg, pos)
+            nbrs, seg, pos = ex.facet_filter_edges(esg, esg.attr, nbrs,
+                                                   seg, pos)
             if not args.loop and len(nbrs):
                 # visit-once: drop edges to already-seen nodes so the result
                 # graph is a DAG by depth (first-visit tree semantics)

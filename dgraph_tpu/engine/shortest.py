@@ -116,10 +116,10 @@ def _dijkstra(ex, sg, data: PathData, src: int, dst: int) -> PathData:
             nbrs, seg, pos = ex.filter_edges(esg.filters, nbrs, seg, pos)
             if not len(nbrs):
                 continue
-            if wkeys[i] and not esg.is_reverse and len(pos):
+            if wkeys[i] and len(pos):
                 fvals = store.edge_facets(
-                    esg.attr, pos, [wkeys[i]]).get(wkeys[i],
-                                                   [None] * len(pos))
+                    esg.attr, ex.facet_positions(esg, pos),
+                    [wkeys[i]]).get(wkeys[i], [None] * len(pos))
                 ws = [float(v) if isinstance(v, (int, float, np.integer,
                                                  np.floating)) else 1.0
                       for v in fvals]

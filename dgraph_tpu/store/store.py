@@ -111,6 +111,23 @@ class PredicateData:
     efacets: dict[str, FacetCol] = field(default_factory=dict)
     # facet key → {subject rank: value} for value postings
     vfacets: dict[str, dict[int, object]] = field(default_factory=dict)
+    # reverse-CSR position → forward-CSR position: facets live on the
+    # forward posting, but the reference serves them on ~pred expansions
+    # too; this map makes reverse edge_pos facet-addressable
+    rev_pos: np.ndarray | None = None
+
+    def build_rev_pos(self, n: int) -> None:
+        if self.rev is None or self.fwd is None or not self.rev.nnz:
+            return
+        o_arr = np.repeat(np.arange(n, dtype=np.int64),
+                          np.diff(self.rev.indptr).astype(np.int64))
+        s_arr = self.rev.indices.astype(np.int64)
+        # both CSRs are sorted by (subject, object), so the flattened
+        # (s * n + o) keys of the forward edges are ascending
+        fwd_src = np.repeat(np.arange(n, dtype=np.int64),
+                            np.diff(self.fwd.indptr).astype(np.int64))
+        fwd_keys = fwd_src * n + self.fwd.indices.astype(np.int64)
+        self.rev_pos = np.searchsorted(fwd_keys, s_arr * n + o_arr)
 
 
 class Store:
@@ -125,6 +142,16 @@ class Store:
         self._device: dict[tuple[str, str], tuple[jax.Array, jax.Array]] = {}
         self._empty_rel = EdgeRel(np.zeros(self.n_nodes + 1, np.int32),
                                   np.zeros(0, np.int32))
+
+    def rev_to_fwd_pos(self, pred: str, pos: np.ndarray) -> np.ndarray:
+        """Map reverse-CSR edge positions to their forward positions (the
+        space facet columns key on). Built lazily per predicate."""
+        pd = self.preds.get(pred)
+        if pd is None or not len(pos):
+            return pos
+        if pd.rev_pos is None:
+            pd.build_rev_pos(self.n_nodes)
+        return pd.rev_pos[pos] if pd.rev_pos is not None else pos
 
     # -- uid ↔ rank ---------------------------------------------------------
     @property
@@ -185,16 +212,24 @@ class Store:
 
     def values_for(self, pred: str, rank: int, lang: str = "") -> list:
         """Values of `pred` on `rank`. `lang` may be a fallback chain like
-        "en:fr:." (reference: language preference lists; "." = untagged)."""
+        "en:fr:." (reference: language preference lists; "." = ANY
+        language, untagged preferred — gql lang fallback semantics)."""
         if not lang:
             col = self.value_col(pred, "")
             return col.get(rank) if col is not None else []
+        pd = self.preds.get(pred)
         for l in lang.split(":"):
-            col = self.value_col(pred, "" if l == "." else l)
-            if col is not None:
-                vs = col.get(rank)
-                if vs:
-                    return vs
+            if l == ".":
+                langs = [""] + sorted(k for k in (pd.vals if pd else {})
+                                      if k)
+            else:
+                langs = [l]
+            for lk in langs:
+                col = self.value_col(pred, lk)
+                if col is not None:
+                    vs = col.get(rank)
+                    if vs:
+                        return vs
         return []
 
     def has_ranks(self, pred: str) -> np.ndarray:

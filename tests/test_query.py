@@ -58,6 +58,11 @@ def build_store():
         b.add_type(uid, "Person")
     b.add_value(1, "name", "Michonne-fr", lang="fr")
     b.add_value(2, "nickname", "The King")
+    b.add_value(3, "name", "Maggie", lang="en")
+    # uid 7: tagged-only names (lang fallback-chain fixture)
+    b.add_value(7, "name", "Zeven", lang="nl")
+    b.add_value(7, "name", "Sieben", lang="de")
+    b.add_value(7, "age", 70)
     for s, o in FRIENDS:
         b.add_edge(s, "friend", o, facets=FRIEND_FACETS.get((s, o)))
     b.add_edge(2, "boss", 1)
@@ -339,6 +344,328 @@ CASES = [
          {"name": "Leonard", "friend|since": 1999},
          {"name": "King Lear", "friend|since": 2004},
          {"name": "Margaret", "friend|since": 2010}]}]}),
+
+    # ---- language chains (reference: gql lang fallback lists) ----------
+    ("lang_exact_tag", """
+     { q(func: uid(3)) { name@en } }""",
+     {"q": [{"name@en": "Maggie"}]}),
+
+    ("lang_missing_tag_empty", """
+     { q(func: uid(4)) { name@fr } }""",
+     {"q": []}),
+
+    ("lang_chain_two_tags", """
+     { q(func: uid(7)) { name@de:nl } }""",
+     {"q": [{"name@de:nl": "Sieben"}]}),
+
+    ("lang_chain_fallback_any", """
+     { q(func: uid(1, 7)) { name@xx:. } }""",
+     {"q": [{"name@xx:.": "Michonne"}, {"name@xx:.": "Sieben"}]}),
+
+    ("lang_bare_any", """
+     { q(func: uid(7)) { name@. } }""",
+     {"q": [{"name@.": "Sieben"}]}),
+
+    ("lang_untagged_excludes_tagged", """
+     { q(func: uid(7)) { age name } }""",
+     {"q": [{"age": 70}]}),
+
+    ("eq_on_lang_index", """
+     { q(func: eq(name@en, "Maggie")) { name } }""",
+     {"q": [{"name": "Margaret"}]}),
+
+    # ---- facets on reverse edges (forward postings, ~pred render) ------
+    ("facets_on_reverse_edge", """
+     { q(func: uid(2)) { name ~friend @facets(since) { name } } }""",
+     {"q": [{"name": "King Lear",
+             "~friend": [{"name": "Michonne", "~friend|since": 2004}]}]}),
+
+    ("facets_reverse_all_keys", """
+     { q(func: uid(4)) { ~friend @facets { name } } }""",
+     {"q": [{"~friend": [
+         {"name": "Michonne", "~friend|since": 1999},
+         {"name": "Margaret"}]}]}),
+
+    ("facets_reverse_filter", """
+     { q(func: uid(3)) { ~friend @facets(eq(close, false)) { name } } }""",
+     {"q": [{"~friend": [{"name": "Michonne"}]}]}),
+
+    # ---- cascade / normalize / pagination interactions ----------------
+    ("cascade_then_pagination", """
+     { q(func: has(age), first: 2) @cascade { name nickname } }""",
+     {"q": [{"name": "King Lear", "nickname": "The King"}]}),
+
+    ("cascade_nested_edge", """
+     { q(func: uid(1, 2, 5)) @cascade { name friend { nickname } } }""",
+     {"q": [{"name": "Michonne",
+             "friend": [{"nickname": "The King"}]}]}),
+
+    ("normalize_nested_alias", """
+     { q(func: uid(1)) @normalize {
+         n: name friend { fn: name friend { ffn: name } } } }""",
+     {"q": [{"n": "Michonne", "fn": "King Lear", "ffn": "Margaret"},
+            {"n": "Michonne", "fn": "Margaret", "ffn": "Leonard"},
+            {"n": "Michonne", "fn": "Leonard", "ffn": "Garfield"}]}),
+
+    ("normalize_with_pagination", """
+     { q(func: uid(1)) @normalize {
+         friend (first: 2) { fn: name } } }""",
+     {"q": [{"fn": "King Lear"}, {"fn": "Margaret"}]}),
+
+    # ---- val-var propagation across blocks -----------------------------
+    ("valvar_cross_block_order", """
+     { var(func: has(age)) { a as age }
+       q(func: uid(a), orderdesc: val(a), first: 3) { name age } }""",
+     {"q": [{"name": "King Lear", "age": 77},
+            {"age": 70},
+            {"name": "Leonard", "age": 45}]}),
+
+    ("valvar_filter_le", """
+     { var(func: has(age)) { a as age }
+       q(func: uid(a)) @filter(le(val(a), 12)) { name age } }""",
+     {"q": [{"name": "Garfield", "age": 5}, {"name": "Bear", "age": 12}]}),
+
+    ("valvar_math_two_vars", """
+     { var(func: uid(1)) { a as age h as height }
+       q(func: uid(1)) { m: math(a + h) } }""",
+     {"q": [{"m": 39.67}]}),
+
+    ("valvar_sum_over_block", """
+     { var(func: uid(1)) { f as friend { a as age } }
+       s(func: uid(f)) { total: sum(val(a)) } }""",
+     {"s": [{"total": 153}]}),
+
+    ("uid_var_from_child", """
+     { var(func: uid(1)) { friend { f as friend } }
+       q(func: uid(f)) { name } }""",
+     {"q": [{"name": "Margaret"}, {"name": "Leonard"},
+            {"name": "Garfield"}]}),
+
+    # ---- pagination / ordering -----------------------------------------
+    ("first_negative_root", """
+     { q(func: type(Person), first: -2) { name } }""",
+     {"q": [{"name": "Garfield"}, {"name": "Bear"}]}),
+
+    ("offset_beyond_end", """
+     { q(func: type(Person), offset: 50) { name } }""",
+     {"q": []}),
+
+    ("after_cursor_root", """
+     { q(func: type(Person), after: 0x3) { name } }""",
+     {"q": [{"name": "Leonard"}, {"name": "Garfield"}, {"name": "Bear"}]}),
+
+    ("after_on_child", """
+     { q(func: uid(1)) { friend (after: 0x2) { name } } }""",
+     {"q": [{"friend": [{"name": "Margaret"}, {"name": "Leonard"}]}]}),
+
+    ("child_first_negative", """
+     { q(func: uid(1)) { friend (first: -1) { name } } }""",
+     {"q": [{"friend": [{"name": "Leonard"}]}]}),
+
+    ("two_order_keys", """
+     { q(func: type(Person), orderasc: alive, orderdesc: age) { name } }""",
+     {"q": [{"name": "King Lear"}, {"name": "Bear"},
+            {"name": "Leonard"}, {"name": "Michonne"},
+            {"name": "Margaret"}, {"name": "Garfield"}]}),
+
+    ("orderasc_string", """
+     { q(func: type(Film), orderasc: name) { name } }""",
+     {"q": [{"name": "Blade Runner"}, {"name": "Blade Trinity"},
+            {"name": "The Wire"}]}),
+
+    ("order_by_lang_value", """
+     { q(func: uid(1, 3), orderasc: name@fr:.) { name@fr:. } }""",
+     {"q": [{"name@fr:.": "Margaret"}, {"name@fr:.": "Michonne-fr"}]}),
+
+    ("order_then_offset", """
+     { q(func: type(Person), orderasc: age, offset: 2, first: 2) { age } }""",
+     {"q": [{"age": 31}, {"age": 38}]}),
+
+    # ---- filters --------------------------------------------------------
+    ("not_at_root_filter", """
+     { q(func: type(Person)) @filter(NOT ge(age, 30)) { name } }""",
+     {"q": [{"name": "Garfield"}, {"name": "Bear"}]}),
+
+    ("nested_and_or_not", """
+     { q(func: type(Person))
+       @filter((le(age, 40) AND eq(alive, true)) OR NOT has(friend))
+       { name } }""",
+     {"q": [{"name": "Michonne"}, {"name": "Margaret"},
+            {"name": "Garfield"}, {"name": "Bear"}]}),
+
+    ("eq_multiple_args", """
+     { q(func: eq(name, "Michonne", "Bear")) { name } }""",
+     {"q": [{"name": "Michonne"}, {"name": "Bear"}]}),
+
+    ("filter_has_child", """
+     { q(func: uid(1)) { friend @filter(has(nickname)) { name } } }""",
+     {"q": [{"friend": [{"name": "King Lear"}]}]}),
+
+    ("filter_between_child", """
+     { q(func: uid(1)) { friend @filter(between(age, 30, 50)) { name } } }""",
+     {"q": [{"friend": [{"name": "Margaret"}, {"name": "Leonard"}]}]}),
+
+    ("gt_float_root", """
+     { q(func: gt(height, 1.6)) { name height } }""",
+     {"q": [{"name": "Michonne", "height": 1.67},
+            {"name": "King Lear", "height": 1.7},
+            {"name": "Leonard", "height": 1.85}]}),
+
+    ("eq_bool_false", """
+     { q(func: eq(alive, false)) { name } }""",
+     {"q": [{"name": "King Lear"}, {"name": "Bear"}]}),
+
+    ("regexp_case_insensitive", """
+     { q(func: regexp(name, /^blade.*/i)) { name } }""",
+     {"q": [{"name": "Blade Runner"}, {"name": "Blade Trinity"}]}),
+
+    ("filter_uid_literal_child", """
+     { q(func: uid(1)) { friend @filter(uid(0x3, 0x4)) { name } } }""",
+     {"q": [{"friend": [{"name": "Margaret"}, {"name": "Leonard"}]}]}),
+
+    # ---- counts / aggregation ------------------------------------------
+    ("count_reverse_leaf", """
+     { q(func: uid(3)) { name count(~friend) } }""",
+     {"q": [{"name": "Margaret", "count(~friend)": 2}]}),
+
+    ("min_max_same_block", """
+     { var(func: type(Person)) { a as age }
+       s() { min(val(a)) max(val(a)) } }""",
+     {"s": [{"min(val(a))": 5}, {"max(val(a))": 77}]}),
+
+    ("avg_val_block", """
+     { var(func: uid(5, 6)) { a as age }
+       s() { avg(val(a)) } }""",
+     {"s": [{"avg(val(a))": 8.5}]}),
+
+    ("count_pred_filter_root", """
+     { q(func: eq(count(friend), 3)) { name } }""",
+     {"q": [{"name": "Michonne"}]}),
+
+    ("agg_empty_set", """
+     { var(func: eq(name, "NoSuch")) { a as age }
+       s() { sum(val(a)) } }""",
+     {"s": [{"sum(val(a))": 0}]}),
+
+    ("alias_on_count", """
+     { q(func: uid(1)) { n: count(friend) } }""",
+     {"q": [{"n": 3}]}),
+
+    # ---- recurse --------------------------------------------------------
+    ("recurse_depth_1", """
+     { q(func: uid(1)) @recurse(depth: 1) { name friend } }""",
+     {"q": [{"name": "Michonne",
+             "friend": [{"name": "King Lear"}, {"name": "Margaret"},
+                        {"name": "Leonard"}]}]}),
+
+    ("recurse_with_filter", """
+     { q(func: uid(1)) @recurse(depth: 3)
+       { name friend @filter(eq(alive, true)) } }""",
+     # first-visit tree: Margaret and Leonard are both reached at hop 1,
+     # so Margaret's edge to Leonard doesn't re-nest him (loop=false)
+     {"q": [{"name": "Michonne", "friend": [
+         {"name": "Margaret"},
+         {"name": "Leonard", "friend": [{"name": "Garfield"}]}]}]}),
+
+    ("recurse_reverse_edge", """
+     { q(func: uid(6)) @recurse(depth: 3) { name ~friend } }""",
+     {"q": [{"name": "Bear", "~friend": [
+         {"name": "Garfield", "~friend": [
+             {"name": "Leonard", "~friend": [
+                 {"name": "Michonne"}, {"name": "Margaret"}]}]}]}]}),
+
+    # ---- shortest -------------------------------------------------------
+    ("shortest_unreachable", """
+     { path as shortest(from: 0x6, to: 0x1) { friend }
+       p(func: uid(path)) { name } }""",
+     {"_path_": [], "p": []}),
+
+    ("shortest_reverse_pred", """
+     { path as shortest(from: 0x5, to: 0x3) { ~friend }
+       p(func: uid(path)) { name } }""",
+     {"_path_": [{"uid": "0x5", "~friend": {
+         "uid": "0x4", "~friend": {"uid": "0x3"}}}],
+      "p": [{"name": "Margaret"}, {"name": "Leonard"},
+            {"name": "Garfield"}]}),
+
+    # ---- expand ---------------------------------------------------------
+    ("expand_type_arg", """
+     { q(func: uid(100)) { expand(Film) } }""",
+     {"q": [{"name": "The Wire"}]}),
+
+    ("expand_all_with_children", """
+     { q(func: uid(102)) { expand(_all_) { name } } }""",
+     {"q": [{"name": "Blade Trinity",
+             "starring": [{"name": "Margaret"}],
+             "genre": [{"name": "SciFi"}]}]}),
+
+    # ---- misc -----------------------------------------------------------
+    ("dgraph_type_leaf", """
+     { q(func: uid(1, 100)) { dgraph.type } }""",
+     {"q": [{"dgraph.type": ["Person"]}, {"dgraph.type": ["Film"]}]}),
+
+    ("uid_func_dedup_sorted", """
+     { q(func: uid(0x3, 0x1, 0x3)) { uid } }""",
+     {"q": [{"uid": "0x1"}, {"uid": "0x3"}]}),
+
+    ("has_on_uid_pred", """
+     { q(func: has(boss)) { name } }""",
+     {"q": [{"name": "King Lear"}, {"name": "Margaret"}]}),
+
+    ("same_pred_two_aliases", """
+     { q(func: uid(1)) {
+         adults: friend @filter(ge(age, 18)) { name }
+         pets: friend @filter(lt(age, 18)) { name } } }""",
+     {"q": [{"adults": [{"name": "King Lear"}, {"name": "Margaret"},
+                        {"name": "Leonard"}]}]}),
+
+    ("nested_reverse_mix", """
+     { q(func: uid(1)) { ~starring { name starring { name } } } }""",
+     {"q": [{"~starring": [
+         {"name": "The Wire",
+          "starring": [{"name": "Michonne"}, {"name": "King Lear"}]},
+         {"name": "Blade Runner",
+          "starring": [{"name": "Michonne"}, {"name": "Margaret"}]}]}]}),
+
+    ("uid_var_two_blocks_reuse", """
+     { a as var(func: uid(1)) { friend }
+       x(func: uid(a)) { name }
+       y(func: uid(a)) @filter(ge(age, 35)) { name } }""",
+     {"x": [{"name": "Michonne"}],
+      "y": [{"name": "Michonne"}]}),
+
+    ("count_uid_at_child", """
+     { q(func: uid(1, 2)) { name friend { count(uid) } } }""",
+     {"q": [{"name": "Michonne", "friend": [{"count": 3}]},
+            {"name": "King Lear", "friend": [{"count": 1}]}]}),
+
+    ("empty_block_no_func_error_free", """
+     { q(func: uid(0x7f)) { name } }""",
+     {"q": []}),
+
+    ("anyofterms_multi_args", """
+     { q(func: anyofterms(name, "Michonne", "Bear")) { name } }""",
+     {"q": [{"name": "Michonne"}, {"name": "Bear"}]}),
+
+    ("recurse_reverse_facet_filter", """
+     { q(func: uid(4)) @recurse(depth: 1)
+       { name ~friend @facets(eq(close, false)) } }""",
+     {"q": [{"name": "Leonard"}]}),
+
+    ("shortest_reverse_weighted", """
+     { path as shortest(from: 0x3, to: 0x1) { ~friend @facets(since) }
+       p(func: uid(path)) { name } }""",
+     # facet weights apply on ~pred too: the direct 3→1 edge costs 2010
+     # (since facet), but 3→2 (no facet: 1.0) + 2→1 (2004) = 2005 wins
+     {"_path_": [{"uid": "0x3", "~friend": {
+         "uid": "0x2", "~friend": {"uid": "0x1"}}, "_weight_": 2005.0}],
+      "p": [{"name": "Michonne"}, {"name": "King Lear"},
+            {"name": "Margaret"}]}),
+
+    ("groupby_minmax_empty_group", """
+     { var(func: uid(100)) { a as name }
+       q(func: type(Person)) @groupby(alive) { min(val(a)) } }""",
+     {"q": [{"@groupby": [{"alive": False}, {"alive": True}]}]}),
 ]
 
 
@@ -467,3 +794,28 @@ def test_iri_reverse_and_aliased_uid(engine):
     out = q(engine, '{ lear(func: eq(name, "King Lear")) { myid: uid ~<friend> { name } } }')
     assert out == {"lear": [{"myid": "0x2",
                              "~friend": [{"name": "Michonne"}]}]}
+
+
+# ---- error cases (reference: parser/validation error tables) --------------
+
+ERROR_CASES = [
+    ("unknown_function", '{ q(func: frobnicate(name, "x")) { name } }'),
+    ("duplicate_block_names", '{ q(func: uid(1)) { uid } q(func: uid(2)) { uid } }'),
+    ("undefined_query_var", '{ q(func: eq(name, $missing)) { name } }'),
+    ("unterminated_block", '{ q(func: uid(1)) { name '),
+    ("trailing_garbage", '{ q(func: uid(1)) { name } } extra'),
+    ("bad_uid_literal", '{ q(func: uid(zzz)) { name } }'),
+    ("filter_without_parens", '{ q(func: uid(1)) @filter { name } }'),
+    ("empty_query", ''),
+    ("orphan_lang_tag", '{ q(func: uid(1)) { @en } }'),
+    ("between_arity", '{ q(func: between(age, 1)) { name } }'),
+]
+
+
+@pytest.mark.parametrize("name,query", ERROR_CASES,
+                         ids=[c[0] for c in ERROR_CASES])
+def test_query_errors(name, query):
+    from dgraph_tpu.dql.parser import ParseError
+    e = Engine(build_store(), device_threshold=10**9)
+    with pytest.raises((ParseError, ValueError)):
+        e.query(query)
