@@ -71,6 +71,20 @@ def cmd_alpha(args) -> int:
         # restarted follower replays the leader's log + snapshot)
         if alpha.groups.other_addrs():
             alpha.resync_on_join()
+
+        def size_heartbeat():
+            # feed Zero's rebalance loop (reference: tablet-size report
+            # in the membership heartbeat)
+            import time as _time
+            while True:
+                _time.sleep(30.0)
+                try:
+                    alpha.report_tablet_sizes()
+                except Exception:  # noqa: BLE001 — heartbeat must survive
+                    log.debug("tablet size report failed", exc_info=True)
+
+        import threading
+        threading.Thread(target=size_heartbeat, daemon=True).start()
     http_server = make_http_server(alpha, cfg.http_addr, cfg.http_port)
     serve_background(http_server)
     log.info("alpha up: grpc=%d http=%d", grpc_port,
@@ -85,16 +99,42 @@ def cmd_alpha(args) -> int:
 
 def cmd_zero(args) -> int:
     # Standalone cluster manager (reference: dgraph zero): ts/uid leases,
-    # commit arbitration, membership, tablet assignment — the full
-    # pb.Zero surface (cluster/zero.py).
-    from dgraph_tpu.cluster.zero import ZeroState, make_zero_server
+    # commit arbitration, membership, tablet assignment/rebalance — the
+    # full pb.Zero surface (cluster/zero.py). With --w the state machine
+    # journals to disk and a restart preserves tablets and watermarks.
+    import threading
+
+    from dgraph_tpu.cluster.zero import (ZeroState, make_zero_server,
+                                         rebalance_once)
 
     xlog.setup(args.log_level)
     log = xlog.get("zero")
-    server, port, _state = make_zero_server(
-        ZeroState(replicas=args.replicas), f"127.0.0.1:{args.port}")
+    state = ZeroState(
+        replicas=args.replicas,
+        journal_path=(f"{args.w}/zero.journal" if args.w else None),
+        txn_timeout_s=args.txn_timeout)
+    server, port, _state = make_zero_server(state,
+                                            f"127.0.0.1:{args.port}")
     server.start()
-    log.info("zero up: grpc=%d replicas=%d", port, args.replicas)
+    log.info("zero up: grpc=%d replicas=%d journal=%s", port,
+             args.replicas, args.w or "off")
+
+    def maintenance():
+        import time
+        while True:
+            time.sleep(max(args.txn_timeout / 2, 1.0)
+                       if args.txn_timeout else 10.0)
+            try:
+                n = state.expire_stale_txns()
+                if n:
+                    log.info("expired %d abandoned txns", n)
+                if args.rebalance and rebalance_once(state):
+                    log.info("rebalanced one tablet")
+            except Exception:  # noqa: BLE001 — the loop must outlive bugs
+                log.exception("zero maintenance sweep failed")
+
+    t = threading.Thread(target=maintenance, daemon=True)
+    t.start()
     server.wait_for_termination()
     return 0
 
@@ -189,6 +229,13 @@ def main(argv=None) -> int:
     p.add_argument("--port", type=int, default=5080)
     p.add_argument("--replicas", type=int, default=1,
                    help="replicas per group (elasticity knob)")
+    p.add_argument("--w", default=None,
+                   help="journal dir (state survives restart)")
+    p.add_argument("--txn_timeout", type=float, default=300.0,
+                   help="abort pending txns older than this — the max "
+                        "transaction lifetime (0 = never)")
+    p.add_argument("--rebalance", action="store_true",
+                   help="enable the size-based tablet rebalance loop")
     p.add_argument("--log_level", default="info")
     p.set_defaults(fn=cmd_zero)
 

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import hashlib
 import threading
+import time
 from dataclasses import dataclass, field
 
 
@@ -40,6 +41,7 @@ def fingerprint(key) -> str:
 class TxnStatus:
     start_ts: int
     commit_ts: int  # 0 while pending, -1 if aborted
+    created: float = field(default_factory=time.monotonic)
 
 
 class Oracle:
@@ -160,6 +162,23 @@ class Oracle:
             st = self._pending.get(start_ts)
             if st is not None and st.commit_ts == 0:
                 st.commit_ts = -1
+
+    def expire_older_than(self, max_age_s: float) -> int:
+        """Abort pending txns OLDER than max_age_s (age since start, not
+        idleness — Zero only hears from a txn again at commit). A
+        coordinator that crashed without abort must not pin the gc
+        watermark forever (reference: Zero lease timeouts). A later
+        commit of an expired txn raises TxnAborted, exactly like a lost
+        conflict; max_age_s is therefore also the ceiling on transaction
+        lifetime and should be generous."""
+        cutoff = time.monotonic() - max_age_s
+        n = 0
+        with self._lock:
+            for st in self._pending.values():
+                if st.commit_ts == 0 and st.created < cutoff:
+                    st.commit_ts = -1
+                    n += 1
+        return n
 
     def status(self, start_ts: int) -> TxnStatus | None:
         with self._lock:

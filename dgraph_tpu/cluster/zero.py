@@ -26,12 +26,25 @@ from dgraph_tpu.protos import task_pb2 as pb
 SERVICE_ZERO = "dgraph_tpu.Zero"
 
 
-class ZeroState:
-    """Membership + tablets + the oracle, under one lock."""
+LEASE_BLOCK = 1000   # ts/uid leases persist at block granularity
 
-    def __init__(self, replicas: int = 1):
+
+class ZeroState:
+    """Membership + tablets + the oracle, under one lock.
+
+    With `journal_path` set, every state transition (join, tablet claim,
+    move, removal) and lease-block boundary is fsync'd to a Journal and
+    replayed on restart — Zero's tablet map and watermarks survive without
+    any Alpha rejoining (reference: group-0 raft WAL + snapshots). Leases
+    persist per LEASE_BLOCK: a restart skips to the end of the last
+    persisted block, burning at most one block of unused ids — the same
+    trade the reference's batched lease makes."""
+
+    def __init__(self, replicas: int = 1, journal_path: str | None = None,
+                 txn_timeout_s: float = 0.0):
         self.oracle = Oracle()
         self.replicas = replicas
+        self.txn_timeout_s = txn_timeout_s
         self._lock = threading.Lock()
         self._next_node = 1
         self._next_group = 1
@@ -39,7 +52,99 @@ class ZeroState:
         self.groups: dict[int, dict[int, str]] = {}
         # pred -> group_id
         self.tablets: dict[str, int] = {}
+        # group_id -> {pred: approx bytes} (rebalance input)
+        self.tablet_sizes: dict[int, dict[str, int]] = {}
         self.counter = 0
+        self._journal = None
+        self._ts_block = 0
+        self._uid_block = 0
+        if journal_path:
+            from dgraph_tpu.store.wal import Journal
+            for doc in Journal.replay(journal_path):
+                self._replay(doc)
+            self._journal = Journal(journal_path)
+
+    def _replay(self, doc: dict) -> None:
+        k = doc["k"]
+        if k == "join":
+            self.groups.setdefault(doc["g"], {})[doc["n"]] = doc["a"]
+            self._next_node = max(self._next_node, doc["n"] + 1)
+            self._next_group = max(self._next_group, doc["g"] + 1)
+        elif k == "tablet":
+            self.tablets[doc["p"]] = doc["g"]
+        elif k == "remove":
+            for nodes in self.groups.values():
+                nodes.pop(doc["n"], None)
+        elif k == "ts":
+            self._ts_block = max(self._ts_block, doc["v"])
+            self.oracle.bump_ts(doc["v"])
+        elif k == "uid":
+            self._uid_block = max(self._uid_block, doc["v"])
+            self.oracle.bump_uid(doc["v"])
+        self.counter += 1
+
+    def _log(self, doc: dict) -> None:
+        if self._journal is not None:
+            self._journal.append(doc)
+
+    def persist_leases(self) -> None:
+        """Journal the lease watermarks at block granularity — called on
+        the issuing paths, fsyncs only when a block boundary is crossed."""
+        if self._journal is None:
+            return
+        ts = self.oracle.max_assigned
+        uid = self.oracle.max_uid
+        with self._lock:
+            if ts >= self._ts_block:
+                self._ts_block = (ts // LEASE_BLOCK + 1) * LEASE_BLOCK
+                self._log({"k": "ts", "v": self._ts_block})
+            if uid >= self._uid_block:
+                self._uid_block = (uid // LEASE_BLOCK + 1) * LEASE_BLOCK
+                self._log({"k": "uid", "v": self._uid_block})
+
+    def expire_stale_txns(self) -> int:
+        """Abort pending transactions older than txn_timeout_s — a crashed
+        coordinator must not pin the gc watermark forever (reference: Zero
+        expires via MaxAssigned + timeouts). Returns the abort count."""
+        if not self.txn_timeout_s:
+            return 0
+        return self.oracle.expire_older_than(self.txn_timeout_s)
+
+    def report_sizes(self, group: int, sizes: dict[str, int]) -> None:
+        with self._lock:
+            self.tablet_sizes[group] = dict(sizes)
+
+    def move_tablet(self, pred: str, dst_group: int) -> bool:
+        """Flip a tablet's owner (the map half of a move; the data ship
+        happens first — see ZeroService.MoveTablet / rebalance_once)."""
+        with self._lock:
+            if dst_group not in self.groups or \
+                    self.tablets.get(pred) == dst_group:
+                return False
+            self.tablets[pred] = dst_group
+            self._log({"k": "tablet", "p": pred, "g": dst_group})
+            self.counter += 1
+            return True
+
+    def rebalance_candidate(self):
+        """Pick (pred, src_group, dst_group): move the smallest tablet of
+        the most-loaded group to the least-loaded group, if the imbalance
+        is worth it (reference: zero/tablet.go rebalance loop)."""
+        with self._lock:
+            if len(self.groups) < 2:
+                return None
+            load = {g: sum(self.tablet_sizes.get(g, {}).values())
+                    for g in self.groups}
+            src = max(load, key=load.get)
+            dst = min(load, key=load.get)
+            if src == dst or load[src] <= 1.5 * max(load[dst], 1):
+                return None
+            movable = {p: s for p, s in self.tablet_sizes[src].items()
+                       if self.tablets.get(p) == src}
+            if not movable:
+                return None
+            pred = min(movable, key=movable.get)
+            return pred, src, dst
 
     def connect(self, addr: str, group: int = 0, max_ts: int = 0,
                 max_uid: int = 0) -> tuple[int, int]:
@@ -66,6 +171,7 @@ class ZeroState:
                     gid = self._next_group
             self.groups.setdefault(gid, {})[node_id] = addr
             self._next_group = max(self._next_group, gid + 1)
+            self._log({"k": "join", "n": node_id, "g": gid, "a": addr})
             self.counter += 1
             return node_id, gid
 
@@ -74,6 +180,7 @@ class ZeroState:
         with self._lock:
             for nodes in self.groups.values():
                 nodes.pop(node_id, None)
+            self._log({"k": "remove", "n": node_id})
             self.counter += 1
 
     def should_serve(self, pred: str, group: int) -> int:
@@ -83,6 +190,7 @@ class ZeroState:
             owner = self.tablets.get(pred)
             if owner is None:
                 self.tablets[pred] = owner = group
+                self._log({"k": "tablet", "p": pred, "g": group})
                 self.counter += 1
             return owner
 
@@ -118,11 +226,21 @@ class ZeroService:
     def Timestamps(self, req: pb.TsRequest, ctx) -> pb.AssignedIds:
         o = self.state.oracle
         ts = o.read_only_ts() if req.read_only else o.read_ts()
+        self.state.persist_leases()
         return pb.AssignedIds(start_id=ts, end_id=ts)
 
     def AssignUids(self, req: pb.AssignRequest, ctx) -> pb.AssignedIds:
         r = self.state.oracle.assign_uids(int(req.num))
+        self.state.persist_leases()
         return pb.AssignedIds(start_id=r.start, end_id=r.stop - 1)
+
+    def ReportTablets(self, req: pb.TabletSizes, ctx) -> pb.Payload:
+        self.state.report_sizes(int(req.group), dict(req.sizes))
+        return pb.Payload(data=b"ok")
+
+    def MoveTablet(self, req: pb.MoveTabletRequest, ctx) -> pb.Payload:
+        ok = move_tablet(self.state, req.pred, int(req.dst_group))
+        return pb.Payload(data=b"ok" if ok else b"noop")
 
     def Commit(self, req: pb.CommitRequest, ctx) -> pb.TxnContext:
         if req.abort:
@@ -133,7 +251,75 @@ class ZeroService:
                                            list(req.keys))
         except TxnAborted as e:
             ctx.abort(grpc.StatusCode.ABORTED, str(e))
+        self.state.persist_leases()
         return pb.TxnContext(start_ts=req.start_ts, commit_ts=cts)
+
+
+def move_tablet(state: ZeroState, pred: str, dst_group: int) -> bool:
+    """Orchestrate a tablet move (reference: zero/tablet.go
+    movePredicate): ship a snapshot to EVERY destination replica, flip
+    the map once, then ship the copy-window delta to each. Queries keep
+    answering throughout — before the flip the old group serves; after
+    it, the new owners (already loaded) do. The flip only happens after
+    at least one replica holds the bulk copy; delta failures retry and
+    are loudly logged (the replica heals fully on its next rejoin
+    resync)."""
+    import contextlib
+    import time as _time
+
+    from dgraph_tpu.server.task import Client
+    from dgraph_tpu.utils import logging as xlog
+    log = xlog.get("zero")
+    with state._lock:
+        src_group = state.tablets.get(pred)
+        src_nodes = dict(state.groups.get(src_group, {}))
+        dst_nodes = dict(state.groups.get(dst_group, {}))
+    if src_group is None or src_group == dst_group or not dst_nodes \
+            or not src_nodes:
+        return False
+    src_addr = sorted(src_nodes.values())[0]
+    with contextlib.ExitStack() as stack:
+        clients = []
+        for addr in sorted(dst_nodes.values()):
+            c = Client(addr)
+            stack.callback(c.close)
+            clients.append((addr, c))
+        loaded = []
+        for addr, c in clients:                # bulk copy, map unflipped
+            try:
+                c.pull_tablet(pred, src_addr)
+                loaded.append((addr, c))
+            except grpc.RpcError as e:
+                log.warning("bulk pull of %s to %s failed: %s",
+                            pred, addr, e)
+        if not loaded:
+            return False
+        if not state.move_tablet(pred, dst_group):
+            return False
+        for addr, c in loaded:                 # copy-window delta
+            for attempt in range(3):
+                try:
+                    c.pull_tablet(pred, src_addr)
+                    break
+                except grpc.RpcError as e:
+                    if attempt == 2:
+                        log.error(
+                            "delta pull of %s to %s failed after flip "
+                            "(%s); replica misses copy-window writes "
+                            "until it resyncs", pred, addr, e)
+                    else:
+                        _time.sleep(0.2)
+    return True
+
+
+def rebalance_once(state: ZeroState) -> bool:
+    """One sweep of the size-based rebalance loop (reference:
+    zero/tablet.go runRebalance)."""
+    cand = state.rebalance_candidate()
+    if cand is None:
+        return False
+    pred, _src, dst = cand
+    return move_tablet(state, pred, dst)
 
 
 def _unary(fn, req_cls):
@@ -156,6 +342,8 @@ def make_zero_server(state: ZeroState | None = None,
             "Timestamps": _unary(svc.Timestamps, pb.TsRequest),
             "AssignUids": _unary(svc.AssignUids, pb.AssignRequest),
             "Commit": _unary(svc.Commit, pb.CommitRequest),
+            "ReportTablets": _unary(svc.ReportTablets, pb.TabletSizes),
+            "MoveTablet": _unary(svc.MoveTablet, pb.MoveTabletRequest),
         }),))
     port = server.add_insecure_port(addr)
     return server, port, state
@@ -217,6 +405,15 @@ class ZeroClient:
     def abort(self, start_ts: int) -> None:
         self._call("Commit", pb.CommitRequest(start_ts=start_ts, abort=True),
                    pb.TxnContext)
+
+    def report_tablets(self, group: int, sizes: dict[str, int]) -> None:
+        self._call("ReportTablets",
+                   pb.TabletSizes(group=group, sizes=sizes), pb.Payload)
+
+    def move_tablet(self, pred: str, dst_group: int) -> bool:
+        r = self._call("MoveTablet", pb.MoveTabletRequest(
+            pred=pred, dst_group=dst_group), pb.Payload)
+        return r.data == b"ok"
 
     def close(self):
         self.channel.close()

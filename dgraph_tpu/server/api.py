@@ -691,6 +691,24 @@ class Alpha:
         with self._state_lock:
             self._open_txns.pop(txn.start_ts, None)
 
+    def report_tablet_sizes(self) -> dict[str, int]:
+        """Report owned-tablet sizes to Zero (reference: the tablet-size
+        heartbeat feeding zero/tablet.go's rebalance loop)."""
+        store = self.mvcc.read_view(self.oracle.read_only_ts())
+        sizes: dict[str, int] = {}
+        for pred, pd in store.preds.items():
+            if not self.groups.serves(pred):
+                continue
+            n = 0
+            for rel in (pd.fwd, pd.rev):
+                if rel is not None:
+                    n += rel.indptr.nbytes + rel.indices.nbytes
+            for col in pd.vals.values():
+                n += col.subj.nbytes + sum(len(str(v)) for v in col.vals)
+            sizes[pred] = n
+        self.groups.zero.report_tablets(self.groups.gid, sizes)
+        return sizes
+
     # -- maintenance --------------------------------------------------------
     def _maybe_gc(self) -> None:
         with self._state_lock:

@@ -178,6 +178,27 @@ class WorkerService:
                 out.records.append(pb.LogRecord(ts=ts, drop=True))
         return out
 
+    def PullTablet(self, req: pb.PullTabletRequest, ctx) -> pb.Payload:
+        """Pull a whole tablet from a peer and install it locally — the
+        data-ship leg of a tablet move (reference: movePredicate's Badger
+        Stream from the old owner to the new). Committed layers above the
+        snapshot compose on top, so writes racing the move survive."""
+        from dgraph_tpu.cluster.tablet import unpack_tablet
+        src = Client(req.src_addr)
+        try:
+            blob, version = src.tablet_snapshot(
+                req.attr, self.alpha.oracle.read_only_ts())
+        finally:
+            src.close()
+        if blob:
+            pd = unpack_tablet(blob, req.attr, self.alpha.mvcc.schema)
+            self.alpha.mvcc.install_tablet(req.attr, pd)
+            with self.alpha._state_lock:
+                self.alpha.tablet_versions[req.attr] = max(
+                    self.alpha.tablet_versions.get(req.attr, 0), version)
+                self.alpha._stale_preds.discard(req.attr)
+        return pb.Payload(data=b"ok")
+
     def TabletSnapshot(self, req: pb.TabletSnapshotRequest,
                        ctx) -> pb.TabletSnapshot:
         """Serve a whole-tablet snapshot as-of read_ts (reference: Badger
@@ -216,6 +237,7 @@ def make_server(alpha: Alpha, addr: str = "127.0.0.1:0",
             "ServeTask": _unary(w.ServeTask, pb.TaskQuery),
             "ApplyMutation": _unary(w.ApplyMutation, pb.MutationMsg),
             "FetchLog": _unary(w.FetchLog, pb.FetchLogRequest),
+            "PullTablet": _unary(w.PullTablet, pb.PullTabletRequest),
             "TabletSnapshot": _unary(w.TabletSnapshot,
                                      pb.TabletSnapshotRequest),
         }),
@@ -298,6 +320,11 @@ class Client:
         self._call(SERVICE_WORKER, "ApplyMutation",
                    pb.MutationMsg(drop_all=True, commit_ts=ts,
                                   origin=origin, prev_ts=prev_ts),
+                   pb.Payload)
+
+    def pull_tablet(self, attr: str, src_addr: str) -> None:
+        self._call(SERVICE_WORKER, "PullTablet",
+                   pb.PullTabletRequest(attr=attr, src_addr=src_addr),
                    pb.Payload)
 
     def tablet_snapshot(self, attr: str, read_ts: int = 0):

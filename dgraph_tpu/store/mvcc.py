@@ -244,8 +244,14 @@ class MVCCStore:
         """Swap a whole predicate's data into the newest fold (snapshot
         resync of an owned tablet from a replica — reference: Badger
         Stream snapshot install). Point-in-time reads below the newest
-        fold keep their old view; new reads see the resynced tablet."""
+        fold keep their old view; new reads see the resynced tablet.
+
+        The incoming blocks are rank-indexed against the CURRENT
+        vocabulary (identical cluster-wide by the vocab-touch broadcast),
+        so the state is folded to a snapshot carrying that vocabulary
+        before the swap — patching an older fold would mis-index."""
         from dgraph_tpu.store.store import Store, build_indexes
+        self.rollup()
         with self._lock:
             fold_ts, store = self._history[-1]
             preds = dict(store.preds)

@@ -96,8 +96,10 @@ def mut_from_bytes(b: bytes) -> Mutation:
     return _doc_mut(json.loads(b))
 
 
-class WAL:
-    """Append-only fsync'd mutation log, one file per store directory."""
+class Journal:
+    """Generic fsync'd append-only JSON-record log (torn-tail safe). The
+    WAL layers mutation semantics on top; Zero journals its state machine
+    through it directly (reference: the group-0 raft WAL role)."""
 
     def __init__(self, path: str, sync: bool = True):
         self.path = path
@@ -116,59 +118,76 @@ class WAL:
         self._wlock = threading.Lock()
         self._f = open(path, "ab")
 
-    def _write(self, doc: dict) -> None:
+    @staticmethod
+    def _frame(doc: dict) -> bytes:
         payload = json.dumps(doc, separators=(",", ":")).encode()
-        rec = MAGIC + _HEADER.pack(len(payload),
-                                   zlib.crc32(payload)) + payload
+        return MAGIC + _HEADER.pack(len(payload),
+                                    zlib.crc32(payload)) + payload
+
+    def append(self, doc: dict) -> None:
         # concurrent appenders (apply broadcasts race local commits) must
         # not interleave record bytes
+        rec = self._frame(doc)
         with self._wlock:
             self._f.write(rec)
             self._f.flush()
             if self.sync:
                 os.fsync(self._f.fileno())
 
-    def append(self, mut: Mutation, commit_ts: int) -> None:
-        """Durably record a committed mutation. Called AFTER the oracle
-        assigns commit_ts and BEFORE the in-memory apply — a crash between
-        the two replays the record (apply is idempotent set-semantics)."""
-        self._write({"ts": commit_ts, "m": _mut_doc(mut)})
-
-    def append_schema(self, schema_text: str, ts: int) -> None:
-        """Durably record an Alter's schema text (replay re-runs the
-        rebuild; reference: schema mutations ride the same raft log)."""
-        self._write({"ts": ts, "schema": schema_text})
-
-    def append_drop(self, ts: int) -> None:
-        """Durably record a DropAll (replay resets, not resurrects)."""
-        self._write({"ts": ts, "drop": 1})
-
-    def truncate(self, upto_ts: int) -> None:
-        """Drop records with commit_ts ≤ upto_ts (checkpoint just absorbed
-        them). Rewrites via temp file + atomic rename; the tail survives.
+    def rewrite(self, docs) -> None:
+        """Atomically replace the log's contents (temp file + rename).
         Holds the write lock for the whole rewrite — a concurrent append
-        (broadcast receive path) must neither hit a closed file nor land
-        on the replaced inode."""
+        must neither hit a closed file nor land on the replaced inode."""
         with self._wlock:
-            keep = [(ts, kind, obj) for ts, kind, obj in replay(self.path)
-                    if ts > upto_ts]
             tmp = self.path + ".tmp"
             with open(tmp, "wb") as f:
-                for ts, kind, obj in keep:
-                    doc = ({"ts": ts, "m": _mut_doc(obj)} if kind == "mut"
-                           else {"ts": ts, "drop": 1} if kind == "drop"
-                           else {"ts": ts, "schema": obj})
-                    payload = json.dumps(doc, separators=(",", ":")).encode()
-                    f.write(MAGIC + _HEADER.pack(
-                        len(payload), zlib.crc32(payload)) + payload)
+                for doc in docs:
+                    f.write(self._frame(doc))
                 f.flush()
                 os.fsync(f.fileno())
             self._f.close()
             os.replace(tmp, self.path)
             self._f = open(self.path, "ab")
 
+    @staticmethod
+    def replay(path: str):
+        if not os.path.exists(path):
+            return
+        with open(path, "rb") as f:
+            data = f.read()
+        for _off, payload in _scan(data):
+            yield json.loads(payload)
+
     def close(self) -> None:
         self._f.close()
+
+
+class WAL(Journal):
+    """Append-only fsync'd mutation log, one file per store directory."""
+
+    def append(self, mut: Mutation, commit_ts: int) -> None:  # type: ignore[override]
+        """Durably record a committed mutation. Called AFTER the oracle
+        assigns commit_ts and BEFORE the in-memory apply — a crash between
+        the two replays the record (apply is idempotent set-semantics)."""
+        super().append({"ts": commit_ts, "m": _mut_doc(mut)})
+
+    def append_schema(self, schema_text: str, ts: int) -> None:
+        """Durably record an Alter's schema text (replay re-runs the
+        rebuild; reference: schema mutations ride the same raft log)."""
+        super().append({"ts": ts, "schema": schema_text})
+
+    def append_drop(self, ts: int) -> None:
+        """Durably record a DropAll (replay resets, not resurrects)."""
+        super().append({"ts": ts, "drop": 1})
+
+    def truncate(self, upto_ts: int) -> None:
+        """Drop records with commit_ts ≤ upto_ts (checkpoint just absorbed
+        them); the tail survives atomically."""
+        self.rewrite(
+            ({"ts": ts, "m": _mut_doc(obj)} if kind == "mut"
+             else {"ts": ts, "drop": 1} if kind == "drop"
+             else {"ts": ts, "schema": obj})
+            for ts, kind, obj in replay(self.path) if ts > upto_ts)
 
 
 def _scan(data: bytes) -> Iterator[tuple[int, bytes]]:
