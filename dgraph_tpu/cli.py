@@ -172,6 +172,26 @@ def cmd_live(args) -> int:
     return 0
 
 
+def cmd_backup(args) -> int:
+    """Binary backup: full or incremental-since-last (reference:
+    ee/backup; SURVEY §2.5)."""
+    from dgraph_tpu.server.backup import backup
+    xlog.setup(args.log_level)
+    m = backup(args.p, args.dest, force_full=args.full)
+    print(json.dumps(m))
+    return 0
+
+
+def cmd_restore(args) -> int:
+    """Rebuild a posting dir from a backup series (reference: ee
+    restore)."""
+    from dgraph_tpu.server.backup import restore
+    xlog.setup(args.log_level)
+    ts = restore(args.dest, args.p)
+    print(json.dumps({"restored_max_ts": ts, "p_dir": args.p}))
+    return 0
+
+
 def cmd_export(args) -> int:
     from dgraph_tpu.server.export import export_json, export_rdf
     from dgraph_tpu.store import checkpoint
@@ -255,6 +275,20 @@ def main(argv=None) -> int:
     p.add_argument("--conc", type=int, default=4)
     p.add_argument("--log_level", default="info")
     p.set_defaults(fn=cmd_live)
+
+    p = sub.add_parser("backup", help="binary backup (full/incremental)")
+    p.add_argument("--p", default="p", help="posting dir to back up")
+    p.add_argument("--dest", required=True, help="backup series dir")
+    p.add_argument("--full", action="store_true",
+                   help="force a full backup even if the chain extends")
+    p.add_argument("--log_level", default="info")
+    p.set_defaults(fn=cmd_backup)
+
+    p = sub.add_parser("restore", help="rebuild a posting dir from backups")
+    p.add_argument("--dest", required=True, help="backup series dir")
+    p.add_argument("--p", required=True, help="posting dir to write")
+    p.add_argument("--log_level", default="info")
+    p.set_defaults(fn=cmd_restore)
 
     p = sub.add_parser("export", help="dump a snapshot as RDF/JSON")
     p.add_argument("--p", default="p")

@@ -158,7 +158,19 @@ class ZeroState:
         self.oracle.bump_ts(max_ts)
         if max_uid:
             self.oracle.bump_uid(max_uid)
+        # the bumped watermarks must hit the journal NOW: a crash before
+        # the next lease-issuing RPC would otherwise replay lower blocks
+        # and re-lease ids the joiner's store already holds
+        self.persist_leases()
         with self._lock:
+            # a rejoining node reclaims its recorded identity by address —
+            # a journal-replayed membership must not trap a restarted
+            # cluster's tablets in ghost groups (reference: raft id reuse
+            # on rejoin)
+            for g, nodes in self.groups.items():
+                for nid, a in nodes.items():
+                    if a == addr and (not group or group == g):
+                        return nid, g
             node_id = self._next_node
             self._next_node += 1
             gid = group

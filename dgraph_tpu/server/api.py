@@ -704,7 +704,17 @@ class Alpha:
                 if rel is not None:
                     n += rel.indptr.nbytes + rel.indices.nbytes
             for col in pd.vals.values():
-                n += col.subj.nbytes + sum(len(str(v)) for v in col.vals)
+                n += col.subj.nbytes
+                if col.vals.dtype == object:
+                    # sampled estimate: exact byte counts would re-scan
+                    # millions of strings every heartbeat
+                    k = min(len(col.vals), 256)
+                    if k:
+                        avg = sum(len(str(v))
+                                  for v in col.vals[:k]) / k
+                        n += int(avg * len(col.vals))
+                else:
+                    n += col.vals.nbytes
             sizes[pred] = n
         self.groups.zero.report_tablets(self.groups.gid, sizes)
         return sizes

@@ -1,0 +1,159 @@
+"""Binary backup/restore: full + incremental-since-ts series.
+
+Reference parity: `ee/backup` + `worker/backup*.go` (SURVEY §2.5) — the
+enterprise binary backup: a SERIES of backups in one destination
+directory, each either a full snapshot or an incremental carrying only
+the commits since the previous backup's read timestamp, plus a restore
+that folds the chain back into a serveable posting directory.
+
+Layout under <dest>/:
+    backup-<seq:04d>-<full|incr>/
+        backup_manifest.json   {type, seq, since_ts, read_ts}
+        (full)  the checkpoint snapshot files (store/checkpoint.py)
+        (incr)  delta.log — WAL-format records in (since_ts, read_ts]
+
+Incrementals read the source WAL, so they are only possible while the
+WAL still covers the previous backup's read_ts (a checkpoint truncates
+absorbed records); `backup()` falls back to a full backup automatically
+when the chain can't be extended — same behavior as the reference when
+the since-ts is below the oldest Badger version.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+
+from dgraph_tpu.store import checkpoint
+from dgraph_tpu.store.wal import Journal, WAL, _mut_doc, replay
+
+MANIFEST = "backup_manifest.json"
+
+
+def _series(dest: str) -> list[dict]:
+    """Existing backups, ascending by seq."""
+    out = []
+    if not os.path.isdir(dest):
+        return out
+    for name in sorted(os.listdir(dest)):
+        mp = os.path.join(dest, name, MANIFEST)
+        if os.path.exists(mp):
+            with open(mp) as f:
+                m = json.load(f)
+            m["dir"] = os.path.join(dest, name)
+            out.append(m)
+    return sorted(out, key=lambda m: m["seq"])
+
+
+def backup(p_dir: str, dest: str, force_full: bool = False) -> dict:
+    """Append one backup to the series at `dest` from the posting dir
+    `p_dir` (offline, or a dir a live Alpha checkpoints to). Returns the
+    new manifest."""
+    from dgraph_tpu.server.api import Alpha
+
+    series = _series(dest)
+    seq = (series[-1]["seq"] + 1) if series else 1
+    last_ts = series[-1]["read_ts"] if series else 0
+
+    alpha = Alpha.open(p_dir, sync=False)
+    # the oracle watermark covers EVERY replayed record — including a
+    # trailing DropAll, which resets mvcc state to ts 0 and would
+    # otherwise regress read_ts and fall out of the incremental window
+    read_ts = max(alpha.mvcc.base_ts, alpha.oracle.max_assigned,
+                  max((l.commit_ts for l in alpha.mvcc.layers), default=0))
+
+    wal_path = os.path.join(p_dir, "wal.log")
+    wal_floor = alpha.mvcc.base_ts  # records ≤ this were absorbed
+    incremental = (not force_full and series
+                   and last_ts >= wal_floor)
+    kind = "incr" if incremental else "full"
+    bdir = os.path.join(dest, f"backup-{seq:04d}-{kind}")
+    os.makedirs(bdir, exist_ok=True)
+
+    if incremental:
+        # WAL records in (last_ts, read_ts] — the delta since the chain tip
+        seg = Journal(os.path.join(bdir, "delta.log"), sync=False)
+        n = 0
+        for ts, k, obj in replay(wal_path):
+            if ts <= last_ts or ts > read_ts:
+                continue
+            if k == "mut":
+                seg.append({"ts": ts, "m": _mut_doc(obj)})
+            elif k == "schema":
+                seg.append({"ts": ts, "schema": obj})
+            else:
+                seg.append({"ts": ts, "drop": 1})
+            n += 1
+        seg.close()
+        extra = {"records": n}
+    else:
+        store = alpha.mvcc.rollup()
+        checkpoint.save(store, bdir, base_ts=read_ts)
+        extra = {"n_nodes": store.n_nodes}
+        last_ts = 0
+
+    manifest = {"type": kind, "seq": seq,
+                "since_ts": last_ts if incremental else 0,
+                "read_ts": read_ts, **extra}
+    tmp = os.path.join(bdir, MANIFEST + ".tmp")
+    with open(tmp, "w") as f:
+        json.dump(manifest, f, indent=1)
+    os.replace(tmp, os.path.join(bdir, MANIFEST))
+    if alpha.wal is not None:
+        alpha.wal.close()
+    return manifest
+
+
+def restore(dest: str, p_dir: str) -> int:
+    """Rebuild a serveable posting dir from the backup series: newest
+    full + every later incremental, in order (reference: ee restore map/
+    reduce over backup layers). Returns the restored max commit_ts."""
+    from dgraph_tpu.store.mvcc import MVCCStore
+    from dgraph_tpu.store.schema import parse_schema
+    from dgraph_tpu.store.wal import _doc_mut
+
+    series = _series(dest)
+    fulls = [m for m in series if m["type"] == "full"]
+    if not fulls:
+        raise FileNotFoundError(f"no full backup in {dest}")
+    base_m = fulls[-1]
+    chain = [m for m in series
+             if m["seq"] > base_m["seq"] and m["type"] == "incr"]
+    # the chain must be contiguous: each incr's since_ts is the previous
+    # backup's read_ts
+    prev = base_m
+    for m in chain:
+        if m["since_ts"] != prev["read_ts"]:
+            raise ValueError(
+                f"backup chain broken: seq {m['seq']} covers "
+                f"({m['since_ts']}, {m['read_ts']}] but previous read_ts "
+                f"is {prev['read_ts']}")
+        prev = m
+
+    store, base_ts = checkpoint.load(base_m["dir"])
+    mvcc = MVCCStore(base=store, base_ts=base_ts)
+    max_ts = base_ts
+    schema = None
+    for m in chain:
+        for doc in Journal.replay(os.path.join(m["dir"], "delta.log")):
+            ts = int(doc["ts"])
+            if "schema" in doc:
+                merged = (schema or mvcc.schema).clone()
+                merged.update(parse_schema(doc["schema"]))
+                schema = merged
+                mvcc.rebuild_base(schema=merged)
+            elif "drop" in doc:
+                mvcc = MVCCStore()
+                schema = None   # post-drop alters start from scratch
+            else:
+                mvcc.apply(_doc_mut(doc["m"]), ts)
+            max_ts = max(max_ts, ts)
+
+    final = mvcc.rollup() if mvcc.layers else mvcc.base
+    if os.path.isdir(p_dir):
+        shutil.rmtree(p_dir)
+    checkpoint.save_versioned(final, p_dir, base_ts=max_ts)
+    # a fresh (empty) WAL: everything restored lives in the checkpoint
+    WAL(os.path.join(p_dir, "wal.log"), sync=False).close()
+    return max_ts

@@ -117,3 +117,23 @@ def test_rebalance_moves_smallest_tablet_from_loaded_group():
     assert len(out["q"]) == 4  # 200 people, ages cycle mod 50
     for s in (s1, s2, zserver):
         s.stop(None)
+
+
+def test_rejoin_reclaims_identity_after_zero_restart(tmp_path):
+    """A journal-replayed membership must hand a rejoining address its
+    OLD node id and group, or tablets stay mapped to a ghost group
+    (code-review finding)."""
+    jp = str(tmp_path / "zero.journal")
+    z1 = ZeroState(replicas=1, journal_path=jp)
+    n1, g1 = z1.connect("127.0.0.1:7001")
+    assert z1.should_serve("name", g1) == g1
+    z1._journal.close()
+
+    z2 = ZeroState(replicas=1, journal_path=jp)   # restart
+    n1b, g1b = z2.connect("127.0.0.1:7001")       # same alpha rejoins
+    assert (n1b, g1b) == (n1, g1)
+    # its tablets still belong to it; no ghost group split
+    assert z2.should_serve("name", g1b) == g1b
+    # a genuinely new node still gets a fresh id and group
+    n2, g2 = z2.connect("127.0.0.1:7002")
+    assert n2 != n1 and g2 != g1
