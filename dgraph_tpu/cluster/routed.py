@@ -52,11 +52,19 @@ class _RoutedPreds(dict):
 
 
 def routed_view(alpha, store: Store, read_ts: int) -> Store:
-    """Wrap a local read view so foreign predicates resolve remotely."""
+    """Wrap a local read view so foreign predicates resolve remotely:
+    small-frontier hops route per-hop through the owner's ServeTask
+    (remote_expand — O(frontier+result) bytes), everything else faults in
+    the whole tablet through the preds mapping."""
     rs = object.__new__(Store)
     rs.uids = store.uids
     rs.schema = store.schema
     rs.preds = _RoutedPreds(store.preds, alpha, read_ts)
     rs._device = {}
     rs._empty_rel = store._empty_rel
+
+    def remote_expand(pred, reverse, frontier):
+        return alpha.remote_hop(pred, reverse, frontier, read_ts, rs)
+
+    rs.remote_expand = remote_expand
     return rs

@@ -75,12 +75,25 @@ class Executor:
         self.val_vars: dict[str, dict[int, object]] = {}
 
     # -- frontier expansion (the hot op) ------------------------------------
-    def expand(self, pred: str, reverse: bool, frontier: np.ndarray):
+    def expand(self, pred: str, reverse: bool, frontier: np.ndarray,
+               allow_remote: bool = True):
         """Whole-frontier CSR expansion → (neighbors, seg, edge_pos) host
         arrays. `edge_pos` indexes the CSR of the expansion direction;
         facet consumers map reverse positions through facet_positions()
         (forward-aligned) AT USE so facet-free reverse hops — the hot
-        distributed-task path — never pay for the rev→fwd table."""
+        distributed-task path — never pay for the rev→fwd table.
+
+        On a routed view, a small-frontier hop over a foreign tablet may
+        execute on the OWNER via ServeTask instead of faulting the whole
+        tablet in (reference: ProcessTaskOverNetwork); remote results
+        carry no edge positions, so callers needing facets pass
+        allow_remote=False."""
+        if allow_remote and len(frontier):
+            rem = getattr(self.store, "remote_expand", None)
+            if rem is not None:
+                out = rem(pred, reverse, frontier)
+                if out is not None:
+                    return out
         rel = self.store.rel(pred, reverse)
         if len(frontier) == 0 or rel.nnz == 0:
             return EMPTY, EMPTY, EMPTY64
@@ -419,7 +432,9 @@ class Executor:
         if fused is not None:
             nbrs, seg, pos = fused
         else:
-            nbrs, seg, pos = self.expand(sg.attr, sg.is_reverse, frontier)
+            nbrs, seg, pos = self.expand(
+                sg.attr, sg.is_reverse, frontier,
+                allow_remote=not _needs_facets(sg))
             nbrs, seg, pos = self.filter_edges(sg.filters, nbrs, seg, pos)
             nbrs, seg, pos = self.facet_filter_edges(sg, sg.attr, nbrs,
                                                      seg, pos)
@@ -601,6 +616,13 @@ class Executor:
                 if vs:
                     env[int(r)] = vs[0]
             self.val_vars[sg.var_name] = env
+
+
+def _needs_facets(sg) -> bool:
+    """Whether a block consumes edge positions (facet render/filter/order)
+    — remote per-hop results carry none."""
+    return (sg.facet_keys is not None or sg.facet_filter is not None
+            or bool(sg.facet_orders))
 
 
 def _coerce_to(want, v):

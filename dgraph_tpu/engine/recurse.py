@@ -19,6 +19,7 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
+from dgraph_tpu.engine.execute import _needs_facets
 from dgraph_tpu.engine.ir import SubGraph
 
 MAX_RECURSE_DEPTH = 64  # guard when depth: 0 (fixpoint mode)
@@ -78,7 +79,9 @@ def expand_recurse(ex, root) -> None:
         level: dict[int, tuple[np.ndarray, np.ndarray]] = {}
         new_parts = []
         for i, esg in enumerate(data.edge_sgs):
-            nbrs, seg, pos = ex.expand(esg.attr, esg.is_reverse, frontier)
+            nbrs, seg, pos = ex.expand(
+                esg.attr, esg.is_reverse, frontier,
+                allow_remote=not _needs_facets(esg))
             nbrs, seg, pos = ex.filter_edges(esg.filters, nbrs, seg, pos)
             nbrs, seg, pos = ex.facet_filter_edges(esg, esg.attr, nbrs,
                                                    seg, pos)

@@ -112,7 +112,9 @@ def _dijkstra(ex, sg, data: PathData, src: int, dst: int) -> PathData:
             break
         frontier = np.array([u], np.int32)
         for i, esg in enumerate(data.edge_sgs):
-            nbrs, seg, pos = ex.expand(esg.attr, esg.is_reverse, frontier)
+            nbrs, seg, pos = ex.expand(esg.attr, esg.is_reverse,
+                                       frontier,
+                                       allow_remote=not wkeys[i])
             nbrs, seg, pos = ex.filter_edges(esg.filters, nbrs, seg, pos)
             if not len(nbrs):
                 continue

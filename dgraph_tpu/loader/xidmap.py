@@ -26,7 +26,11 @@ class XidMap:
     def _lease(self) -> int:
         with self._pool_lock:
             if not self._pool:
-                self._pool = list(self._oracle.assign_uids(LEASE_CHUNK))
+                # reversed so pop() hands uids out ASCENDING: monotone
+                # allocation keeps ranks append-only, which downstream
+                # caches (foreign-tablet adaptation) rely on for validity
+                self._pool = list(reversed(
+                    self._oracle.assign_uids(LEASE_CHUNK)))
             return self._pool.pop()
 
     def assign(self, xid: str) -> int:

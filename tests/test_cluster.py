@@ -410,3 +410,87 @@ def test_missed_alter_recovered_via_chain():
     assert out == {"q": [{"name": "bob", "city": "basel"}]}
     for s in (sr1, sr2b, zserver):
         s.stop(None)
+
+
+def test_per_hop_remote_execution_ships_frontier_not_tablet():
+    """A small-frontier hop over a big foreign tablet routes through the
+    owner's ServeTask (O(frontier+result) bytes) instead of faulting the
+    whole tablet in (VERDICT r2 item 4; reference:
+    worker/task.go ProcessTaskOverNetwork)."""
+    import numpy as np
+
+    from dgraph_tpu.utils.metrics import METRICS
+
+    zserver, zport, state = make_zero_server()
+    zserver.start()
+    zt = f"127.0.0.1:{zport}"
+    a1, s1, _ = start_cluster_alpha(zt, device_threshold=10**9)
+    a2, s2, _ = start_cluster_alpha(zt, device_threshold=10**9)
+    zc = ZeroClient(zt)
+    zc.should_serve("name", a1.groups.gid)
+    zc.should_serve("follows", a2.groups.gid)
+    a1.alter("name: string @index(exact) .\nfollows: [uid] @reverse .")
+    # a BIG tablet on group 2: 300 nodes, ~3k follows edges
+    rng = np.random.default_rng(4)
+    lines = [f'_:n{i} <name> "n{i}" .' for i in range(300)]
+    lines += [f"_:n{i} <follows> _:n{(i * 7 + j) % 300} ."
+              for i in range(300) for j in range(10)]
+    a2.mutate(set_nquads="\n".join(lines))
+
+    t0 = METRICS.snapshot()["counters"].get("tablet_bytes_fetched", 0)
+    h0 = METRICS.snapshot()["counters"].get("taskhop_bytes_fetched", 0)
+    # 2-hop spanning query from a1 with a 1-uid frontier: follows is
+    # foreign to a1 -> per-hop remote execution
+    out = a1.query('{ q(func: eq(name, "n7")) '
+                   '{ name follows { follows { uid } } } }')
+    assert out["q"][0]["name"] == "n7"
+    assert len(out["q"][0]["follows"]) == 10
+    t1 = METRICS.snapshot()["counters"].get("tablet_bytes_fetched", 0)
+    h1 = METRICS.snapshot()["counters"].get("taskhop_bytes_fetched", 0)
+    assert t1 == t0, "whole tablet was pulled for a tiny frontier"
+    assert h1 > h0, "per-hop remote path did not run"
+    # wire bytes are frontier+result sized: far below the tablet's edges
+    assert h1 - h0 < 3000 * 8
+
+    # remote answers equal a local-pull answer (force the tablet path)
+    a1.remote_hop_max = 0
+    out2 = a1.query('{ q(func: eq(name, "n7")) '
+                    '{ name follows { follows { uid } } } }')
+    assert out == out2
+    assert METRICS.snapshot()["counters"].get(
+        "tablet_bytes_fetched", 0) > t1  # the pull really happened
+    a1.remote_hop_max = 4096
+    for s in (s1, s2, zserver):
+        s.stop(None)
+
+
+def test_tablet_cache_survives_vocab_growth():
+    """Append-only vocabulary growth must NOT evict cached foreign
+    tablets (VERDICT r2 weak #3): ranks below the fetch-time max uid are
+    stable, so the cached CSR just pads wider."""
+    from dgraph_tpu.utils.metrics import METRICS
+
+    zserver, zport, state = make_zero_server()
+    zserver.start()
+    zt = f"127.0.0.1:{zport}"
+    a1, s1, _ = start_cluster_alpha(zt, device_threshold=10**9)
+    a2, s2, _ = start_cluster_alpha(zt, device_threshold=10**9)
+    zc = ZeroClient(zt)
+    zc.should_serve("name", a1.groups.gid)
+    zc.should_serve("friend", a2.groups.gid)
+    a1.alter("name: string @index(exact) .\nfriend: [uid] .")
+    a1.mutate(set_nquads='_:a <name> "alice" .\n_:b <name> "bob" .\n'
+                         '_:a <friend> _:b .')
+    q = '{ q(func: eq(name, "alice")) { name friend { name } } }'
+    a1.remote_hop_max = 0  # force the whole-tablet path for this test
+    want = {"q": [{"name": "alice", "friend": [{"name": "bob"}]}]}
+    assert a1.query(q) == want
+    t0 = METRICS.snapshot()["counters"].get("tablet_bytes_fetched", 0)
+    # a commit touching ONLY a1's own tablet grows the vocabulary
+    a1.mutate(set_nquads='_:c <name> "carol" .')
+    assert a1.query(q) == want                    # cached copy adapted
+    t1 = METRICS.snapshot()["counters"].get("tablet_bytes_fetched", 0)
+    assert t1 == t0, "vocab growth evicted the cached tablet"
+    a1.remote_hop_max = 4096
+    for s in (s1, s2, zserver):
+        s.stop(None)
