@@ -443,7 +443,10 @@ class Executor:
                 if sg.facet_orders:
                     order_idx = self._facet_order(sg, nbrs, seg, pos)
                 else:
-                    order_idx = self.order_ranks(nbrs, sg.orders, seg=seg)
+                    order_idx = self._mesh_row_order(sg, nbrs, seg)
+                    if order_idx is None:
+                        order_idx = self.order_ranks(nbrs, sg.orders,
+                                                     seg=seg)
                 nbrs, seg = nbrs[order_idx], seg[order_idx]
                 pos = pos[order_idx] if len(pos) else pos
             # per-row pagination (seg is nondecreasing: CSR construction
@@ -477,10 +480,12 @@ class Executor:
 
     def _mesh_order_topk(self, sg: SubGraph, ranks: np.ndarray):
         """Order-by pushdown on the mesh (reference: SortOverNetwork):
-        single-key `orderasc/orderdesc` with a result cap runs as per-shard
-        top-k + on-mesh merge. Returns the ordered (truncated) display
-        list, or None → host ordering path."""
-        if (self.mesh is None or len(sg.orders) != 1 or not sg.first
+        single-key `orderasc/orderdesc` runs as per-shard top-k + on-mesh
+        merge — capped when `first` bounds the result, full-length
+        otherwise (orderdesc+offset, no-first). String keys ride a
+        rank-dictionary float column. Returns the ordered display list,
+        or None → host ordering path."""
+        if (self.mesh is None or len(sg.orders) != 1
                 or sg.first < 0 or sg.after
                 or len(ranks) < self.device_threshold):
             return None
@@ -488,9 +493,25 @@ class Executor:
         if o.is_val_var:
             return None
         from dgraph_tpu.parallel.dsort import mesh_topk
-        k = sg.first + max(sg.offset, 0)
+        k = (sg.first + max(sg.offset, 0)) if sg.first else len(ranks)
         return mesh_topk(self.mesh, self.store, o.attr, o.lang,
                          ranks, k, desc=o.desc)
+
+    def _mesh_row_order(self, sg: SubGraph, nbrs: np.ndarray,
+                        seg: np.ndarray):
+        """Child-level (per-row) order-by on the mesh: the whole edge list
+        sorts by (row, key, uid) in one SPMD program (reference:
+        worker/sort.go per-group sort + coordinator merge). None → host
+        lexsort path."""
+        if (self.mesh is None or len(sg.orders) != 1 or sg.facet_orders
+                or len(nbrs) < self.device_threshold):
+            return None
+        o = sg.orders[0]
+        if o.is_val_var:
+            return None
+        from dgraph_tpu.parallel.dsort import mesh_row_sort
+        return mesh_row_sort(self.mesh, self.store, o.attr, o.lang,
+                             nbrs, seg, desc=o.desc)
 
     def _fused_level(self, sg: SubGraph, frontier: np.ndarray):
         """Large-frontier fast path: expand → filter → paginate → dedupe

@@ -77,8 +77,12 @@ def _key_column(store, pred: str, lang: str, mesh: Mesh):
             if isinstance(first, (bool, np.bool_, int, np.integer, float,
                                   np.floating, np.datetime64)):
                 vals = np.array([_to_key(v) for v in vals], np.float64)
+            elif isinstance(first, str):
+                vals = _string_codes(np.array([str(v) for v in vals]))
             else:
                 vals = None
+        elif vals.dtype.kind == "U":
+            vals = _string_codes(vals)
         elif np.issubdtype(vals.dtype, np.datetime64):
             vals = vals.astype("datetime64[us]").astype(np.int64
                                                         ).astype(np.float64)
@@ -109,6 +113,19 @@ def _to_key(v) -> float:
     return float(v)
 
 
+def _string_codes(svals: np.ndarray) -> np.ndarray | None:
+    """Rank-dictionary encoding: dense codes of the sorted unique strings
+    order exactly like the strings, so string order-by runs on the
+    device-friendly float column (reference: worker/sort.go ships value
+    bytes; here the dictionary stays host-side, codes go to the device).
+    The device column is float32, whose mantissa holds 2^24 distinct
+    integers — larger dictionaries fall back to the host sort."""
+    uniq, codes = np.unique(svals, return_inverse=True)
+    if len(uniq) >= 1 << 24:
+        return None
+    return codes.astype(np.float64)
+
+
 def mesh_topk(mesh: Mesh, store, pred: str, lang: str, ranks: np.ndarray,
               k: int, desc: bool = False) -> np.ndarray | None:
     """Global top-k of `ranks` ordered by a value predicate, on-mesh.
@@ -126,7 +143,9 @@ def mesh_topk(mesh: Mesh, store, pred: str, lang: str, ranks: np.ndarray,
         cap <<= 1
     from dgraph_tpu import ops
     cand = ops.pad_to(np.asarray(ranks, np.int32), cap)
-    kk = min(k, cap)
+    # full-length sorts (no `first`) take kk=cap so the jitted program is
+    # shared across cardinalities within a bucket, not compiled per count
+    kk = cap if k >= len(ranks) else min(k, cap)
     top_r, top_v = _build_topk(mesh, cap, kk, rows)(keys_s, row_lo, cand)
     top_r = np.asarray(top_r)
     out = top_r[np.asarray(valid_mask_np(top_r))]
@@ -136,3 +155,59 @@ def mesh_topk(mesh: Mesh, store, pred: str, lang: str, ranks: np.ndarray,
 def valid_mask_np(a: np.ndarray) -> np.ndarray:
     from dgraph_tpu.ops.uidalgebra import SENTINEL32
     return a != SENTINEL32
+
+
+@functools.lru_cache(maxsize=64)
+def _build_row_sort(mesh: Mesh, cap: int, rows: int, desc: bool):
+    def per_device(keys_b, row_lo_b, nbrs, seg):
+        keys, row_lo = keys_b[0], row_lo_b[0]
+        local = nbrs - row_lo
+        mine = valid_mask(nbrs) & (local >= 0) & (local < rows)
+        kv = jnp.where(mine, keys[jnp.clip(local, 0, rows - 1)], 0.0)
+        # every valid rank lives on exactly ONE shard: a psum assembles
+        # the full per-edge key vector on all devices
+        kv = lax.psum(kv, SHARD_AXIS)
+        if desc:
+            kv = jnp.where(jnp.isinf(kv), kv, -kv)
+        # padded slots sort last within their (nonexistent) row
+        kv = jnp.where(valid_mask(nbrs), kv, jnp.inf)
+        seg_k = jnp.where(valid_mask(nbrs), seg, jnp.int32(2**31 - 1))
+        # priority: row, key (missing=+inf last), uid tiebreak — the host
+        # lexsort contract of Executor.order_ranks
+        return jnp.lexsort((nbrs, kv, seg_k))
+
+    fn = jax.shard_map(
+        per_device, mesh=mesh,
+        in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(), P()),
+        out_specs=P(),
+        check_vma=False,
+    )
+    return jax.jit(fn)
+
+
+def mesh_row_sort(mesh: Mesh, store, pred: str, lang: str,
+                  nbrs: np.ndarray, seg: np.ndarray,
+                  desc: bool = False) -> np.ndarray | None:
+    """Per-row (child-level) order-by on the mesh: one SPMD program sorts
+    the whole edge list by (row, key, uid) against the sharded key column
+    (reference: worker/sort.go pushed into each group, merged — here the
+    merge is the lexsort itself). Returns the permutation, or None when
+    the key column is not device-orderable."""
+    col = _key_column(store, pred, lang, mesh)
+    if col is None:
+        return None
+    keys_s, row_lo, rows = col
+    from dgraph_tpu import ops
+    cap = 64
+    while cap < len(nbrs):
+        cap <<= 1
+    # pad_to sentinel-pads (order-preserving); the device code masks
+    # padded seg slots via valid_mask(nbrs), so seg's pad value never
+    # matters
+    nb = ops.pad_to(np.asarray(nbrs, np.int32), cap)
+    sg_ = ops.pad_to(np.asarray(seg, np.int32), cap)
+    order = np.asarray(_build_row_sort(mesh, cap, rows, desc)(
+        keys_s, row_lo, nb, sg_))
+    # padded slots carry a maxint row key, so they sort strictly last:
+    # the first len(nbrs) slots are the real permutation
+    return order[:len(nbrs)]

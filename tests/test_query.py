@@ -662,6 +662,20 @@ CASES = [
       "p": [{"name": "Michonne"}, {"name": "King Lear"},
             {"name": "Margaret"}]}),
 
+    ("orderdesc_no_first", """
+     { q(func: type(Person), orderdesc: age) { age } }""",
+     {"q": [{"age": 77}, {"age": 45}, {"age": 38}, {"age": 31},
+            {"age": 12}, {"age": 5}]}),
+
+    ("child_order_string", """
+     { q(func: uid(1)) { friend (orderasc: name) { name } } }""",
+     {"q": [{"friend": [{"name": "King Lear"}, {"name": "Leonard"},
+                        {"name": "Margaret"}]}]}),
+
+    ("order_string_offset_desc", """
+     { q(func: type(Film), orderdesc: name, offset: 1) { name } }""",
+     {"q": [{"name": "Blade Trinity"}, {"name": "Blade Runner"}]}),
+
     ("groupby_minmax_empty_group", """
      { var(func: uid(100)) { a as name }
        q(func: type(Person)) @groupby(alive) { min(val(a)) } }""",
