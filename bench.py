@@ -50,7 +50,7 @@ N_NODES = 1 << 20          # ~1M nodes
 AVG_DEG = 16.0             # ~16M directed edges
 DEPTH = 4
 SEEDS_PER_QUERY = 4
-B_DEV = 2048               # device lanes (64 uint32 words per row)
+B_DEV = 4096               # device lanes (128 uint32 words per row)
 B_CPU_FALLBACK = 256       # smaller batch for the XLA-CPU fallback child
 SMALL_N = 1 << 16          # stage1 graph
 DEV_REPS = 4

@@ -42,6 +42,14 @@ def cmd_alpha(args) -> int:
     # a crash is recovered (reference: badger open + raft WAL restore)
     alpha = Alpha.open(cfg.p_dir, device_threshold=cfg.device_threshold,
                        mesh=mesh)
+    if args.acl_secret_file:
+        # ACL enforcement (reference: ee/acl --acl_secret_file): groot
+        # bootstrap + token-gated endpoints
+        from dgraph_tpu.server.acl import AclManager
+        secret = open(args.acl_secret_file).read().strip()
+        alpha.acl = AclManager(alpha, secret)
+        alpha.acl.ensure_groot()
+        log.info("ACL enforcement enabled")
     log.info("opened %s: %d nodes", cfg.p_dir, alpha.mvcc.base.n_nodes)
 
     grpc_server, grpc_port = make_server(
@@ -238,6 +246,8 @@ def main(argv=None) -> int:
     p.add_argument("--mesh-devices", type=int, default=None,
                    dest="mesh_devices",
                    help="SPMD engine over N devices (-1 = all, 0 = off)")
+    p.add_argument("--acl_secret_file", default=None,
+                   help="enable ACL; file holds the token-signing secret")
     p.add_argument("--zero", default=None,
                    help="zero address → join a cluster")
     p.add_argument("--group", type=int, default=0,

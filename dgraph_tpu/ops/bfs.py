@@ -217,23 +217,47 @@ def _ell_hop(ells, frontier, W):
     return jnp.concatenate(parts, axis=0)
 
 
+COUNT_BLK = 1 << 15   # edge-counter node-block rows (bounds unpack memory)
+
+
 def make_ell_recurse(ells, outdeg, n: int, W: int, count_edges: bool = True):
     """Compile a depth-parameterised loop=false @recurse over an EllGraph
     already resident on device. Returns fn(mask0, depth) →
     (last[n+1,W], seen[n+1,W], edges[B] int32)."""
+    nblk = -(-n // COUNT_BLK)
+    n_pad = nblk * COUNT_BLK
+    if count_edges:
+        outdeg_pad = jnp.concatenate(
+            [jnp.asarray(outdeg),
+             jnp.zeros((n_pad - n,), jnp.float32)])
+
+    def _count(frontier, edges):
+        # per-query frontier out-degree mass: unpack the packed lanes and
+        # matvec on the MXU (f32 exact to 2^24 per hop per query; int32
+        # accumulator exact to 2^31). Blocked over node rows — a whole-
+        # array unpack materialises n*W*32 floats and blows HBM at wide B.
+        fpad = jnp.concatenate(
+            [frontier[:n], jnp.zeros((n_pad - n, W), jnp.uint32)])
+
+        def body(i, acc):
+            sl = lax.dynamic_slice_in_dim(fpad, i * COUNT_BLK,
+                                          COUNT_BLK, 0)
+            od = lax.dynamic_slice_in_dim(outdeg_pad, i * COUNT_BLK,
+                                          COUNT_BLK, 0)
+            bits = ((sl[:, :, None] >> jnp.arange(32, dtype=jnp.uint32))
+                    & 1).astype(jnp.float32).reshape(COUNT_BLK, W * 32)
+            return acc + od @ bits
+
+        hop_edges = lax.fori_loop(
+            0, nblk, body, jnp.zeros((W * 32,), jnp.float32))
+        return edges + hop_edges.astype(jnp.int32)
 
     @functools.partial(jax.jit, static_argnames=("depth",))
     def recurse(mask0, depth: int):
         def hop(carry, _):
             frontier, seen, edges = carry
             if count_edges:
-                # per-query frontier out-degree mass: unpack the packed
-                # lanes and take one MXU matvec (f32 exact to 2^24 per
-                # hop per query; int32 accumulator exact to 2^31)
-                bits = ((frontier[:n, :, None]
-                         >> jnp.arange(32, dtype=jnp.uint32)) & 1
-                        ).astype(jnp.float32).reshape(n, W * 32)
-                edges = edges + (outdeg @ bits).astype(jnp.int32)
+                edges = _count(frontier, edges)
             nxt = _ell_hop(ells, frontier, W)
             fresh = nxt & ~seen
             seen = seen | fresh

@@ -1,0 +1,257 @@
+"""Access control lists: users, groups, predicate permissions, login.
+
+Reference parity: `ee/acl` (SURVEY §2.5) — ACL state lives IN the graph
+itself under reserved predicates (`dgraph.xid`, `dgraph.password`,
+`dgraph.user.group`, `dgraph.rule.predicate`, `dgraph.rule.permission`),
+a `groot` superuser in the `guardians` group is bootstrapped on first
+start, login returns a signed access token, and enforcement hides
+unreadable predicates from queries / refuses unwritable mutations.
+
+Permissions are a bitmask per (group, predicate): READ=4, WRITE=2,
+MODIFY=1 (the reference's values). Guardians bypass all checks. Tokens
+are HMAC-SHA256-signed JSON (userid + expiry) — the role the reference's
+JWTs play, without a JWT dependency.
+
+Enforcement is store-level: an unreadable predicate simply does not
+exist in the user's view (reference: query rewriting drops unauthorized
+predicates rather than erroring), so every engine path — filters,
+expand, recurse — inherits the policy.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import json
+import os
+import re
+import time
+
+READ, WRITE, MODIFY = 4, 2, 1
+GROOT, GUARDIANS = "groot", "guardians"
+RESERVED = ("dgraph.xid", "dgraph.password", "dgraph.user.group",
+            "dgraph.rule.predicate", "dgraph.rule.permission",
+            "dgraph.acl.rule")
+ACL_SCHEMA = """
+dgraph.xid: string @index(exact) @upsert .
+dgraph.password: string .
+dgraph.user.group: [uid] @reverse .
+dgraph.acl.rule: [uid] .
+dgraph.rule.predicate: string .
+dgraph.rule.permission: int .
+"""
+TOKEN_TTL_S = 3600.0
+
+
+class AclError(PermissionError):
+    pass
+
+
+_USERID_RE = re.compile(r"^[A-Za-z0-9_.-]{1,64}$")
+
+
+def _check_userid(userid: str) -> str:
+    """User ids are spliced into DQL lookups — a strict charset is the
+    injection guard (reference: xid validation)."""
+    if not _USERID_RE.match(userid or ""):
+        raise AclError(f"invalid userid {userid!r}")
+    return userid
+
+
+def _hash_password(password: str, salt: bytes | None = None) -> str:
+    salt = salt or os.urandom(16)
+    dk = hashlib.scrypt(password.encode(), salt=salt, n=2**14, r=8, p=1)
+    return base64.b64encode(salt).decode() + "$" + \
+        base64.b64encode(dk).decode()
+
+
+def _check_password(password: str, stored: str) -> bool:
+    try:
+        salt_b64, dk_b64 = stored.split("$", 1)
+        salt = base64.b64decode(salt_b64)
+        dk = hashlib.scrypt(password.encode(), salt=salt,
+                            n=2**14, r=8, p=1)
+        return hmac.compare_digest(dk, base64.b64decode(dk_b64))
+    except Exception:  # noqa: BLE001 — malformed hash = no access
+        return False
+
+
+class AclManager:
+    """Login + enforcement against ACL state stored in the graph."""
+
+    def __init__(self, alpha, secret: str):
+        self.alpha = alpha
+        self.secret = secret.encode()
+        self._perm_cache: tuple[int, dict] | None = None
+
+    # -- bootstrap -----------------------------------------------------------
+    def ensure_groot(self, password: str = "password") -> None:
+        """First-start bootstrap: groot user in the guardians group
+        (reference: ee/acl ResetAcl)."""
+        self.alpha.alter(ACL_SCHEMA)
+        out = self._query(
+            '{ q(func: eq(dgraph.xid, "%s")) { uid } }' % GROOT)
+        if out["q"]:
+            return
+        self.alpha.mutate(set_nquads=f'''
+            _:g <dgraph.xid> "{GUARDIANS}" .
+            _:u <dgraph.xid> "{GROOT}" .
+            _:u <dgraph.password> "{_hash_password(password)}" .
+            _:u <dgraph.user.group> _:g .
+        ''')
+
+    def _query(self, q: str) -> dict:
+        # internal reads bypass enforcement (the manager IS the authority)
+        return self.alpha.query(q)
+
+    # -- login / tokens -------------------------------------------------------
+    def login(self, userid: str, password: str) -> str:
+        userid = _check_userid(userid)
+        out = self._query(
+            '{ q(func: eq(dgraph.xid, "%s")) { dgraph.password } }'
+            % userid)
+        rows = [r for r in out["q"] if "dgraph.password" in r]
+        if not rows or not _check_password(password,
+                                           rows[0]["dgraph.password"]):
+            raise AclError("invalid credentials")
+        doc = json.dumps({"u": userid,
+                          "exp": time.time() + TOKEN_TTL_S},
+                         separators=(",", ":")).encode()
+        sig = hmac.new(self.secret, doc, hashlib.sha256).digest()
+        return (base64.urlsafe_b64encode(doc).decode() + "." +
+                base64.urlsafe_b64encode(sig).decode())
+
+    def verify(self, token: str | None) -> str:
+        if not token:
+            raise AclError("no access token")
+        try:
+            doc_b64, sig_b64 = token.split(".", 1)
+            doc = base64.urlsafe_b64decode(doc_b64)
+            sig = base64.urlsafe_b64decode(sig_b64)
+        except Exception:  # noqa: BLE001
+            raise AclError("malformed access token") from None
+        want = hmac.new(self.secret, doc, hashlib.sha256).digest()
+        if not hmac.compare_digest(sig, want):
+            raise AclError("bad token signature")
+        payload = json.loads(doc)
+        if payload["exp"] < time.time():
+            raise AclError("token expired")
+        return _check_userid(payload["u"])
+
+    # -- permissions ----------------------------------------------------------
+    def perms_for(self, userid: str):
+        """(is_guardian, {pred: bitmask}) for a user — union over their
+        groups' rules. Cached per committed version."""
+        userid = _check_userid(userid)
+        ver = self.alpha.oracle.max_assigned
+        if self._perm_cache is not None and self._perm_cache[0] == ver:
+            cached = self._perm_cache[1].get(userid)
+            if cached is not None:
+                return cached
+        out = self._query('''
+        { q(func: eq(dgraph.xid, "%s")) {
+            dgraph.user.group {
+              dgraph.xid
+              dgraph.acl.rule {
+                dgraph.rule.predicate dgraph.rule.permission } } } }'''
+                          % userid)
+        guardian = False
+        perms: dict[str, int] = {}
+        for user in out["q"]:
+            for grp in user.get("dgraph.user.group", []):
+                if grp.get("dgraph.xid") == GUARDIANS:
+                    guardian = True
+                for rule in grp.get("dgraph.acl.rule", []):
+                    p = rule.get("dgraph.rule.predicate")
+                    m = rule.get("dgraph.rule.permission", 0)
+                    if p:
+                        perms[p] = perms.get(p, 0) | int(m)
+        result = (guardian, perms)
+        if self._perm_cache is None or self._perm_cache[0] != ver:
+            self._perm_cache = (ver, {})
+        self._perm_cache[1][userid] = result
+        return result
+
+    # -- enforcement ----------------------------------------------------------
+    def check_alter(self, userid: str) -> None:
+        guardian, _ = self.perms_for(userid)
+        if not guardian:
+            raise AclError(f"{userid!r} is not a guardian: alter denied")
+
+    def check_mutation(self, userid: str, preds) -> None:
+        guardian, perms = self.perms_for(userid)
+        if guardian:
+            return
+        for p in preds:
+            if p == "dgraph.type":
+                continue  # typed nodes are writable by any user (ref)
+            if p.startswith("dgraph."):
+                raise AclError(f"reserved predicate {p!r}: denied")
+            if not perms.get(p, 0) & WRITE:
+                raise AclError(f"no write permission on {p!r}")
+
+    def readable_view(self, userid: str, store):
+        """Store view hiding unreadable predicates (reference: unauth
+        predicates are dropped from the query, not errored)."""
+        guardian, perms = self.perms_for(userid)
+        if guardian:
+            return store
+        allowed = {p for p, m in perms.items() if m & READ}
+
+        from dgraph_tpu.store.store import Store
+        rs = object.__new__(Store)
+        rs.uids = store.uids
+        rs.schema = store.schema
+        rs.preds = _AclPreds(store.preds, allowed)
+        # allowed preds are the SAME objects as the underlying store's, so
+        # device/sort-key caches are shared — an ACL view must not
+        # re-upload the working set per query
+        rs._device = store._device
+        rs._empty_rel = store._empty_rel
+        for attr in ("_key_cols", "_key_cols_mesh"):
+            if hasattr(store, attr):
+                setattr(rs, attr, getattr(store, attr))
+        rem = getattr(store, "remote_expand", None)
+        if rem is not None:
+            def remote_expand(pred, reverse, frontier):
+                if pred not in allowed:
+                    return None
+                return rem(pred, reverse, frontier)
+            rs.remote_expand = remote_expand
+        return rs
+
+
+class _AclPreds(dict):
+    def __init__(self, inner, allowed):
+        super().__init__()
+        self._inner = inner
+        self._allowed = allowed
+
+    def _ok(self, pred) -> bool:
+        if pred == "dgraph.type":
+            return True  # type membership is readable by any user (ref)
+        return pred in self._allowed and not str(pred).startswith("dgraph.")
+
+    def get(self, pred, default=None):
+        if not self._ok(pred):
+            return default
+        return self._inner.get(pred, default)
+
+    def __getitem__(self, pred):
+        out = self.get(pred)
+        if out is None:
+            raise KeyError(pred)
+        return out
+
+    def __contains__(self, pred):
+        return self.get(pred) is not None
+
+    def __iter__(self):
+        return (p for p in self._inner if self._ok(p))
+
+    def keys(self):
+        return [p for p in self._inner if self._ok(p)]
+
+    def items(self):
+        return [(p, v) for p, v in self._inner.items() if self._ok(p)]

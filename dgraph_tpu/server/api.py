@@ -66,6 +66,7 @@ class Alpha:
         # absorbed by a checkpoint); FetchLog answers "complete" only above
         self._wal_floor = base_ts
         self.remote_hop_max = 4096  # frontier cap for per-hop routing
+        self.acl = None  # server/acl.AclManager | None (enforcement on)
         self._apply_lock = threading.Lock()
         self._state_lock = threading.Lock()
         self._open_txns: dict[int, Txn] = {}
@@ -190,14 +191,19 @@ class Alpha:
                     del self._active_reads[ts]
 
     def query(self, dql: str, variables: dict | None = None,
-              read_ts: int | None = None) -> dict:
+              read_ts: int | None = None,
+              acl_user: str | None = None) -> dict:
         """Read-only query at a snapshot (reference: Server.Query with
-        best-effort/read-only txn)."""
+        best-effort/read-only txn). With ACL enabled and an acl_user,
+        unreadable predicates are invisible (reference: query rewriting
+        drops unauthorized predicates)."""
         with self._reading(read_ts) as ts:
             store = self.mvcc.read_view(ts)
             if self.groups is not None:
                 from dgraph_tpu.cluster.routed import routed_view
                 store = routed_view(self, store, ts)
+            if self.acl is not None and acl_user is not None:
+                store = self.acl.readable_view(acl_user, store)
             out = Engine(store, device_threshold=self.device_threshold,
                          mesh=self.mesh).query(dql, variables)
         self._maybe_gc()
@@ -207,7 +213,8 @@ class Alpha:
                del_nquads: str | None = None,
                set_json=None, del_json=None,
                commit_now: bool = True,
-               start_ts: int | None = None) -> dict:
+               start_ts: int | None = None,
+               acl_user: str | None = None) -> dict:
         """Mutation RPC. With start_ts: continue that open txn. With
         commit_now=False: leave the txn open and return its start_ts
         (reference: Server.Mutate + CommitNow flag)."""
@@ -216,12 +223,22 @@ class Alpha:
         try:
             uids = txn.mutate(set_nquads=set_nquads, del_nquads=del_nquads,
                               set_json=set_json, del_json=del_json)
+            if self.acl is not None and acl_user is not None:
+                m = txn.mutation
+                touched = {e[1] for e in (m.edge_sets + m.edge_dels
+                                          + m.val_sets + m.val_dels)}
+                self.acl.check_mutation(acl_user, touched)
             if commit_now:
                 txn.commit()
             return {"uids": uids,
                     "txn": {"start_ts": txn.start_ts,
                             "commit_ts": txn.commit_ts}}
         except TxnAborted:
+            txn.discard()
+            raise
+        except PermissionError:
+            # an ACL denial leaves forbidden edits in the buffer — the
+            # whole txn dies, continued or not
             txn.discard()
             raise
         except Exception:
@@ -232,7 +249,8 @@ class Alpha:
                 txn.discard()
             raise
 
-    def _bind_upsert_vars(self, txn: "Txn", query_src: str):
+    def _bind_upsert_vars(self, txn: "Txn", query_src: str,
+                          acl_user: str | None = None):
         """Run the upsert's query at the txn's read snapshot and convert
         the executor's rank-space var bindings to uid space."""
         import numpy as np
@@ -242,6 +260,8 @@ class Alpha:
             if self.groups is not None:
                 from dgraph_tpu.cluster.routed import routed_view
                 store = routed_view(self, store, ts)
+            if self.acl is not None and acl_user is not None:
+                store = self.acl.readable_view(acl_user, store)
             out, ex = Engine(
                 store, device_threshold=self.device_threshold,
                 mesh=self.mesh).query_with_vars(query_src)
@@ -257,6 +277,16 @@ class Alpha:
         for n, env in val_vars.items():
             counts.setdefault(n, len(env))
         return out, uid_vars, val_vars, counts
+
+    def _check_txn_acl(self, txn: "Txn", acl_user: str | None) -> None:
+        """Write-permission check over everything buffered in a txn (the
+        upsert paths route here; plain mutations check inline)."""
+        if self.acl is None or acl_user is None:
+            return
+        m = txn.mutation
+        touched = {e[1] for e in (m.edge_sets + m.edge_dels
+                                  + m.val_sets + m.val_dels)}
+        self.acl.check_mutation(acl_user, touched)
 
     def _run_upsert(self, commit_now: bool, start_ts: int | None,
                     run) -> dict:
@@ -281,7 +311,8 @@ class Alpha:
             raise
 
     def upsert(self, src: str, commit_now: bool = True,
-               start_ts: int | None = None) -> dict:
+               start_ts: int | None = None,
+               acl_user: str | None = None) -> dict:
         """Upsert block: run the query at the txn's read_ts, bind vars,
         evaluate @if conditions, substitute uid(v)/val(v) into the
         mutations, commit through the normal conflict path (reference:
@@ -293,7 +324,7 @@ class Alpha:
 
         def run(txn):
             out, uid_vars, val_vars, counts = self._bind_upsert_vars(
-                txn, req.query_src)
+                txn, req.query_src, acl_user)
             uids: dict[str, str] = {}
             applied = 0
             for m in req.mutations:
@@ -305,13 +336,15 @@ class Alpha:
                     uids.update(txn.mutate(set_nquads=set_rdf or None,
                                            del_nquads=del_rdf or None))
                     applied += 1
+            self._check_txn_acl(txn, acl_user)
             return out, uids, applied
 
         return self._run_upsert(commit_now, start_ts, run)
 
     def upsert_json(self, query: str, cond: str = "",
                     set_json=None, del_json=None, commit_now: bool = True,
-                    start_ts: int | None = None) -> dict:
+                    start_ts: int | None = None,
+                    acl_user: str | None = None) -> dict:
         """The HTTP JSON upsert form: {"query", "cond", "set"/"delete" as
         JSON mutation lists with uid(v)/val(v) references} (reference:
         Dgraph HTTP /mutate JSON upsert)."""
@@ -327,7 +360,7 @@ class Alpha:
 
         def run(txn):
             out, uid_vars, val_vars, counts = self._bind_upsert_vars(
-                txn, query)
+                txn, query, acl_user)
             uids: dict[str, str] = {}
             applied = 0
             if eval_cond(cond_tree, counts):
@@ -339,6 +372,7 @@ class Alpha:
                     uids.update(txn.mutate(set_json=set_sub or None,
                                            del_json=del_sub or None))
                     applied += 1
+            self._check_txn_acl(txn, acl_user)
             return out, uids, applied
 
         return self._run_upsert(commit_now, start_ts, run)

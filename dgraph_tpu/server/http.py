@@ -60,9 +60,29 @@ def make_http_server(alpha: Alpha, addr: str = "127.0.0.1",
             else:
                 self._send(404, {"errors": [{"message": "not found"}]})
 
+        def _acl_user(self):
+            """Resolve the access token when ACL is on (reference: the
+            accessJwt header gate on every endpoint)."""
+            if alpha.acl is None:
+                return None
+            token = (self.headers.get("X-Dgraph-AccessToken")
+                     or self.headers.get("X-Dgraph-AccessJWT"))
+            return alpha.acl.verify(token)
+
         def do_POST(self):
             t0 = time.perf_counter()
             try:
+                if self.path.startswith("/login"):
+                    req = json.loads(self._body().decode())
+                    if alpha.acl is None:
+                        self._send(400, {"errors": [
+                            {"message": "ACL is not enabled"}]})
+                        return
+                    token = alpha.acl.login(req.get("userid", ""),
+                                            req.get("password", ""))
+                    self._send(200, {"data": {"accessJWT": token}})
+                    return
+                acl_user = self._acl_user()
                 if self.path.startswith("/query"):
                     body = self._body().decode()
                     if "application/json" in (
@@ -71,7 +91,7 @@ def make_http_server(alpha: Alpha, addr: str = "127.0.0.1",
                         q, variables = req["query"], req.get("variables")
                     else:
                         q, variables = body, None
-                    out = alpha.query(q, variables)
+                    out = alpha.query(q, variables, acl_user=acl_user)
                     METRICS.observe("query_latency_us",
                                     (time.perf_counter() - t0) * 1e6)
                     self._send(200, {
@@ -108,28 +128,33 @@ def make_http_server(alpha: Alpha, addr: str = "127.0.0.1",
                                        % (req["query"],
                                           req.get("cond", ""),
                                           "\n".join(parts)))
-                                res = alpha.upsert(src, commit_now=cn,
-                                                   start_ts=start_ts)
+                                res = alpha.upsert(
+                                    src, commit_now=cn,
+                                    start_ts=start_ts,
+                                    acl_user=acl_user)
                             else:
                                 res = alpha.upsert_json(
                                     req["query"], req.get("cond", ""),
                                     set_json=req.get("set"),
                                     del_json=req.get("delete"),
-                                    commit_now=cn, start_ts=start_ts)
+                                    commit_now=cn, start_ts=start_ts,
+                                    acl_user=acl_user)
                         else:
                             res = alpha.mutate(
                                 set_json=req.get("set"),
                                 del_json=req.get("delete"),
                                 commit_now=(commit_now or
                                             req.get("commitNow", False)),
-                                start_ts=start_ts)
+                                start_ts=start_ts, acl_user=acl_user)
                     elif _is_upsert(body):
                         res = alpha.upsert(body, commit_now=commit_now,
-                                           start_ts=start_ts)
+                                           start_ts=start_ts,
+                                           acl_user=acl_user)
                     else:
                         res = alpha.mutate(set_nquads=body,
                                            commit_now=commit_now,
-                                           start_ts=start_ts)
+                                           start_ts=start_ts,
+                                           acl_user=acl_user)
                     self._send(200, {"data": res})
                 elif self.path.startswith("/commit"):
                     qs = self.path.partition("?")[2]
@@ -148,6 +173,8 @@ def make_http_server(alpha: Alpha, addr: str = "127.0.0.1",
                     self._send(200, {"data": {
                         "code": "Success", "commit_ts": cts}})
                 elif self.path.startswith("/alter"):
+                    if alpha.acl is not None:
+                        alpha.acl.check_alter(acl_user)
                     body = self._body().decode()
                     if body.strip().startswith("{"):
                         op = json.loads(body)
@@ -163,6 +190,9 @@ def make_http_server(alpha: Alpha, addr: str = "127.0.0.1",
             except TxnAborted as e:
                 self._send(409, {"errors": [{"message": str(e),
                                              "code": "Aborted"}]})
+            except PermissionError as e:
+                self._send(401, {"errors": [{"message": str(e),
+                                             "code": "Unauthorized"}]})
             except Exception as e:  # surface parse/exec errors as the
                 # reference does: 200-with-errors JSON is api-breaking,
                 # use 400 + errors list

@@ -33,18 +33,34 @@ class DgraphService:
     def __init__(self, alpha: Alpha):
         self.alpha = alpha
 
+    def _acl_user(self, ctx):
+        """Token gate for the public service when ACL is on (reference:
+        the accessJwt gRPC metadata every dgo client attaches). The
+        WORKER service stays cluster-internal — peers authenticate by
+        network placement, as the reference's worker port does."""
+        if self.alpha.acl is None:
+            return None
+        md = {k.lower(): v for k, v in (ctx.invocation_metadata() or ())}
+        token = md.get("accessjwt") or md.get("x-dgraph-accesstoken")
+        try:
+            return self.alpha.acl.verify(token)
+        except PermissionError as e:
+            ctx.abort(grpc.StatusCode.UNAUTHENTICATED, str(e))
+
     def Query(self, req: pb.Request, ctx) -> pb.Response:
         import json
         t0 = time.perf_counter()
+        acl_user = self._acl_user(ctx)
         start_ts = req.start_ts or None
         out = self.alpha.query(req.query, dict(req.vars) or None,
-                               read_ts=start_ts)
+                               read_ts=start_ts, acl_user=acl_user)
         return pb.Response(
             json=json.dumps(out).encode(),
             txn=pb.TxnContext(start_ts=start_ts or 0),
             latency_us=int((time.perf_counter() - t0) * 1e6))
 
     def Mutate(self, req: pb.MutationReq, ctx) -> pb.MutationResp:
+        acl_user = self._acl_user(ctx)
         try:
             res = self.alpha.mutate(
                 set_nquads=req.set_nquads or None,
@@ -52,9 +68,12 @@ class DgraphService:
                 set_json=req.set_json or None,
                 del_json=req.del_json or None,
                 commit_now=req.commit_now,
-                start_ts=req.start_ts or None)
+                start_ts=req.start_ts or None,
+                acl_user=acl_user)
         except TxnAborted as e:
             ctx.abort(grpc.StatusCode.ABORTED, str(e))
+        except PermissionError as e:
+            ctx.abort(grpc.StatusCode.PERMISSION_DENIED, str(e))
         return pb.MutationResp(
             uids=res["uids"],
             txn=pb.TxnContext(start_ts=res["txn"]["start_ts"],
@@ -70,6 +89,12 @@ class DgraphService:
                              aborted=req.aborted)
 
     def Alter(self, req: pb.Operation, ctx) -> pb.Payload:
+        acl_user = self._acl_user(ctx)
+        if self.alpha.acl is not None:
+            try:
+                self.alpha.acl.check_alter(acl_user)
+            except PermissionError as e:
+                ctx.abort(grpc.StatusCode.PERMISSION_DENIED, str(e))
         if req.drop_all:
             self.alpha.drop_all()
         elif req.schema:
