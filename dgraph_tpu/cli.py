@@ -32,8 +32,15 @@ def cmd_alpha(args) -> int:
     mesh = None
     if cfg.mesh_devices:
         # SPMD serving: the query engine runs its hops sharded over the
-        # device mesh (reference: the sidecar seam, SURVEY §3.1)
-        from dgraph_tpu.parallel.mesh import make_mesh
+        # device mesh (reference: the sidecar seam, SURVEY §3.1). With a
+        # coordinator (flag or JAX_COORDINATOR_ADDRESS env) the mesh
+        # spans HOSTS: jax.distributed joins the processes over DCN and
+        # jax.devices() below covers every host's chips.
+        from dgraph_tpu.parallel.mesh import init_distributed, make_mesh
+        if init_distributed(args.jax_coordinator):
+            import jax as _jax
+            log.info("multi-host runtime: process %d/%d",
+                     _jax.process_index(), _jax.process_count())
         mesh = make_mesh(None if cfg.mesh_devices < 0
                          else cfg.mesh_devices)
         log.info("device mesh: %d devices", mesh.devices.size)
@@ -248,6 +255,12 @@ def main(argv=None) -> int:
                    help="SPMD engine over N devices (-1 = all, 0 = off)")
     p.add_argument("--acl_secret_file", default=None,
                    help="enable ACL; file holds the token-signing secret")
+    p.add_argument("--jax-coordinator", default=None,
+                   dest="jax_coordinator",
+                   help="host:port of the jax.distributed coordinator "
+                        "(multi-host mesh over DCN); env trio "
+                        "JAX_COORDINATOR_ADDRESS/JAX_NUM_PROCESSES/"
+                        "JAX_PROCESS_ID also works")
     p.add_argument("--zero", default=None,
                    help="zero address → join a cluster")
     p.add_argument("--group", type=int, default=0,

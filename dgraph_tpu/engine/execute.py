@@ -131,23 +131,19 @@ class Executor:
         return _bucket(max(int(per_shard.max()), 1))
 
     @staticmethod
-    def _reassemble_shards(srel, nbrs_s, seg_s, pos_s, counts):
-        """Stitch per-shard edge slots back into one global edge matrix.
-        Each frontier row lives on exactly one shard, so a stable sort by
-        seg recovers global CSR row order; pos is shard-local and offsets
-        by pos_lo into the absolute facet position space."""
-        nbrs_s, seg_s, pos_s = (np.asarray(nbrs_s), np.asarray(seg_s),
-                                np.asarray(pos_s))
-        counts = np.asarray(counts)
+    def _stitch_edge_parts(parts):
+        """Stitch per-shard edge slices into one global edge matrix:
+        each frontier row's edges come from exactly one slice, so a
+        stable sort by seg recovers global CSR row order. `parts` yields
+        (nbrs, seg, local_pos, pos_lo) — pos offsets into the absolute
+        facet position space."""
         parts_n, parts_s, parts_p = [], [], []
-        for d in range(srel.n_shards):
-            t = int(counts[d])
-            if not t:
+        for nbrs, seg, pos, pos_lo in parts:
+            if not len(nbrs):
                 continue
-            parts_n.append(nbrs_s[d, :t])
-            parts_s.append(seg_s[d, :t])
-            parts_p.append(pos_s[d, :t].astype(np.int64)
-                           + int(srel.pos_lo[d]))
+            parts_n.append(nbrs)
+            parts_s.append(seg)
+            parts_p.append(pos.astype(np.int64) + int(pos_lo))
         if not parts_n:
             return EMPTY, EMPTY, EMPTY64
         nbrs = np.concatenate(parts_n)
@@ -156,13 +152,31 @@ class Executor:
         order = np.argsort(seg, kind="stable")
         return nbrs[order], seg[order], pos[order]
 
+    @classmethod
+    def _reassemble_shards(cls, srel, nbrs_s, seg_s, pos_s, counts):
+        nbrs_s, seg_s, pos_s = (np.asarray(nbrs_s), np.asarray(seg_s),
+                                np.asarray(pos_s))
+        counts = np.asarray(counts)
+        return cls._stitch_edge_parts(
+            (nbrs_s[d, :int(counts[d])], seg_s[d, :int(counts[d])],
+             pos_s[d, :int(counts[d])], srel.pos_lo[d])
+            for d in range(srel.n_shards))
+
+    # frontiers above this replicate poorly: shard them and ring-rotate
+    # over ICI instead (the long-context analog, SURVEY §5). Tests lower
+    # it to force the ring path on small fixtures.
+    ring_threshold = 1 << 17
+
     def _expand_mesh(self, pred: str, reverse: bool, frontier: np.ndarray):
         """SPMD expansion over the device mesh: every device expands the
         row slab it owns, outputs stay sharded, the host reassembles the
         edge matrix (reference: ProcessTaskOverNetwork scatter/gather —
-        SURVEY §3.1 — with gRPC replaced by residency + one shard_map)."""
+        SURVEY §3.1 — with gRPC replaced by residency + one shard_map).
+        Frontiers past ring_threshold ride the sharded ring path."""
         from dgraph_tpu.parallel.dhop import matrix_hop
 
+        if len(frontier) > self.ring_threshold:
+            return self._expand_mesh_ring(pred, reverse, frontier)
         srel = self.store.sharded_rel(pred, reverse, self.mesh)
         fr = ops.pad_to(frontier, _bucket(len(frontier)))
         deg = self.store.rel(pred, reverse).degree(frontier)
@@ -171,6 +185,42 @@ class Executor:
             self.mesh, srel, fr, edge_cap)
         assert int(max_shard) <= edge_cap, (int(max_shard), edge_cap)
         return self._reassemble_shards(srel, nbrs_s, seg_s, pos_s, totals)
+
+    def _expand_mesh_ring(self, pred: str, reverse: bool,
+                          frontier: np.ndarray):
+        """Sharded-frontier expansion: chunks rotate ring-wise (ppermute)
+        while each device expands against its resident row slab — the
+        engine route for frontiers too large to replicate (SURVEY §5
+        long-context analog; structural cousin of ring attention)."""
+        from dgraph_tpu.parallel.dhop import ring_matrix_hop
+        from dgraph_tpu.parallel.pshard import shard_frontier
+
+        srel = self.store.sharded_rel(pred, reverse, self.mesh)
+        d = srel.n_shards
+        per = -(-len(frontier) // d)
+        f_cap = _bucket(max(per, 1))
+        chunks = shard_frontier(frontier, d, f_cap)
+        # per (origin chunk × shard) edge cap: a chunk meets every slab
+        deg = self.store.rel(pred, reverse).degree(frontier)
+        rows_per = srel.rows_per_shard
+        shard_of = np.minimum(frontier // rows_per, d - 1)
+        chunk_of = np.minimum(np.arange(len(frontier)) // per, d - 1)
+        per_pair = np.zeros((d, d))
+        np.add.at(per_pair, (chunk_of, shard_of), deg)
+        edge_cap = _bucket(max(int(per_pair.max()), 1))
+        nbrs_a, seg_a, pos_a, totals, max_e = ring_matrix_hop(
+            self.mesh, srel, chunks, edge_cap)
+        assert int(max_e) <= edge_cap, (int(max_e), edge_cap)
+        nbrs_a, seg_a, pos_a = (np.asarray(nbrs_a), np.asarray(seg_a),
+                                np.asarray(pos_a))
+        totals = np.asarray(totals)
+        nbrs, seg, pos = self._stitch_edge_parts(
+            (nbrs_a[dev, i, :int(totals[dev, i])],
+             seg_a[dev, i, :int(totals[dev, i])] + ((dev - i) % d) * per,
+             pos_a[dev, i, :int(totals[dev, i])], srel.pos_lo[dev])
+            for dev in range(d) for i in range(d))
+        keep = seg < len(frontier)  # drop chunk padding rows
+        return nbrs[keep], seg[keep], pos[keep]
 
     def _expand_device(self, pred: str, reverse: bool, frontier: np.ndarray):
         indptr, indices = self.store.device_rel(pred, reverse)

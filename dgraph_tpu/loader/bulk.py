@@ -1,18 +1,18 @@
 """Bulk loader: offline map-reduce RDF → checkpointed Store snapshot.
 
-Reference parity: `dgraph/cmd/bulk/` — mappers shard parsed N-Quads,
-reducers sort per predicate and write Badger SSTs, output directory is the
-initial data checkpoint Alphas boot from. TPU-first shape: the reduce
-output is CSR blocks + columnar values (what HBM wants), written via
-`store.checkpoint.save`; map parallelism is a thread pool over input
-chunks (numpy releases the GIL on the hot sorts).
+Reference parity: `dgraph/cmd/bulk/` — N mapper PROCESSES shard-parse
+N-Quads (the map phase is pure-Python lexing, so real processes, not
+GIL-bound threads — the role of bulk's mapper goroutines), the
+single-process reduce assigns uids and builds CSR blocks + columnar
+values (what HBM wants), written via `store.checkpoint.save` as the
+snapshot Alphas boot from.
 """
 
 from __future__ import annotations
 
+import multiprocessing as mp
 import os
 import time
-from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass
 
 from dgraph_tpu.cluster.oracle import Oracle
@@ -39,17 +39,40 @@ def chunk_lines(text: str, n_chunks: int) -> list[str]:
     return ["\n".join(lines[i:i + per]) for i in range(0, len(lines), per)]
 
 
+def _map_chunk(chunk: str) -> list[NQuad]:
+    return parse_rdf(chunk)
+
+
+# inputs below this skip process startup (tests, tiny loads)
+_MP_MIN_BYTES = 1 << 20
+
+
 def run_bulk(rdf_text: str, out_dir: str, schema_text: str = "",
              n_mappers: int = 4, oracle: Oracle | None = None) -> BulkStats:
-    """Map (parallel parse + uid assignment) → reduce (StoreBuilder
-    finalize) → checkpoint. Returns stats; `out_dir` holds the snapshot."""
+    """Map (parallel parse in worker processes) → reduce (uid assignment
+    + StoreBuilder finalize) → checkpoint. Returns stats; `out_dir` holds
+    the snapshot."""
     t0 = time.perf_counter()
     oracle = oracle or Oracle()
     xm = XidMap(oracle)
 
     chunks = chunk_lines(rdf_text, n_mappers)
-    with ThreadPoolExecutor(max_workers=n_mappers) as pool:
-        parsed: list[list[NQuad]] = list(pool.map(parse_rdf, chunks))
+    if n_mappers > 1 and len(rdf_text) >= _MP_MIN_BYTES:
+        import sys
+        import threading
+        # forking a multi-threaded process risks child deadlocks — and
+        # jax's runtime threads are C++-level, invisible to
+        # threading.active_count(); spawn whenever jax is loaded (a
+        # re-import per worker, but safe)
+        methods = mp.get_all_start_methods()
+        safe_fork = ("fork" in methods
+                     and threading.active_count() == 1
+                     and "jax" not in sys.modules)
+        ctx = mp.get_context("fork" if safe_fork else "spawn")
+        with ctx.Pool(processes=min(n_mappers, len(chunks))) as pool:
+            parsed: list[list[NQuad]] = pool.map(_map_chunk, chunks)
+    else:
+        parsed = [parse_rdf(c) for c in chunks]
 
     schema = parse_schema(schema_text) if schema_text else Schema()
     b = StoreBuilder(schema=schema)

@@ -237,6 +237,68 @@ def ring_hop(mesh: Mesh, rel: ShardedRel, frontier_chunks: jax.Array,
 
 
 @functools.lru_cache(maxsize=64)
+def _build_ring_matrix(mesh: Mesh, edge_cap: int, f_cap: int):
+    n_dev = mesh.devices.size
+    perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
+
+    def per_device(indptr_b, indices_b, row_lo_b, chunk_b):
+        indptr, indices, row_lo = indptr_b[0], indices_b[0], row_lo_b[0]
+        chunk = chunk_b[0]
+
+        def step(i, carry):
+            chunk, nbrs_a, seg_a, pos_a, tot_a, max_e = carry
+            nbrs, seg, pos, valid, t = _local_expand_full(
+                indptr, indices, row_lo, chunk, edge_cap)
+            nbrs_a = lax.dynamic_update_index_in_dim(nbrs_a, nbrs, i, 0)
+            seg_a = lax.dynamic_update_index_in_dim(seg_a, seg, i, 0)
+            pos_a = lax.dynamic_update_index_in_dim(pos_a, pos, i, 0)
+            tot_a = lax.dynamic_update_index_in_dim(tot_a, t, i, 0)
+            chunk = lax.ppermute(chunk, SHARD_AXIS, perm)
+            return (chunk, nbrs_a, seg_a, pos_a, tot_a,
+                    jnp.maximum(max_e, t))
+
+        z = jnp.zeros
+        _, nbrs_a, seg_a, pos_a, tot_a, max_e = lax.fori_loop(
+            0, n_dev, step,
+            (chunk, z((n_dev, edge_cap), jnp.int32),
+             z((n_dev, edge_cap), jnp.int32),
+             z((n_dev, edge_cap), jnp.int32),
+             z((n_dev,), jnp.int32), jnp.int32(0)))
+        max_all = lax.pmax(max_e, SHARD_AXIS)
+        return (nbrs_a[None], seg_a[None], pos_a[None], tot_a[None],
+                max_all)
+
+    fn = jax.shard_map(
+        per_device, mesh=mesh,
+        in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS),
+                  P(SHARD_AXIS)),
+        out_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS),
+                   P(SHARD_AXIS), P()),
+        check_vma=False,
+    )
+    return jax.jit(fn)
+
+
+def ring_matrix_hop(mesh: Mesh, rel: ShardedRel, frontier_chunks,
+                    edge_cap: int):
+    """One hop with a SHARDED frontier that RETURNS the edge matrix — the
+    long-context analog wired for the query engine (SURVEY §5): the
+    frontier is too big to replicate, so chunks rotate ring-wise over ICI
+    (ppermute) while every device expands the resident chunk against its
+    local rows.
+
+    Returns (nbrs[D, D, edge_cap], seg[D, D, edge_cap],
+    pos[D, D, edge_cap], totals[D, D], max_step_edges). For shard d at
+    ring step i the expanded chunk ORIGINATED on shard (d - i) mod D;
+    `seg` indexes within that chunk; valid only if max_step_edges ≤
+    edge_cap."""
+    f_cap = frontier_chunks.shape[1]
+    return _build_ring_matrix(mesh, edge_cap, f_cap)(
+        rel.indptr_s, rel.indices_s, rel.row_lo,
+        jax.device_put(frontier_chunks))
+
+
+@functools.lru_cache(maxsize=64)
 def _build_recurse(mesh: Mesh, edge_cap: int, out_cap: int, seen_cap: int,
                    depth: int):
     """Whole multi-hop @recurse as ONE compiled program (frontier loop in

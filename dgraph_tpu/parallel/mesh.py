@@ -21,6 +21,37 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 SHARD_AXIS = "shard"
 
 
+def init_distributed(coordinator: str | None = None,
+                     num_processes: int | None = None,
+                     process_id: int | None = None) -> bool:
+    """Join a multi-host JAX runtime over DCN (reference: the conn/
+    cluster bootstrap — but for devices, not Alphas): after this,
+    jax.devices() spans every host and make_mesh() lays the shard axis
+    across ICI within hosts and DCN between them. Driven by explicit
+    args, the JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES /
+    JAX_PROCESS_ID env trio, or — with JAX_DIST_AUTO=1 on a TPU pod
+    slice — jax's built-in cluster discovery (no-arg initialize).
+    Returns True when a multi-process runtime was joined."""
+    import os
+
+    coordinator = coordinator or os.environ.get("JAX_COORDINATOR_ADDRESS")
+    if coordinator is None:
+        if os.environ.get("JAX_DIST_AUTO") == "1":
+            jax.distributed.initialize()
+            return jax.process_count() > 1
+        return False
+    num_processes = num_processes if num_processes is not None else int(
+        os.environ.get("JAX_NUM_PROCESSES", "0")) or None
+    process_id = process_id if process_id is not None else int(
+        os.environ.get("JAX_PROCESS_ID", "-1"))
+    if process_id < 0:
+        process_id = None
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=num_processes,
+                               process_id=process_id)
+    return jax.process_count() > 1
+
+
 def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
     """A 1-D mesh over the first `n_devices` devices (default: all)."""
     if devices is None:

@@ -155,3 +155,29 @@ def test_cli_bulk_debug_export(tmp_path):
                          "--format", "rdf"))
     assert out["exported"] == 7
     assert "<name>" in exp.read_text()
+
+
+def test_bulk_multiprocess_map(tmp_path):
+    """Above the size floor the map phase runs in worker processes and
+    produces the same snapshot as the inline path (reference: bulk
+    mapper goroutines)."""
+    import dgraph_tpu.loader.bulk as bulk
+    from dgraph_tpu.server.api import Alpha
+
+    n = 4000
+    rdf = "\n".join(
+        f'_:u{i} <name> "user-{i}" .\n_:u{i} <follows> _:u{(i + 1) % n} .'
+        for i in range(n))
+    old = bulk._MP_MIN_BYTES
+    bulk._MP_MIN_BYTES = 1  # force the process pool on this small input
+    try:
+        st = bulk.run_bulk(rdf, str(tmp_path / "p"),
+                           schema_text="name: string @index(exact) .\n"
+                                       "follows: [uid] .",
+                           n_mappers=4)
+    finally:
+        bulk._MP_MIN_BYTES = old
+    assert st.nquads == 2 * n and st.edges == n
+    a = Alpha.open(str(tmp_path / "p"))
+    out = a.query('{ q(func: eq(name, "user-7")) { follows { name } } }')
+    assert out == {"q": [{"follows": [{"name": "user-8"}]}]}

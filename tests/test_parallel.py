@@ -236,3 +236,43 @@ def test_mesh_topk_matches_host_ordering():
         for q in queries:
             assert mesh.query(q) == host.query(q), q
     assert calls, "pushdown path never taken"
+
+
+def test_ring_frontier_engine_route():
+    """Frontiers past ring_threshold ride the sharded ring path from the
+    ENGINE (VERDICT r2 item 7: previously a demo unreachable from DQL);
+    results must match the host engine exactly."""
+    import numpy as np
+
+    from dgraph_tpu.engine import Engine
+    from dgraph_tpu.models.synthetic import powerlaw_rel
+    from dgraph_tpu.parallel.mesh import make_mesh
+    from dgraph_tpu.store.store import StoreBuilder
+
+    rel = powerlaw_rel(600, 5.0, seed=12)
+    b = StoreBuilder()
+    src = np.repeat(np.arange(600, dtype=np.int64),
+                    np.diff(rel.indptr).astype(np.int64))
+    b.add_edges("link", src + 1, rel.indices.astype(np.int64) + 1)
+    for i in range(600):
+        b.add_value(i + 1, "score", i % 17)
+    store = b.finalize()
+
+    q = ('{ q(func: has(link), first: 40) '
+         '{ uid link { uid link { count(uid) } } } }')
+    host = Engine(store, device_threshold=10**9).query(q)
+
+    mesh_engine = Engine(store, device_threshold=0, mesh=make_mesh(8))
+    ring = mesh_engine.query(q)
+    assert ring == host
+
+    # force EVERY mesh hop through the ring path
+    from dgraph_tpu.engine.execute import Executor
+    old = Executor.ring_threshold
+    Executor.ring_threshold = 4
+    try:
+        forced = Engine(store, device_threshold=0,
+                        mesh=make_mesh(8)).query(q)
+    finally:
+        Executor.ring_threshold = old
+    assert forced == host
