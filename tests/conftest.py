@@ -18,3 +18,11 @@ import jax  # noqa: E402  (imported here so the flags above bind first)
 jax.config.update("jax_platforms", "cpu")
 
 assert jax.device_count() >= 8, "virtual device mesh failed to initialise"
+
+# Sanitizer-equivalent mode (reference: `go test -race` in CI; SURVEY §5
+# build equivalent): DGRAPH_TPU_DEBUG_CHECKS=1 runs the whole suite under
+# jax_debug_nans (any NaN in a jitted program faults immediately) and
+# jax_enable_checks (internal invariant checks + tracer leak detection).
+if os.environ.get("DGRAPH_TPU_DEBUG_CHECKS") == "1":
+    jax.config.update("jax_debug_nans", True)
+    jax.config.update("jax_enable_checks", True)
