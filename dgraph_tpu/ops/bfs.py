@@ -209,10 +209,51 @@ def unpack_masks(g: EllGraph, mask) -> list:
     return out
 
 
-def _ell_hop(ells, frontier, W):
-    """next[v] = OR of frontier[u] over in-neighbors u — gathers only."""
-    parts = [lax.reduce(frontier[e], jnp.uint32(0), lax.bitwise_or, (1,))
-             for e in ells]
+# bytes a single bucket gather may NOMINALLY materialise before
+# row-chunking. XLA usually fuses the gather into the OR-reduce without
+# materialising, so this is NOT a real memory model — it exists solely to
+# break up shapes XLA's fusion gives up on (observed: ~20G at B=8192),
+# because the chunked form (lax.map) serialises and costs ~35% throughput
+# wherever fusion would have worked
+GATHER_BUDGET = 12 << 30
+
+
+def _prepare_buckets(ells, n: int, W: int):
+    """Pre-shape each ELL bucket for the hop at lane width W: buckets
+    whose nominal gather intermediate fits GATHER_BUDGET stay flat;
+    larger ones are padded + reshaped to [nch, ch, K] ONCE, eagerly (one
+    device array — the jitted program must not carry both the original
+    and a padded copy as constants)."""
+    prepared = []
+    for e in ells:
+        n_b, K = e.shape
+        row_bytes = max(K * W * 4, 1)
+        if n_b * row_bytes <= GATHER_BUDGET:
+            prepared.append(("flat", jnp.asarray(e), n_b))
+            continue
+        ch = max(1, min(GATHER_BUDGET // row_bytes, n_b))
+        nch = -(-n_b // ch)
+        pad = jnp.full((nch * ch - n_b, K), n, jnp.int32)  # zero mask row
+        e3 = jnp.concatenate([jnp.asarray(e, jnp.int32), pad]
+                             ).reshape(nch, ch, K)
+        prepared.append(("chunked", e3, n_b))
+    return prepared
+
+
+def _ell_hop(prepared, frontier, W):
+    """next[v] = OR of frontier[u] over in-neighbors u — gathers only.
+    Chunked buckets reduce row-slabs sequentially (lax.map) to bound the
+    intermediate where XLA's gather+reduce fusion gives up (~20G)."""
+    parts = []
+    for kind, e, n_b in prepared:
+        if kind == "flat":
+            parts.append(lax.reduce(frontier[e], jnp.uint32(0),
+                                    lax.bitwise_or, (1,)))
+        else:
+            out = lax.map(
+                lambda c: lax.reduce(frontier[c], jnp.uint32(0),
+                                     lax.bitwise_or, (1,)), e)
+            parts.append(out.reshape(-1, W)[:n_b])
     parts.append(jnp.zeros((1, W), jnp.uint32))       # sentinel row
     return jnp.concatenate(parts, axis=0)
 
@@ -226,6 +267,7 @@ def make_ell_recurse(ells, outdeg, n: int, W: int, count_edges: bool = True):
     (last[n+1,W], seen[n+1,W], edges[B] int32)."""
     nblk = -(-n // COUNT_BLK)
     n_pad = nblk * COUNT_BLK
+    prepared = _prepare_buckets(ells, n, W)
     if count_edges:
         outdeg_pad = jnp.concatenate(
             [jnp.asarray(outdeg),
@@ -258,7 +300,7 @@ def make_ell_recurse(ells, outdeg, n: int, W: int, count_edges: bool = True):
             frontier, seen, edges = carry
             if count_edges:
                 edges = _count(frontier, edges)
-            nxt = _ell_hop(ells, frontier, W)
+            nxt = _ell_hop(prepared, frontier, W)
             fresh = nxt & ~seen
             seen = seen | fresh
             return (fresh, seen, edges), None
