@@ -13,7 +13,7 @@ The device numerator is ops/bfs.py::ell_recurse: B traversals packed into
 the bit-lanes of a frontier mask, the whole depth-4 batch as ONE fused XLA
 program. Per hop: pure ELL gathers + bitwise ORs (no scatter — measured
 ~10 ns per random row access on v5e regardless of row width, so the
-kernel amortises each access over B=2048 lanes) + one MXU matvec for the
+kernel amortises each access over B=4096 lanes) + one MXU matvec for the
 exact per-query edge counters.
 
 Robustness contract (the driver grades this file): device work runs in a
