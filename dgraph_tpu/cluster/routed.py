@@ -62,6 +62,9 @@ def routed_view(alpha, store: Store, read_ts: int) -> Store:
     rs.preds = _RoutedPreds(store.preds, alpha, read_ts)
     rs._device = {}
     rs._empty_rel = store._empty_rel
+    # per-snapshot kernel caches key off the underlying immutable store,
+    # not this per-request wrapper (engine/batch.py _cache_host)
+    rs._ell_host = getattr(store, "_ell_host", store)
 
     def remote_expand(pred, reverse, frontier):
         return alpha.remote_hop(pred, reverse, frontier, read_ts, rs)

@@ -1,0 +1,217 @@
+"""Batched @recurse serving: many concurrent queries, ONE lane kernel.
+
+Reference parity: the reference serves a concurrent query mix with
+per-query goroutines (worker/task.go); the TPU-native equivalent packs
+structurally-compatible `@recurse` queries into the bit-lanes of
+`ops/bfs.py ell_recurse` — one fused multi-hop program answers the whole
+batch (the north-star kernel, reached from the SERVING path, not just
+the bench). Ineligible queries fall back to the per-query engine.
+
+Eligibility (per query): exactly one root block, `@recurse(depth: d,
+loop: false)` with the SAME predicate/direction and depth across the
+batch, no filters/facets on the recursed edge, no root pagination/
+ordering. Value leaves are unrestricted — rendering reuses the standard
+renderer over per-query RecurseData rebuilt from the kernel's per-hop
+first-visit masks.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from dgraph_tpu.engine.execute import Executor, LevelNode
+from dgraph_tpu.engine.ir import SubGraph
+from dgraph_tpu.engine.outputnode import to_json
+from dgraph_tpu.engine.recurse import RecurseData, _bind_recurse_vars
+
+MIN_BATCH = 4            # below this the per-query engine is cheaper
+
+
+class _BatchPlan:
+    def __init__(self, blocks, attr, reverse, depth):
+        self.blocks = blocks          # one root SubGraph per query
+        self.attr = attr
+        self.reverse = reverse
+        self.depth = depth
+
+
+def _expands(store, c: SubGraph) -> bool:
+    from dgraph_tpu.engine.execute import expands
+    return expands(store.schema, c)
+
+
+def plan_batch(store, queries_blocks) -> _BatchPlan | None:
+    """Inspect parsed queries; a plan comes back only when every query
+    fits one lane-kernel launch."""
+    if len(queries_blocks) < MIN_BATCH:
+        return None
+    sig = None
+    roots = []
+    for blocks in queries_blocks:
+        if len(blocks) != 1:
+            return None
+        sg = blocks[0]
+        r = sg.recurse
+        if (r is None or r.loop or not r.depth or sg.shortest is not None
+                or sg.filters is not None or sg.first or sg.offset
+                or sg.after or sg.orders or sg.groupby or sg.cascade
+                or sg.normalize or sg.var_name):
+            return None
+        edge_sgs = [c for c in sg.children if _expands(store, c)]
+        if len(edge_sgs) != 1:
+            return None
+        e = edge_sgs[0]
+        if (e.filters is not None or e.facet_filter is not None
+                or e.facet_orders or e.facet_keys is not None
+                or e.first or e.offset or e.after or e.orders
+                or e.var_name):
+            return None
+        s = (e.attr, e.is_reverse, r.depth)
+        if sig is None:
+            sig = s
+        elif sig != s:
+            return None
+        roots.append(sg)
+    return _BatchPlan(roots, sig[0], sig[1], sig[2])
+
+
+def run_batch(store, plan: _BatchPlan, device_threshold: int) -> list:
+    """Execute the batch as one ell_recurse launch and render each query
+    with the standard renderer (full leaf/value support)."""
+    import jax
+
+    from dgraph_tpu.ops.bfs import pack_seed_masks
+
+    g = _ell_for(store, plan.attr, plan.reverse)
+    if g is None:
+        return None
+
+    # root seed ranks per query (host index lookups, as run_block does)
+    ex0 = Executor(store, device_threshold=device_threshold)
+    seeds = [ex0.root_ranks(sg) for sg in plan.blocks]
+    B = -(-len(seeds) // 32) * 32
+    seed_lists = seeds + [np.zeros(0, np.int32)] * (B - len(seeds))
+    mask0 = pack_seed_masks(g, seed_lists)
+
+    fn = _recurse_for(store, plan.attr, plan.reverse, mask0.shape[1])
+    _last, _seen, _edges, hops = fn(jax.device_put(mask0), plan.depth,
+                                    True)
+    hops = np.asarray(hops)          # [depth, n+1, W] fresh masks
+    rel = store.rel(plan.attr, plan.reverse)
+
+    out = []
+    for q, sg in enumerate(plan.blocks):
+        ex = Executor(store, device_threshold=device_threshold)
+        root_nodes = np.unique(seeds[q]).astype(np.int32)
+        node = LevelNode(sg=sg, nodes=root_nodes,
+                         display=root_nodes)
+        data = _rebuild_recurse_data(store, g, rel, hops, q, sg,
+                                     root_nodes, plan.depth)
+        _bind_recurse_vars(ex, node, data, sg)
+        node.recurse_data = data
+        out.append(to_json(ex, [node]))
+    return out
+
+
+def _rebuild_recurse_data(store, g, rel, hops, q: int, sg: SubGraph,
+                          root_nodes: np.ndarray,
+                          depth: int) -> RecurseData:
+    """Per-query first-visit tree from the kernel's per-hop fresh masks:
+    hop h's parents are hop h-1's first-visit set; a (p, c) edge is kept
+    when c is first visited at hop h — exactly the host loop's
+    loop=false semantics."""
+    data = RecurseData(loop=False)
+    for c in sg.children:
+        (data.edge_sgs if _expands(store, c)
+         else data.leaf_sgs).append(c)
+
+    word, bit = q // 32, np.uint32(1 << (q % 32))
+    parents = root_nodes
+    all_nodes = [root_nodes]
+    p_parts, c_parts = [], []
+    for h in range(depth):
+        if not len(parents):
+            break
+        fresh_rows = np.nonzero((hops[h, :g.n, word] & bit) != 0)[0]
+        fresh = np.sort(g.perm_order[fresh_rows]).astype(np.int32)
+        if not len(fresh):
+            break
+        # edges parent → (CSR row ∩ fresh)
+        deg = rel.indptr[parents + 1] - rel.indptr[parents]
+        total = int(deg.sum())
+        if total:
+            seg = np.repeat(np.arange(len(parents)), deg)
+            base = np.repeat(np.cumsum(deg) - deg, deg)
+            pos = (np.repeat(rel.indptr[parents].astype(np.int64), deg)
+                   + np.arange(total) - base)
+            nbrs = rel.indices[pos]
+            keep = np.isin(nbrs, fresh)
+            p_parts.append(parents[seg[keep]].astype(np.int32))
+            c_parts.append(nbrs[keep].astype(np.int32))
+        parents = fresh
+        all_nodes.append(fresh)
+    if p_parts:
+        data.edges[0] = (np.concatenate(p_parts), np.concatenate(c_parts))
+    data.all_nodes = np.unique(np.concatenate(all_nodes)).astype(np.int32)
+    return data
+
+
+# -- per-snapshot kernel caches ----------------------------------------------
+
+def _cache_host(store, attr: str, reverse: bool):
+    """Where kernel caches live: the UNDERLYING immutable snapshot when
+    the view's predicate data IS the snapshot's (routed/ACL wrappers are
+    per-request throwaways — caching on them would rebuild/re-upload per
+    batch); the view itself when the data is view-local (e.g. a faulted
+    foreign tablet, whose version can change between requests)."""
+    base = getattr(store, "_ell_host", store)
+    if base is not store:
+        pd_view = store.preds.get(attr)
+        if pd_view is None or base.preds.get(attr) is not pd_view:
+            return store
+    return base
+
+
+def _ell_for(store, attr: str, reverse: bool):
+    """EllGraph per (snapshot, predicate, direction) — built once,
+    reused across batches until the snapshot changes (stores are
+    immutable)."""
+    from dgraph_tpu.ops.bfs import build_ell
+
+    host = _cache_host(store, attr, reverse)
+    cache = getattr(host, "_ell_cache", None)
+    if cache is None:
+        cache = host._ell_cache = {}
+    key = (attr, reverse)
+    if key not in cache:
+        rel = store.rel(attr, reverse)
+        if rel.nnz == 0:
+            cache[key] = None
+        else:
+            cache[key] = build_ell(rel.indptr, rel.indices)
+    return cache[key]
+
+
+def _recurse_for(store, attr: str, reverse: bool, W: int):
+    """Compiled kernel per (snapshot, pred, dir, lane width). The device
+    arrays upload once per (pred, dir) and are shared across widths."""
+    import jax
+
+    from dgraph_tpu.ops.bfs import make_ell_recurse
+
+    host = _cache_host(store, attr, reverse)
+    fns = getattr(host, "_ell_fns", None)
+    if fns is None:
+        fns = host._ell_fns = {}
+    devs = getattr(host, "_ell_devs", None)
+    if devs is None:
+        devs = host._ell_devs = {}
+    key = (attr, reverse, W)
+    if key not in fns:
+        g = _ell_for(store, attr, reverse)
+        dkey = (attr, reverse)
+        if dkey not in devs:
+            devs[dkey] = [jax.device_put(e) for e in g.ells]
+        fns[key] = make_ell_recurse(devs[dkey], None, g.n, W,
+                                    count_edges=False)
+    return fns[key]

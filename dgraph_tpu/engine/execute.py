@@ -647,15 +647,7 @@ class Executor:
         return out
 
     def _expands(self, sg: SubGraph) -> bool:
-        """Whether a child block triggers uid expansion (vs a value leaf).
-        Schema-driven, as the reference routes by tablet type."""
-        if (sg.is_count or sg.is_uid_leaf or sg.is_agg or sg.is_val_leaf
-                or sg.math_expr is not None):
-            return False
-        if sg.is_reverse or sg.children or sg.recurse or sg.shortest:
-            return True
-        ps = self.store.schema.peek(sg.attr)
-        return bool(ps and ps.kind == Kind.UID)
+        return expands(self.store.schema, sg)
 
     def _record_leaf_vars(self, sg: SubGraph, parent: LevelNode) -> None:
         """Bind value/count vars declared on leaves (a as age, c as count(p))."""
@@ -694,6 +686,19 @@ def _needs_facets(sg) -> bool:
     — remote per-hop results carry none."""
     return (sg.facet_keys is not None or sg.facet_filter is not None
             or bool(sg.facet_orders))
+
+
+def expands(schema, sg: SubGraph) -> bool:
+    """Whether a child block triggers uid expansion (vs a value leaf).
+    Schema-driven, as the reference routes by tablet type. Shared by the
+    executor and the batch planner — the routing rule must never fork."""
+    if (sg.is_count or sg.is_uid_leaf or sg.is_agg or sg.is_val_leaf
+            or sg.math_expr is not None):
+        return False
+    if sg.is_reverse or sg.children or sg.recurse or sg.shortest:
+        return True
+    ps = schema.peek(sg.attr)
+    return bool(ps and ps.kind == Kind.UID)
 
 
 def _coerce_to(want, v):

@@ -294,8 +294,8 @@ def make_ell_recurse(ells, outdeg, n: int, W: int, count_edges: bool = True):
             0, nblk, body, jnp.zeros((W * 32,), jnp.float32))
         return edges + hop_edges.astype(jnp.int32)
 
-    @functools.partial(jax.jit, static_argnames=("depth",))
-    def recurse(mask0, depth: int):
+    @functools.partial(jax.jit, static_argnames=("depth", "keep_hops"))
+    def recurse(mask0, depth: int, keep_hops: bool = False):
         def hop(carry, _):
             frontier, seen, edges = carry
             if count_edges:
@@ -303,11 +303,15 @@ def make_ell_recurse(ells, outdeg, n: int, W: int, count_edges: bool = True):
             nxt = _ell_hop(prepared, frontier, W)
             fresh = nxt & ~seen
             seen = seen | fresh
-            return (fresh, seen, edges), None
+            return (fresh, seen, edges), (fresh if keep_hops else None)
 
-        (last, seen, edges), _ = lax.scan(
+        (last, seen, edges), hops = lax.scan(
             hop, (mask0, mask0, jnp.zeros((W * 32,), jnp.int32)), None,
             length=depth)
+        if keep_hops:
+            # hops[h] = the FRESH mask after hop h+1 (first-visit sets) —
+            # what tree reconstruction needs (engine batch path)
+            return last, seen, edges, hops
         return last, seen, edges
 
     return recurse

@@ -209,6 +209,7 @@ class AclManager:
         # re-upload the working set per query
         rs._device = store._device
         rs._empty_rel = store._empty_rel
+        rs._ell_host = getattr(store, "_ell_host", store)
         for attr in ("_key_cols", "_key_cols_mesh"):
             if hasattr(store, attr):
                 setattr(rs, attr, getattr(store, attr))

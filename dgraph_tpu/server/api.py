@@ -209,6 +209,48 @@ class Alpha:
         self._maybe_gc()
         return out
 
+    def query_batch(self, dqls: list, read_ts: int | None = None,
+                    acl_user: str | None = None) -> list:
+        """Serve MANY queries at once: structurally-compatible @recurse
+        batches execute as ONE lane-packed kernel launch (the north-star
+        throughput path, engine/batch.py); everything else falls back to
+        per-query execution. Returns one JSON dict per query, in order."""
+        from dgraph_tpu.dql.parser import parse
+        from dgraph_tpu.engine.batch import plan_batch, run_batch
+
+        with self._reading(read_ts) as ts:
+            store = self.mvcc.read_view(ts)
+            if self.groups is not None:
+                from dgraph_tpu.cluster.routed import routed_view
+                store = routed_view(self, store, ts)
+            if self.acl is not None and acl_user is not None:
+                store = self.acl.readable_view(acl_user, store)
+            try:
+                blocks = [parse(q) for q in dqls]
+                plan = plan_batch(store, blocks)
+                if plan is not None:
+                    out = run_batch(store, plan, self.device_threshold)
+                    if out is not None:
+                        self._maybe_gc()
+                        return out
+            except Exception:  # noqa: BLE001 — batch is an optimization
+                from dgraph_tpu.utils import logging as xlog
+                xlog.get("alpha").debug("batch plan failed; per-query "
+                                        "fallback", exc_info=True)
+            # per-query fallback with per-query error isolation: one bad
+            # query yields an error OBJECT in its slot, never a failed
+            # batch (the other results still return)
+            eng = Engine(store, device_threshold=self.device_threshold,
+                         mesh=self.mesh)
+            out = []
+            for q in dqls:
+                try:
+                    out.append(eng.query(q))
+                except Exception as e:  # noqa: BLE001
+                    out.append({"errors": [{"message": str(e)}]})
+        self._maybe_gc()
+        return out
+
     def mutate(self, *, set_nquads: str | None = None,
                del_nquads: str | None = None,
                set_json=None, del_json=None,

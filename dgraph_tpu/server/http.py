@@ -83,7 +83,14 @@ def make_http_server(alpha: Alpha, addr: str = "127.0.0.1",
                     self._send(200, {"data": {"accessJWT": token}})
                     return
                 acl_user = self._acl_user()
-                if self.path.startswith("/query"):
+                if self.path.startswith("/query/batch"):
+                    req = json.loads(self._body().decode())
+                    outs = alpha.query_batch(req["queries"],
+                                             acl_user=acl_user)
+                    METRICS.observe("query_latency_us",
+                                    (time.perf_counter() - t0) * 1e6)
+                    self._send(200, {"data": outs})
+                elif self.path.startswith("/query"):
                     body = self._body().decode()
                     if "application/json" in (
                             self.headers.get("Content-Type") or ""):

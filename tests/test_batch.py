@@ -1,0 +1,136 @@
+"""Batched @recurse serving: lane kernel == per-query engine, exactly.
+
+Reference parity: the reference serves concurrent query mixes with
+per-query goroutines; here compatible @recurse queries share one
+lane-packed kernel launch (engine/batch.py)."""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from dgraph_tpu.dql.parser import parse
+from dgraph_tpu.engine import Engine
+from dgraph_tpu.engine.batch import plan_batch, run_batch
+from dgraph_tpu.server.api import Alpha
+
+SCHEMA = """
+name: string @index(exact) .
+score: int .
+follows: [uid] @reverse .
+"""
+
+
+@pytest.fixture(scope="module")
+def alpha():
+    rng = np.random.default_rng(5)
+    a = Alpha(device_threshold=10**9)
+    a.alter(SCHEMA)
+    n = 400
+    lines = [f'_:p{i} <name> "p{i}" .\n_:p{i} <score> "{i % 23}"^^<xs:int> .'
+             for i in range(n)]
+    for i in range(n):
+        for j in rng.choice(n, 4, replace=False):
+            if i != j:
+                lines.append(f"_:p{i} <follows> _:p{j} .")
+    a.mutate(set_nquads="\n".join(lines))
+    return a
+
+
+def _queries(n=12, depth=3):
+    return [('{ q(func: eq(name, "p%d")) @recurse(depth: %d) '
+             '{ name score follows } }' % (i * 17 % 400, depth))
+            for i in range(n)]
+
+
+def test_batch_equals_per_query(alpha):
+    qs = _queries()
+    store = alpha.mvcc.read_view(alpha.oracle.read_only_ts())
+    plan = plan_batch(store, [parse(q) for q in qs])
+    assert plan is not None, "batch plan should be eligible"
+    got = run_batch(store, plan, 10**9)
+    eng = Engine(store, device_threshold=10**9)
+    want = [eng.query(q) for q in qs]
+    assert got == want
+
+
+def test_batch_reverse_and_depths(alpha):
+    store = alpha.mvcc.read_view(alpha.oracle.read_only_ts())
+    qs = [('{ q(func: eq(name, "p%d")) @recurse(depth: 2) '
+           '{ name ~follows } }' % (i * 31 % 400)) for i in range(8)]
+    plan = plan_batch(store, [parse(q) for q in qs])
+    assert plan is not None and plan.reverse is True
+    got = run_batch(store, plan, 10**9)
+    eng = Engine(store, device_threshold=10**9)
+    assert got == [eng.query(q) for q in qs]
+
+
+def test_plan_rejects_incompatible(alpha):
+    store = alpha.mvcc.read_view(alpha.oracle.read_only_ts())
+    base = _queries(6)
+    # mixed depths
+    mixed = base[:5] + ['{ q(func: eq(name, "p1")) @recurse(depth: 9) '
+                        '{ name follows } }']
+    assert plan_batch(store, [parse(q) for q in mixed]) is None
+    # filters on the edge
+    filt = ['{ q(func: eq(name, "p1")) @recurse(depth: 3) '
+            '{ name follows @filter(ge(score, 5)) } }'] * 6
+    assert plan_batch(store, [parse(q) for q in filt]) is None
+    # below MIN_BATCH
+    assert plan_batch(store, [parse(q) for q in base[:2]]) is None
+
+
+def test_query_batch_endpoint_and_fallback(alpha):
+    from dgraph_tpu.server.http import make_http_server, serve_background
+    srv = make_http_server(alpha, "127.0.0.1", 0)
+    serve_background(srv)
+    port = srv.server_address[1]
+    qs = _queries(8)
+    # one incompatible query forces the per-query fallback: results must
+    # still be correct and ordered
+    qs_mixed = qs[:4] + ['{ q(func: eq(name, "p3")) { name score } }'] \
+        + qs[4:]
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/query/batch",
+        data=json.dumps({"queries": qs_mixed}).encode(),
+        headers={"Content-Type": "application/json"})
+    out = json.load(urllib.request.urlopen(req, timeout=60))["data"]
+    eng = Engine(alpha.mvcc.read_view(alpha.oracle.read_only_ts()),
+                 device_threshold=10**9)
+    assert out == [eng.query(q) for q in qs_mixed]
+    # and the fully-compatible batch through the same endpoint
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/query/batch",
+        data=json.dumps({"queries": qs}).encode(),
+        headers={"Content-Type": "application/json"})
+    out = json.load(urllib.request.urlopen(req, timeout=60))["data"]
+    assert out == [eng.query(q) for q in qs]
+    srv.shutdown()
+
+
+def test_batch_error_isolation(alpha):
+    """A malformed query yields an error object in its slot; the rest of
+    the batch still answers (code-review finding)."""
+    qs = _queries(5) + ["{ broken(func: frobnicate(name"]
+    out = alpha.query_batch(qs)
+    assert len(out) == 6
+    assert "errors" in out[5]
+    eng = Engine(alpha.mvcc.read_view(alpha.oracle.read_only_ts()),
+                 device_threshold=10**9)
+    assert out[:5] == [eng.query(q) for q in _queries(5)]
+
+
+def test_batch_kernel_cache_reuse(alpha):
+    """The ELL graph and compiled kernel build once per snapshot, even
+    through per-request view wrappers (code-review finding)."""
+    import dgraph_tpu.engine.batch as b
+    qs = _queries(6)
+    alpha.query_batch(qs)
+    store = alpha.mvcc.read_view(alpha.oracle.read_only_ts())
+    host = getattr(store, "_ell_host", store)
+    assert hasattr(host, "_ell_cache") or hasattr(store, "_ell_cache")
+    cache_holder = host if hasattr(host, "_ell_cache") else store
+    n_before = len(cache_holder._ell_cache)
+    alpha.query_batch(qs)       # second batch: no rebuild
+    assert len(cache_holder._ell_cache) == n_before
