@@ -25,9 +25,20 @@ def cmd_alpha(args) -> int:
     cfg = load_config(AlphaConfig, args.config, {
         "p_dir": args.p, "http_port": args.http_port,
         "grpc_port": args.grpc_port, "log_level": args.log_level,
-        "mesh_devices": args.mesh_devices})
+        "mesh_devices": args.mesh_devices,
+        "encryption_key_file": args.encryption_key_file,
+        "encryption_strict": args.encryption_strict or None})
     xlog.setup(cfg.log_level)
     log = xlog.get("alpha")
+    if cfg.encryption_key_file:
+        # at-rest encryption for every checkpoint file and WAL record
+        # this process writes or reads (reference: ee encryption,
+        # --encryption key-file=)
+        from dgraph_tpu.store import vault
+        vault.load_key_file(cfg.encryption_key_file,
+                            strict=cfg.encryption_strict)
+        log.info("encryption-at-rest enabled (strict=%s)",
+                 cfg.encryption_strict)
 
     mesh = None
     if cfg.mesh_devices:
@@ -255,6 +266,12 @@ def main(argv=None) -> int:
                    help="SPMD engine over N devices (-1 = all, 0 = off)")
     p.add_argument("--acl_secret_file", default=None,
                    help="enable ACL; file holds the token-signing secret")
+    p.add_argument("--encryption_key_file", default=None,
+                   help="AES key file (16/24/32 bytes) → encrypt "
+                        "checkpoints, WAL, and backups at rest")
+    p.add_argument("--encryption_strict", action="store_true",
+                   help="reject plaintext at-rest files (post-migration "
+                        "posture: unauthenticated data cannot be read)")
     p.add_argument("--jax-coordinator", default=None,
                    dest="jax_coordinator",
                    help="host:port of the jax.distributed coordinator "
@@ -287,6 +304,8 @@ def main(argv=None) -> int:
     p.add_argument("--schema", default=None)
     p.add_argument("--out", default="p")
     p.add_argument("--mappers", type=int, default=4)
+    p.add_argument("--encryption_key_file", default=None)
+    p.add_argument("--encryption_strict", action="store_true")
     p.add_argument("--log_level", default="info")
     p.set_defaults(fn=cmd_bulk)
 
@@ -296,6 +315,8 @@ def main(argv=None) -> int:
     p.add_argument("--p", default="p")
     p.add_argument("--batch", type=int, default=1000)
     p.add_argument("--conc", type=int, default=4)
+    p.add_argument("--encryption_key_file", default=None)
+    p.add_argument("--encryption_strict", action="store_true")
     p.add_argument("--log_level", default="info")
     p.set_defaults(fn=cmd_live)
 
@@ -304,12 +325,16 @@ def main(argv=None) -> int:
     p.add_argument("--dest", required=True, help="backup series dir")
     p.add_argument("--full", action="store_true",
                    help="force a full backup even if the chain extends")
+    p.add_argument("--encryption_key_file", default=None)
+    p.add_argument("--encryption_strict", action="store_true")
     p.add_argument("--log_level", default="info")
     p.set_defaults(fn=cmd_backup)
 
     p = sub.add_parser("restore", help="rebuild a posting dir from backups")
     p.add_argument("--dest", required=True, help="backup series dir")
     p.add_argument("--p", required=True, help="posting dir to write")
+    p.add_argument("--encryption_key_file", default=None)
+    p.add_argument("--encryption_strict", action="store_true")
     p.add_argument("--log_level", default="info")
     p.set_defaults(fn=cmd_restore)
 
@@ -317,13 +342,25 @@ def main(argv=None) -> int:
     p.add_argument("--p", default="p")
     p.add_argument("--out", required=True)
     p.add_argument("--format", choices=("rdf", "json"), default="rdf")
+    p.add_argument("--encryption_key_file", default=None)
+    p.add_argument("--encryption_strict", action="store_true")
     p.set_defaults(fn=cmd_export)
 
     p = sub.add_parser("debug", help="inspect a snapshot dir")
     p.add_argument("--p", default="p")
+    p.add_argument("--encryption_key_file", default=None)
+    p.add_argument("--encryption_strict", action="store_true")
     p.set_defaults(fn=cmd_debug)
 
     args = ap.parse_args(argv)
+    if getattr(args, "encryption_key_file", None):
+        # every subcommand that touches a posting dir, WAL, or backup
+        # series honors the same at-rest key (reference: the encryption
+        # superflag is process-wide)
+        from dgraph_tpu.store import vault
+        vault.load_key_file(args.encryption_key_file,
+                            strict=getattr(args, "encryption_strict",
+                                           False))
     return args.fn(args)
 
 

@@ -17,6 +17,8 @@ first-visit masks.
 
 from __future__ import annotations
 
+import threading
+
 import numpy as np
 
 from dgraph_tpu.engine.execute import Executor, LevelNode
@@ -25,6 +27,12 @@ from dgraph_tpu.engine.outputnode import to_json
 from dgraph_tpu.engine.recurse import RecurseData, _bind_recurse_vars
 
 MIN_BATCH = 4            # below this the per-query engine is cheaper
+# Depth is a static arg of the jitted kernel: each distinct value is an
+# XLA compile, and the scan materializes a [depth, n+1, W] hops buffer
+# with no early exit. Depths past any real graph's diameter fall back to
+# the per-query engine (whose host loop exits when the frontier empties)
+# instead of letting a client-controlled depth size device buffers.
+MAX_KERNEL_DEPTH = 64
 
 
 class _BatchPlan:
@@ -52,6 +60,8 @@ def plan_batch(store, queries_blocks) -> _BatchPlan | None:
             return None
         sg = blocks[0]
         r = sg.recurse
+        if r is not None and r.depth and r.depth > MAX_KERNEL_DEPTH:
+            return None
         if (r is None or r.loop or not r.depth or sg.shortest is not None
                 or sg.filters is not None or sg.first or sg.offset
                 or sg.after or sg.orders or sg.groupby or sg.cascade
@@ -86,10 +96,14 @@ def run_batch(store, plan: _BatchPlan, device_threshold: int) -> list:
     if g is None:
         return None
 
-    # root seed ranks per query (host index lookups, as run_block does)
+    # root seed ranks per query (host index lookups, as run_block does).
+    # Lane words round UP to a power of two: padding lanes are zero-seeded
+    # and free, and bucketing bounds distinct kernel compiles at O(log B)
+    # instead of one multi-second XLA compile per client batch size.
     ex0 = Executor(store, device_threshold=device_threshold)
     seeds = [ex0.root_ranks(sg) for sg in plan.blocks]
-    B = -(-len(seeds) // 32) * 32
+    words = -(-len(seeds) // 32)
+    B = 32 * (1 << (words - 1).bit_length() if words > 1 else 1)
     seed_lists = seeds + [np.zeros(0, np.int32)] * (B - len(seeds))
     mask0 = pack_seed_masks(g, seed_lists)
 
@@ -158,6 +172,12 @@ def _rebuild_recurse_data(store, g, rel, hops, q: int, sg: SubGraph,
 
 # -- per-snapshot kernel caches ----------------------------------------------
 
+# one lock guards cache init/population on every snapshot: concurrent
+# batch requests under ThreadingHTTPServer must not both build/upload the
+# same ELL arrays (double HBM) or clobber each other's cache dicts
+_cache_lock = threading.Lock()
+
+
 def _cache_host(store, attr: str, reverse: bool):
     """Where kernel caches live: the UNDERLYING immutable snapshot when
     the view's predicate data IS the snapshot's (routed/ACL wrappers are
@@ -179,17 +199,21 @@ def _ell_for(store, attr: str, reverse: bool):
     from dgraph_tpu.ops.bfs import build_ell
 
     host = _cache_host(store, attr, reverse)
-    cache = getattr(host, "_ell_cache", None)
-    if cache is None:
-        cache = host._ell_cache = {}
     key = (attr, reverse)
-    if key not in cache:
-        rel = store.rel(attr, reverse)
-        if rel.nnz == 0:
-            cache[key] = None
-        else:
-            cache[key] = build_ell(rel.indptr, rel.indices)
-    return cache[key]
+    cache = getattr(host, "_ell_cache", None)
+    if cache is not None and key in cache:  # hot path: no lock
+        return cache[key]
+    with _cache_lock:
+        cache = getattr(host, "_ell_cache", None)
+        if cache is None:
+            cache = host._ell_cache = {}
+        if key not in cache:
+            rel = store.rel(attr, reverse)
+            if rel.nnz == 0:
+                cache[key] = None
+            else:
+                cache[key] = build_ell(rel.indptr, rel.indices)
+        return cache[key]
 
 
 def _recurse_for(store, attr: str, reverse: bool, W: int):
@@ -200,18 +224,22 @@ def _recurse_for(store, attr: str, reverse: bool, W: int):
     from dgraph_tpu.ops.bfs import make_ell_recurse
 
     host = _cache_host(store, attr, reverse)
-    fns = getattr(host, "_ell_fns", None)
-    if fns is None:
-        fns = host._ell_fns = {}
-    devs = getattr(host, "_ell_devs", None)
-    if devs is None:
-        devs = host._ell_devs = {}
     key = (attr, reverse, W)
-    if key not in fns:
-        g = _ell_for(store, attr, reverse)
-        dkey = (attr, reverse)
-        if dkey not in devs:
-            devs[dkey] = [jax.device_put(e) for e in g.ells]
-        fns[key] = make_ell_recurse(devs[dkey], None, g.n, W,
-                                    count_edges=False)
-    return fns[key]
+    fns = getattr(host, "_ell_fns", None)
+    if fns is not None and key in fns:  # hot path: no lock
+        return fns[key]
+    g = _ell_for(store, attr, reverse)  # takes the lock itself
+    with _cache_lock:
+        fns = getattr(host, "_ell_fns", None)
+        if fns is None:
+            fns = host._ell_fns = {}
+        devs = getattr(host, "_ell_devs", None)
+        if devs is None:
+            devs = host._ell_devs = {}
+        if key not in fns:
+            dkey = (attr, reverse)
+            if dkey not in devs:
+                devs[dkey] = [jax.device_put(e) for e in g.ells]
+            fns[key] = make_ell_recurse(devs[dkey], None, g.n, W,
+                                        count_edges=False)
+        return fns[key]

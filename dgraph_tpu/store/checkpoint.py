@@ -23,6 +23,7 @@ import os
 
 import numpy as np
 
+from dgraph_tpu.store import vault
 from dgraph_tpu.store.schema import parse_schema
 from dgraph_tpu.store.store import (
     EdgeRel, FacetCol, PredicateData, Store, ValueColumn, build_indexes)
@@ -52,30 +53,34 @@ def save(store: Store, dirname: str, base_ts: int = 0,
         compress = native.HAVE_NATIVE
     os.makedirs(dirname, exist_ok=True)
     if compress:
-        with open(os.path.join(dirname, "uids.duc"), "wb") as f:
-            f.write(native.codec_encode(store.uids))
+        vault.write_bytes(os.path.join(dirname, "uids.duc"),
+                          native.codec_encode(store.uids))
     else:
-        np.save(os.path.join(dirname, "uids.npy"), store.uids)
+        vault.save_np(os.path.join(dirname, "uids.npy"), store.uids)
     preds_meta = {}
     for pred, pd in store.preds.items():
         slug = _slug(pred)
         meta = {"slug": slug, "langs": sorted(pd.vals)}
         for side, rel in (("fwd", pd.fwd), ("rev", pd.rev)):
             if rel is not None:
-                np.save(os.path.join(dirname, f"{slug}.{side}.indptr.npy"),
-                        rel.indptr)
-                np.save(os.path.join(dirname, f"{slug}.{side}.indices.npy"),
-                        rel.indices)
+                vault.save_np(
+                    os.path.join(dirname, f"{slug}.{side}.indptr.npy"),
+                    rel.indptr)
+                vault.save_np(
+                    os.path.join(dirname, f"{slug}.{side}.indices.npy"),
+                    rel.indices)
                 meta[side] = True
         for lang, col in pd.vals.items():
             lslug = lang or "_"
-            np.save(os.path.join(dirname, f"{slug}.val.{lslug}.subj.npy"),
-                    col.subj)
+            vault.save_np(
+                os.path.join(dirname, f"{slug}.val.{lslug}.subj.npy"),
+                col.subj)
             vals = col.vals
             if vals.dtype == object:  # strings: store as fixed-width UTF
                 vals = np.array([str(v) for v in vals], dtype=np.str_)
-            np.save(os.path.join(dirname, f"{slug}.val.{lslug}.vals.npy"),
-                    vals)
+            vault.save_np(
+                os.path.join(dirname, f"{slug}.val.{lslug}.vals.npy"),
+                vals)
         if pd.efacets or pd.vfacets:
             # facets ride in a JSON sidecar (they are sparse; the reference
             # persists them inside each posting — same durability contract)
@@ -87,8 +92,8 @@ def save(store: Store, dirname: str, base_ts: int = 0,
                                 for r, v in m.items()}
                             for k, m in pd.vfacets.items()},
             }
-            with open(os.path.join(dirname, f"{slug}.facets.json"), "w") as f:
-                json.dump(fdoc, f)
+            vault.write_bytes(os.path.join(dirname, f"{slug}.facets.json"),
+                              json.dumps(fdoc).encode())
             meta["facets"] = True
         preds_meta[pred] = meta
     manifest = {
@@ -100,8 +105,10 @@ def save(store: Store, dirname: str, base_ts: int = 0,
         "predicates": preds_meta,
     }
     tmp = os.path.join(dirname, "manifest.json.tmp")
-    with open(tmp, "w") as f:
-        json.dump(manifest, f, indent=1)
+    # manifest is encrypted too — it carries the schema text and
+    # predicate names (the reference likewise keeps schema inside the
+    # encrypted store, exposing only sizes/timestamps in plaintext)
+    vault.write_bytes(tmp, json.dumps(manifest, indent=1).encode())
     os.replace(tmp, os.path.join(dirname, "manifest.json"))
 
 
@@ -154,8 +161,8 @@ def load(dirname: str) -> tuple[Store, int]:
     """Load (store, base_ts). Reference: restore / bulk-load handoff.
     Accepts both plain snapshot dirs and versioned (CURRENT) layouts."""
     dirname = resolve(dirname)
-    with open(os.path.join(dirname, "manifest.json")) as f:
-        manifest = json.load(f)
+    manifest = json.loads(
+        vault.read_bytes(os.path.join(dirname, "manifest.json")))
     if not (MIN_FORMAT_VERSION <= manifest["format_version"]
             <= FORMAT_VERSION):
         raise ValueError(
@@ -163,10 +170,11 @@ def load(dirname: str) -> tuple[Store, int]:
             f"[{MIN_FORMAT_VERSION}, {FORMAT_VERSION}]")
     if manifest.get("uids_codec"):
         from dgraph_tpu import native
-        with open(os.path.join(dirname, "uids.duc"), "rb") as f:
-            uids = native.codec_decode(f.read(), manifest["n_nodes"])
+        uids = native.codec_decode(
+            vault.read_bytes(os.path.join(dirname, "uids.duc")),
+            manifest["n_nodes"])
     else:
-        uids = np.load(os.path.join(dirname, "uids.npy"))
+        uids = vault.load_np(os.path.join(dirname, "uids.npy"))
     schema = parse_schema(manifest["schema"])
     preds: dict[str, PredicateData] = {}
     for pred, meta in manifest["predicates"].items():
@@ -174,25 +182,25 @@ def load(dirname: str) -> tuple[Store, int]:
         pd = PredicateData(schema=schema.get(pred))
         for side in ("fwd", "rev"):
             if meta.get(side):
-                indptr = np.load(
+                indptr = vault.load_np(
                     os.path.join(dirname, f"{slug}.{side}.indptr.npy"))
-                indices = np.load(
+                indices = vault.load_np(
                     os.path.join(dirname, f"{slug}.{side}.indices.npy"))
                 setattr(pd, side, EdgeRel(indptr=indptr, indices=indices))
         for lang in meta["langs"]:
             lslug = lang or "_"
-            vals = np.load(
+            vals = vault.load_np(
                 os.path.join(dirname, f"{slug}.val.{lslug}.vals.npy"),
                 allow_pickle=False)
             if vals.dtype.kind == "U":  # restore string columns to object
                 vals = vals.astype(object)
             pd.vals[lang] = ValueColumn(
-                subj=np.load(
+                subj=vault.load_np(
                     os.path.join(dirname, f"{slug}.val.{lslug}.subj.npy")),
                 vals=vals)
         if meta.get("facets"):
-            with open(os.path.join(dirname, f"{slug}.facets.json")) as f:
-                fdoc = json.load(f)
+            fdoc = json.loads(vault.read_bytes(
+                os.path.join(dirname, f"{slug}.facets.json")))
             for k, col in fdoc.get("efacets", {}).items():
                 vals = np.empty(len(col["vals"]), dtype=object)
                 vals[:] = [dec_scalar(v) for v in col["vals"]]

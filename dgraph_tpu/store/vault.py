@@ -1,0 +1,178 @@
+"""Encryption-at-rest: AES-GCM over checkpoint files and WAL records.
+
+Reference parity: the enterprise encryption-at-rest feature (SURVEY §2.5
+`ee/`) — the reference encrypts Badger SSTs and value-log blocks with an
+AES key loaded from `--encryption key-file=` at process start. Here the
+at-rest units are (a) whole checkpoint files (numpy blocks, facet
+sidecars, the manifest) and (b) individual WAL/journal record payloads;
+backups inherit both automatically because they are built from the same
+two writers.
+
+Design notes:
+- One process-global key, loaded once at startup (the reference's model:
+  encryption is a property of the deployment, not of a call site).
+- AES-256/192/128-GCM via the `cryptography` package; every encryption
+  uses a fresh random 96-bit nonce, stored alongside the ciphertext:
+  ``MAGIC | nonce(12) | ciphertext+tag``.
+- WAL framing CRCs the *ciphertext*, so torn-tail detection and
+  truncation (`wal._valid_end`) still work without the key — an operator
+  can repair a crashed directory they cannot read, like Badger's
+  MANIFEST replay under encryption.
+- Plaintext files/records remain readable while a key is set (migration:
+  enable the key, next checkpoint rewrites everything encrypted). An
+  encrypted file without a key raises `VaultError` with a clear message.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import struct
+
+import numpy as np
+
+MAGIC = b"DTE1"   # single-shot sealed blob (file or WAL payload)
+MAGIC_C = b"DTEC"  # chunked sealed blob (large checkpoint files)
+MAGIC_P = b"DTEP"  # plaintext-escape: raw bytes that happen to start
+#                    with one of our magics (a delta-varint uid stream
+#                    can emit any byte sequence) are written behind this
+#                    prefix so they are never misread as ciphertext
+_NONCE = 12
+_KEY_SIZES = (16, 24, 32)
+# AESGCM's one-shot API caps plaintext at 2^31-1 bytes; blobs above this
+# are sealed as independent 1 GiB chunks, each with its own nonce+tag
+_CHUNK = 1 << 30
+_LEN = struct.Struct("<Q")
+
+_aead = None    # process-global AESGCM, None = encryption off
+_strict = False  # refuse plaintext once migration is done
+
+
+class VaultError(Exception):
+    """Missing/incorrect key or tampered ciphertext."""
+
+
+def set_key(key: bytes | None, strict: bool = False) -> None:
+    """Install (or clear, with None) the process-global at-rest key.
+    `strict` additionally REJECTS plaintext blobs on read — the
+    post-migration posture in which a keyless writer (or an attacker
+    swapping in unauthenticated files) cannot inject data."""
+    global _aead, _strict
+    if key is None:
+        _aead = None
+        _strict = False
+        return
+    if len(key) not in _KEY_SIZES:
+        raise VaultError(
+            f"encryption key must be {_KEY_SIZES} bytes (AES-128/192/256), "
+            f"got {len(key)}")
+    from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+    _aead = AESGCM(key)
+    _strict = bool(strict)
+
+
+def load_key_file(path: str, strict: bool = False) -> None:
+    """Read the raw AES key from `path` (reference: --encryption
+    key-file=). A single trailing newline is tolerated — keys are often
+    written by shell redirection."""
+    with open(path, "rb") as f:
+        key = f.read()
+    if len(key) - 1 in _KEY_SIZES and key.endswith(b"\n"):
+        key = key[:-1]
+    set_key(key, strict=strict)
+
+
+def active() -> bool:
+    return _aead is not None
+
+
+def encrypt(data: bytes) -> bytes:
+    if _aead is None:
+        return data
+    if len(data) <= _CHUNK:
+        nonce = os.urandom(_NONCE)
+        return MAGIC + nonce + _aead.encrypt(nonce, data, None)
+    parts = [MAGIC_C]
+    for off in range(0, len(data), _CHUNK):
+        nonce = os.urandom(_NONCE)
+        ct = _aead.encrypt(nonce, data[off:off + _CHUNK], None)
+        parts.append(_LEN.pack(len(ct)) + nonce + ct)
+    return b"".join(parts)
+
+
+def is_encrypted(data: bytes) -> bool:
+    return data[:len(MAGIC)] in (MAGIC, MAGIC_C)
+
+
+def decrypt(data: bytes) -> bytes:
+    """Decrypt an encrypted blob; plaintext blobs pass through unchanged
+    (pre-encryption files stay loadable after the key is enabled) unless
+    strict mode is on."""
+    if not is_encrypted(data):
+        if _strict and _aead is not None:
+            raise VaultError(
+                "plaintext data rejected: encryption is in strict mode")
+        return data
+    if _aead is None:
+        raise VaultError(
+            "data is encrypted but no key is loaded "
+            "(--encryption_key_file)")
+    try:
+        if data[:len(MAGIC)] == MAGIC:
+            nonce = data[len(MAGIC):len(MAGIC) + _NONCE]
+            return _aead.decrypt(nonce, data[len(MAGIC) + _NONCE:], None)
+        out, off = [], len(MAGIC_C)
+        while off < len(data):
+            (clen,) = _LEN.unpack_from(data, off)
+            off += _LEN.size
+            nonce = data[off:off + _NONCE]
+            off += _NONCE
+            out.append(_aead.decrypt(nonce, data[off:off + clen], None))
+            off += clen
+        return b"".join(out)
+    except VaultError:
+        raise
+    except Exception as e:  # InvalidTag/short read — wrong key/tampering
+        raise VaultError(f"decryption failed (wrong key or corrupt "
+                         f"data): {e!r}") from e
+
+
+# ---- file IO helpers (checkpoint blocks, sidecars, manifests) ----
+
+def write_bytes(path: str, data: bytes) -> None:
+    # escape regardless of key state: content beginning with any magic
+    # must survive the unconditional MAGIC_P strip in read_bytes
+    if data[:len(MAGIC)] in (MAGIC, MAGIC_C, MAGIC_P):
+        data = MAGIC_P + data
+    with open(path, "wb") as f:
+        f.write(encrypt(data))
+
+
+def read_bytes(path: str) -> bytes:
+    with open(path, "rb") as f:
+        data = decrypt(f.read())
+    if data[:len(MAGIC_P)] == MAGIC_P:
+        return data[len(MAGIC_P):]
+    return data
+
+
+def save_np(path: str, arr: np.ndarray) -> None:
+    """np.save through the vault (serialize to memory, encrypt, write)."""
+    if _aead is None:
+        np.save(path, arr)
+        return
+    buf = io.BytesIO()
+    np.save(buf, arr)
+    write_bytes(path, buf.getvalue())
+
+
+def load_np(path: str, allow_pickle: bool = False) -> np.ndarray:
+    with open(path, "rb") as f:
+        head = f.read(len(MAGIC))
+        if not is_encrypted(head):
+            if _strict and _aead is not None:
+                raise VaultError(f"plaintext file rejected in strict "
+                                 f"encryption mode: {path}")
+            return np.load(path, allow_pickle=allow_pickle)
+        data = head + f.read()
+    return np.load(io.BytesIO(decrypt(data)), allow_pickle=allow_pickle)

@@ -27,6 +27,7 @@ from typing import Iterator
 
 import numpy as np
 
+from dgraph_tpu.store import vault
 from dgraph_tpu.store.mvcc import Mutation
 
 MAGIC = b"DGW1"
@@ -120,7 +121,11 @@ class Journal:
 
     @staticmethod
     def _frame(doc: dict) -> bytes:
-        payload = json.dumps(doc, separators=(",", ":")).encode()
+        # with encryption-at-rest active, each record payload is
+        # AES-GCM-sealed individually; the CRC covers the ciphertext so
+        # torn-tail truncation works without the key (store/vault.py)
+        payload = vault.encrypt(
+            json.dumps(doc, separators=(",", ":")).encode())
         return MAGIC + _HEADER.pack(len(payload),
                                     zlib.crc32(payload)) + payload
 
@@ -156,7 +161,7 @@ class Journal:
         with open(path, "rb") as f:
             data = f.read()
         for _off, payload in _scan(data):
-            yield json.loads(payload)
+            yield json.loads(vault.decrypt(payload))
 
     def close(self) -> None:
         self._f.close()
@@ -224,7 +229,7 @@ def replay(path: str) -> Iterator[tuple[int, str, object]]:
     with open(path, "rb") as f:
         data = f.read()
     for _off, payload in _scan(data):
-        doc = json.loads(payload)
+        doc = json.loads(vault.decrypt(payload))
         if "schema" in doc:
             yield int(doc["ts"]), "schema", doc["schema"]
         elif "drop" in doc:

@@ -79,6 +79,11 @@ def test_plan_rejects_incompatible(alpha):
     assert plan_batch(store, [parse(q) for q in filt]) is None
     # below MIN_BATCH
     assert plan_batch(store, [parse(q) for q in base[:2]]) is None
+    # client-controlled depth beyond the kernel cap falls back to the
+    # per-query engine (host loop early-exits; no unbounded device scan)
+    deep = ['{ q(func: eq(name, "p1")) @recurse(depth: 100000) '
+            '{ name follows } }'] * 6
+    assert plan_batch(store, [parse(q) for q in deep]) is None
 
 
 def test_query_batch_endpoint_and_fallback(alpha):
