@@ -256,7 +256,18 @@ def main(argv=None) -> int:
                     version=f"dgraph_tpu {__version__}")
     sub = ap.add_subparsers(dest="cmd", required=True)
 
-    p = sub.add_parser("alpha", help="run the data server")
+    # at-rest encryption flags, shared by every subcommand that touches
+    # a posting dir, WAL, or backup series (argparse parent parser)
+    enc = argparse.ArgumentParser(add_help=False)
+    enc.add_argument("--encryption_key_file", default=None,
+                     help="AES key file (16/24/32 bytes) → encrypt "
+                          "checkpoints, WAL, and backups at rest")
+    enc.add_argument("--encryption_strict", action="store_true",
+                     help="reject plaintext at-rest files (post-"
+                          "migration posture: unauthenticated data "
+                          "cannot be read)")
+
+    p = sub.add_parser("alpha", help="run the data server", parents=[enc])
     p.add_argument("--p", default="p", help="posting snapshot dir")
     p.add_argument("--config", default=None)
     p.add_argument("--http_port", type=int, default=None)
@@ -266,12 +277,6 @@ def main(argv=None) -> int:
                    help="SPMD engine over N devices (-1 = all, 0 = off)")
     p.add_argument("--acl_secret_file", default=None,
                    help="enable ACL; file holds the token-signing secret")
-    p.add_argument("--encryption_key_file", default=None,
-                   help="AES key file (16/24/32 bytes) → encrypt "
-                        "checkpoints, WAL, and backups at rest")
-    p.add_argument("--encryption_strict", action="store_true",
-                   help="reject plaintext at-rest files (post-migration "
-                        "posture: unauthenticated data cannot be read)")
     p.add_argument("--jax-coordinator", default=None,
                    dest="jax_coordinator",
                    help="host:port of the jax.distributed coordinator "
@@ -285,7 +290,7 @@ def main(argv=None) -> int:
     p.add_argument("--log_level", default="info")
     p.set_defaults(fn=cmd_alpha)
 
-    p = sub.add_parser("zero", help="run the cluster manager service")
+    p = sub.add_parser("zero", help="run the cluster manager service", parents=[enc])
     p.add_argument("--port", type=int, default=5080)
     p.add_argument("--replicas", type=int, default=1,
                    help="replicas per group (elasticity knob)")
@@ -299,57 +304,45 @@ def main(argv=None) -> int:
     p.add_argument("--log_level", default="info")
     p.set_defaults(fn=cmd_zero)
 
-    p = sub.add_parser("bulk", help="offline bulk load → snapshot dir")
+    p = sub.add_parser("bulk", help="offline bulk load → snapshot dir", parents=[enc])
     p.add_argument("--files", required=True, help="N-Quad input file")
     p.add_argument("--schema", default=None)
     p.add_argument("--out", default="p")
     p.add_argument("--mappers", type=int, default=4)
-    p.add_argument("--encryption_key_file", default=None)
-    p.add_argument("--encryption_strict", action="store_true")
     p.add_argument("--log_level", default="info")
     p.set_defaults(fn=cmd_bulk)
 
-    p = sub.add_parser("live", help="transactional load into a snapshot")
+    p = sub.add_parser("live", help="transactional load into a snapshot", parents=[enc])
     p.add_argument("--files", required=True)
     p.add_argument("--schema", default=None)
     p.add_argument("--p", default="p")
     p.add_argument("--batch", type=int, default=1000)
     p.add_argument("--conc", type=int, default=4)
-    p.add_argument("--encryption_key_file", default=None)
-    p.add_argument("--encryption_strict", action="store_true")
     p.add_argument("--log_level", default="info")
     p.set_defaults(fn=cmd_live)
 
-    p = sub.add_parser("backup", help="binary backup (full/incremental)")
+    p = sub.add_parser("backup", help="binary backup (full/incremental)", parents=[enc])
     p.add_argument("--p", default="p", help="posting dir to back up")
     p.add_argument("--dest", required=True, help="backup series dir")
     p.add_argument("--full", action="store_true",
                    help="force a full backup even if the chain extends")
-    p.add_argument("--encryption_key_file", default=None)
-    p.add_argument("--encryption_strict", action="store_true")
     p.add_argument("--log_level", default="info")
     p.set_defaults(fn=cmd_backup)
 
-    p = sub.add_parser("restore", help="rebuild a posting dir from backups")
+    p = sub.add_parser("restore", help="rebuild a posting dir from backups", parents=[enc])
     p.add_argument("--dest", required=True, help="backup series dir")
     p.add_argument("--p", required=True, help="posting dir to write")
-    p.add_argument("--encryption_key_file", default=None)
-    p.add_argument("--encryption_strict", action="store_true")
     p.add_argument("--log_level", default="info")
     p.set_defaults(fn=cmd_restore)
 
-    p = sub.add_parser("export", help="dump a snapshot as RDF/JSON")
+    p = sub.add_parser("export", help="dump a snapshot as RDF/JSON", parents=[enc])
     p.add_argument("--p", default="p")
     p.add_argument("--out", required=True)
     p.add_argument("--format", choices=("rdf", "json"), default="rdf")
-    p.add_argument("--encryption_key_file", default=None)
-    p.add_argument("--encryption_strict", action="store_true")
     p.set_defaults(fn=cmd_export)
 
-    p = sub.add_parser("debug", help="inspect a snapshot dir")
+    p = sub.add_parser("debug", help="inspect a snapshot dir", parents=[enc])
     p.add_argument("--p", default="p")
-    p.add_argument("--encryption_key_file", default=None)
-    p.add_argument("--encryption_strict", action="store_true")
     p.set_defaults(fn=cmd_debug)
 
     args = ap.parse_args(argv)

@@ -43,6 +43,79 @@ class _Renderer:
         self.ex = ex
         self.store = ex.store
         self._row_maps: dict[int, dict[int, np.ndarray]] = {}
+        # per-(leaf, rank-domain) batched lookups: one vectorized fetch
+        # per level/predicate instead of a size-1 searchsorted per node
+        # (each entry pins its domain array so id() keys stay unique)
+        self._leaf_vals: dict = {}
+        self._uid_strs: dict = {}
+        self._degrees: dict = {}
+        self._is_list: dict = {}
+        self._obj_memo: dict = {}
+        self._rec_maps: dict = {}
+        self._rec_obj_memo: dict = {}
+
+    def _rec_rows(self, parents: np.ndarray, children: np.ndarray,
+                  rank: int) -> np.ndarray:
+        """children of `rank` in a recurse edge matrix — grouped ONCE per
+        matrix (stable order preserved) instead of a full boolean scan
+        per rendered row."""
+        ent = self._rec_maps.get(id(parents))
+        if ent is None:
+            order = np.argsort(parents, kind="stable")
+            sp = parents[order]
+            uniq, starts = np.unique(sp, return_index=True)
+            ends = np.append(starts[1:], len(sp))
+            m = {int(u): children[order[s:e]]
+                 for u, s, e in zip(uniq.tolist(), starts.tolist(),
+                                    ends.tolist())}
+            ent = (m, parents)
+            self._rec_maps[id(parents)] = ent
+        return ent[0].get(rank, _EMPTY_I32)
+
+    # -- batched per-level lookups -----------------------------------------
+    def _leaf_vals_for(self, leaf, rank: int, domain) -> list:
+        if domain is None or not len(domain):
+            return self.store.values_for(leaf.attr, rank, leaf.lang)
+        key = (id(leaf), id(domain))
+        ent = self._leaf_vals.get(key)
+        if ent is None:
+            vmap = self.store.values_for_many(leaf.attr, domain, leaf.lang)
+            ent = (vmap, set(domain.tolist()), domain)
+            self._leaf_vals[key] = ent
+        vmap, dset, _pin = ent
+        if rank in vmap:
+            return vmap[rank]
+        if rank in dset:
+            return []
+        return self.store.values_for(leaf.attr, rank, leaf.lang)
+
+    def _uid_for(self, rank: int, domain) -> str:
+        if domain is None or not len(domain):
+            return _uid_str(self.store.uid_of(rank))
+        key = id(domain)
+        ent = self._uid_strs.get(key)
+        if ent is None:
+            uids = self.store.uid_of(domain)
+            ent = ({int(r): f"0x{int(u):x}"
+                    for r, u in zip(domain.tolist(), uids.tolist())},
+                   domain)
+            self._uid_strs[key] = ent
+        s = ent[0].get(rank)
+        return s if s is not None else _uid_str(self.store.uid_of(rank))
+
+    def _count_for(self, leaf, rank: int, domain) -> int:
+        rel = self.store.rel(leaf.attr, leaf.is_reverse)
+        if domain is None or not len(domain):
+            return int(rel.degree(np.array([rank]))[0])
+        key = (id(leaf), id(domain))
+        ent = self._degrees.get(key)
+        if ent is None:
+            ent = (dict(zip(domain.tolist(),
+                            rel.degree(domain).tolist())), domain)
+            self._degrees[key] = ent
+        d = ent[0].get(rank)
+        return int(d) if d is not None else \
+            int(rel.degree(np.array([rank]))[0])
 
     # -- blocks -------------------------------------------------------------
     def render_block(self, node: LevelNode) -> list:
@@ -88,8 +161,9 @@ class _Renderer:
     def node_obj(self, level: LevelNode, rank: int,
                  aliased_only: bool = False) -> dict | None:
         obj: dict = {}
+        domain = level.display if level.display is not None else level.nodes
         for leaf in level.leaf_sgs:
-            self._render_leaf(leaf, rank, obj, aliased_only)
+            self._render_leaf(leaf, rank, obj, aliased_only, domain)
         if level.recurse_data is not None:
             self._render_recurse_children(level.recurse_data, rank, obj,
                                           depth=0)
@@ -100,18 +174,17 @@ class _Renderer:
         return obj
 
     def _render_leaf(self, leaf, rank: int, obj: dict,
-                     aliased_only: bool = False) -> None:
+                     aliased_only: bool = False, domain=None) -> None:
         if leaf.is_agg or (leaf.is_count and leaf.is_uid_leaf):
             return  # block-level entries
         if aliased_only and not leaf.alias and not leaf.is_uid_leaf:
             return  # @normalize: only aliased predicates survive
         if leaf.is_uid_leaf:
-            obj[leaf.alias or "uid"] = _uid_str(self.store.uid_of(rank))
+            obj[leaf.alias or "uid"] = self._uid_for(rank, domain)
             return
         if leaf.is_count:
-            rel = self.store.rel(leaf.attr, leaf.is_reverse)
             name = leaf.alias or f"count({'~' if leaf.is_reverse else ''}{leaf.attr})"
-            obj[name] = int(rel.degree(np.array([rank]))[0])
+            obj[name] = self._count_for(leaf, rank, domain)
             return
         if leaf.is_val_leaf:
             var = self.ex.val_vars.get(leaf.attr, {})
@@ -130,12 +203,15 @@ class _Renderer:
                     obj[leaf.alias] = _json_val(v[rank])
             return
         # plain value predicate
-        vs = self.store.values_for(leaf.attr, rank, leaf.lang)
+        vs = self._leaf_vals_for(leaf, rank, domain)
         if not vs:
             return
         name = leaf.alias or (f"{leaf.attr}@{leaf.lang}" if leaf.lang else leaf.attr)
-        ps = self.store.schema.peek(leaf.attr)
-        if (ps and ps.is_list) or len(vs) > 1:
+        is_list = self._is_list.get(id(leaf))
+        if is_list is None:
+            ps = self.store.schema.peek(leaf.attr)
+            is_list = self._is_list[id(leaf)] = bool(ps and ps.is_list)
+        if is_list or len(vs) > 1:
             obj[name] = [_json_val(v) for v in vs]
         else:
             obj[name] = _json_val(vs[0])
@@ -159,13 +235,23 @@ class _Renderer:
                 child.sg.attr,
                 self.ex.facet_positions(child.sg, child.matrix_pos),
                 keys), aliases)
+        # memoize per (level, rank): a popular child (e.g. a prolific
+        # actor) appears in MANY parents' rows; its subtree renders once
+        memo_key = (id(child), aliased_only)
+        memo = self._obj_memo.get(memo_key)
+        if memo is None:
+            memo = self._obj_memo[memo_key] = {}
         lst = []
         for j, cr in enumerate(rows.tolist()):
-            o = self.node_obj(child, int(cr), aliased_only)
+            cr = int(cr)
+            o = memo.get(cr, _MISS)
+            if o is _MISS:
+                o = memo[cr] = self.node_obj(child, cr, aliased_only)
             if o is None:
                 continue
             if facet_cols is not None:
                 cols, aliases = facet_cols
+                o = dict(o)  # copy: facet annotations are per-row
                 mi = int(row_idx[j])  # position into matrix arrays
                 for k, vals in cols.items():
                     if vals[mi] is not None:
@@ -194,9 +280,13 @@ class _Renderer:
                 entries.append({leaf.alias or "count": int(len(np.unique(rows)))})
         return entries
 
+    _EMPTY_ROW = (np.zeros(0, np.int32), np.zeros(0, np.int64))
+
     def _rows(self, child: LevelNode, parent: LevelNode, rank: int):
         """Matrix row of `rank`: (child ranks in row order, their indices
-        into the matrix arrays — matrix_pos/facet columns align to these)."""
+        into the matrix arrays — matrix_pos/facet columns align to these).
+        The map is keyed by parent RANK so per-call lookup is one dict
+        get, not a numpy searchsorted."""
         m = self._row_maps.get(id(child))
         if m is None:
             m = {}
@@ -205,19 +295,19 @@ class _Renderer:
             sseg = seg[order]
             starts = np.searchsorted(sseg, np.arange(len(parent.nodes)))
             ends = np.searchsorted(sseg, np.arange(len(parent.nodes)), "right")
+            pranks = parent.nodes.tolist()
             for pos in range(len(parent.nodes)):
                 if ends[pos] > starts[pos]:
                     idx = order[starts[pos]:ends[pos]]
-                    m[pos] = (child.matrix_child[idx], idx)
+                    m[int(pranks[pos])] = (child.matrix_child[idx], idx)
             self._row_maps[id(child)] = m
-        pos = int(np.searchsorted(parent.nodes, rank))
-        return m.get(pos, (np.zeros(0, np.int32), np.zeros(0, np.int64)))
+        return m.get(rank, self._EMPTY_ROW)
 
     # -- recurse ------------------------------------------------------------
     def _render_recurse_children(self, data, rank: int, obj: dict,
                                  depth: int) -> None:
         for leaf in data.leaf_sgs:
-            self._render_leaf(leaf, rank, obj)
+            self._render_leaf(leaf, rank, obj, domain=data.all_nodes)
         if data.loop:
             if depth >= len(data.by_depth):
                 return
@@ -226,24 +316,34 @@ class _Renderer:
                 if i not in level:
                     continue
                 parents, children = level[i]
-                rows = children[parents == rank]
+                rows = self._rec_rows(parents, children, rank)
                 self._emit_recurse_rows(data, esg, rows, obj, depth + 1)
         else:
             for i, esg in enumerate(data.edge_sgs):
                 if i not in data.edges:
                     continue
                 parents, children = data.edges[i]
-                rows = children[parents == rank]
+                rows = self._rec_rows(parents, children, rank)
                 self._emit_recurse_rows(data, esg, rows, obj, depth + 1)
 
     def _emit_recurse_rows(self, data, esg, rows, obj: dict, depth: int) -> None:
         if not len(rows):
             return
         name = esg.alias or (f"~{esg.attr}" if esg.is_reverse else esg.attr)
+        # loop=false: a rank's subtree is depth-independent (its children
+        # always come from the global first-visit matrix), so a node
+        # reached by many parents renders once
+        memo = (self._rec_obj_memo.setdefault(id(data), {})
+                if not data.loop else None)
         lst = []
         for cr in rows.tolist():
-            o: dict = {}
-            self._render_recurse_children(data, int(cr), o, depth)
+            cr = int(cr)
+            o = memo.get(cr, _MISS) if memo is not None else _MISS
+            if o is _MISS:
+                o = {}
+                self._render_recurse_children(data, cr, o, depth)
+                if memo is not None:
+                    memo[cr] = o
             if o:
                 lst.append(o)
         if lst:
@@ -285,6 +385,10 @@ class _Renderer:
 
 
 # -- helpers ----------------------------------------------------------------
+
+_MISS = object()  # memo sentinel (None is a real "cascade dropped" result)
+_EMPTY_I32 = np.zeros(0, np.int32)
+
 
 def _uid_str(uid) -> str:
     return f"0x{int(uid):x}"

@@ -72,6 +72,27 @@ class ValueColumn:
         hi = np.searchsorted(self.subj, rank, side="right")
         return list(self.vals[lo:hi])
 
+    def get_many(self, ranks: np.ndarray) -> dict[int, list]:
+        """Values for a whole batch of ranks in two searchsorted calls
+        (the render path's replacement for per-node get()); ranks with
+        no value are absent from the result."""
+        ranks = np.asarray(ranks)
+        lo = np.searchsorted(self.subj, ranks, side="left")
+        hi = np.searchsorted(self.subj, ranks, side="right")
+        out: dict[int, list] = {}
+        single = (hi - lo) == 1  # the common, fully-vectorizable case
+        if single.any():
+            # iterate the numpy array, NOT .tolist(): tolist() would
+            # down-convert np scalars (datetime64 → datetime) and change
+            # downstream JSON rendering
+            out.update((int(r), [v]) for r, v in
+                       zip(ranks[single].tolist(), self.vals[lo[single]]))
+        multi = (hi - lo) > 1
+        for r, l, h in zip(ranks[multi].tolist(), lo[multi].tolist(),
+                           hi[multi].tolist()):
+            out[int(r)] = list(self.vals[l:h])
+        return out
+
     def has(self) -> np.ndarray:
         """Sorted unique ranks that have a value."""
         return np.unique(self.subj)
@@ -231,6 +252,41 @@ class Store:
                     if vs:
                         return vs
         return []
+
+    def values_for_many(self, pred: str, ranks: np.ndarray,
+                        lang: str = "") -> dict[int, list]:
+        """Batched values_for over a rank set — the JSON render path
+        fetches each (level, predicate) column ONCE instead of a
+        searchsorted pair per node. Same per-rank lang-chain fallback
+        semantics as values_for."""
+        ranks = np.asarray(ranks)
+        if not lang:
+            col = self.value_col(pred, "")
+            return col.get_many(ranks) if col is not None else {}
+        pd = self.preds.get(pred)
+        out: dict[int, list] = {}
+        remaining = ranks
+        for l in lang.split(":"):
+            if not len(remaining):
+                break
+            if l == ".":
+                langs = [""] + sorted(k for k in (pd.vals if pd else {})
+                                      if k)
+            else:
+                langs = [l]
+            for lk in langs:
+                if not len(remaining):
+                    break
+                col = self.value_col(pred, lk)
+                if col is None:
+                    continue
+                got = col.get_many(remaining)
+                if got:
+                    out.update(got)
+                    keep = np.array([r not in got
+                                     for r in remaining.tolist()])
+                    remaining = remaining[keep]
+        return out
 
     def has_ranks(self, pred: str) -> np.ndarray:
         """Sorted ranks of subjects that have `pred` (edges or values);

@@ -86,16 +86,24 @@ def active() -> bool:
     return _aead is not None
 
 
-def encrypt(data: bytes) -> bytes:
+def encrypt(data: bytes, aad: bytes = b"") -> bytes:
+    """Seal `data`. `aad` binds context (e.g. a WAL record's ordinal) so
+    a sealed blob cannot be replayed at a different position — GCM
+    authenticates it without storing it."""
     if _aead is None:
         return data
     if len(data) <= _CHUNK:
         nonce = os.urandom(_NONCE)
-        return MAGIC + nonce + _aead.encrypt(nonce, data, None)
+        return MAGIC + nonce + _aead.encrypt(nonce, data, aad or None)
+    # chunked: each chunk's AAD carries (index, total) on top of the
+    # caller context, so chunk reorder, boundary truncation, and
+    # same-key cross-splice of a different-length file all fail the tag
+    n_chunks = -(-len(data) // _CHUNK)
     parts = [MAGIC_C]
-    for off in range(0, len(data), _CHUNK):
+    for ci, off in enumerate(range(0, len(data), _CHUNK)):
         nonce = os.urandom(_NONCE)
-        ct = _aead.encrypt(nonce, data[off:off + _CHUNK], None)
+        ct = _aead.encrypt(nonce, data[off:off + _CHUNK],
+                           aad + b"|chunk:%d/%d" % (ci, n_chunks))
         parts.append(_LEN.pack(len(ct)) + nonce + ct)
     return b"".join(parts)
 
@@ -104,10 +112,10 @@ def is_encrypted(data: bytes) -> bool:
     return data[:len(MAGIC)] in (MAGIC, MAGIC_C)
 
 
-def decrypt(data: bytes) -> bytes:
+def decrypt(data: bytes, aad: bytes = b"") -> bytes:
     """Decrypt an encrypted blob; plaintext blobs pass through unchanged
     (pre-encryption files stay loadable after the key is enabled) unless
-    strict mode is on."""
+    strict mode is on. `aad` must match what encrypt() was given."""
     if not is_encrypted(data):
         if _strict and _aead is not None:
             raise VaultError(
@@ -120,15 +128,28 @@ def decrypt(data: bytes) -> bytes:
     try:
         if data[:len(MAGIC)] == MAGIC:
             nonce = data[len(MAGIC):len(MAGIC) + _NONCE]
-            return _aead.decrypt(nonce, data[len(MAGIC) + _NONCE:], None)
-        out, off = [], len(MAGIC_C)
+            return _aead.decrypt(nonce, data[len(MAGIC) + _NONCE:],
+                                 aad or None)
+        # first pass counts chunks (the (index, total) AAD needs the
+        # total up front to reject boundary truncation)
+        n_chunks, off = 0, len(MAGIC_C)
+        while off < len(data):
+            (clen,) = _LEN.unpack_from(data, off)
+            off += _LEN.size + _NONCE + clen
+            n_chunks += 1
+        if off != len(data):
+            raise VaultError("decryption failed: truncated chunk stream")
+        out, off, ci = [], len(MAGIC_C), 0
         while off < len(data):
             (clen,) = _LEN.unpack_from(data, off)
             off += _LEN.size
             nonce = data[off:off + _NONCE]
             off += _NONCE
-            out.append(_aead.decrypt(nonce, data[off:off + clen], None))
+            out.append(_aead.decrypt(
+                nonce, data[off:off + clen],
+                aad + b"|chunk:%d/%d" % (ci, n_chunks)))
             off += clen
+            ci += 1
         return b"".join(out)
     except VaultError:
         raise

@@ -109,8 +109,9 @@ class Journal:
         # A torn tail from a previous crash must be cut BEFORE appending:
         # records written after corrupt bytes would be unreachable by
         # replay (it stops at the first bad record) — acked-but-invisible.
+        self._seq = 0  # ordinal of the next record (encryption AAD)
         if os.path.exists(path):
-            valid_end = _valid_end(path)
+            valid_end, self._seq = _scan_state(path)
             if valid_end < os.path.getsize(path):
                 with open(path, "r+b") as f:
                     f.truncate(valid_end)
@@ -120,24 +121,29 @@ class Journal:
         self._f = open(path, "ab")
 
     @staticmethod
-    def _frame(doc: dict) -> bytes:
+    def _frame(doc: dict, seq: int) -> bytes:
         # with encryption-at-rest active, each record payload is
-        # AES-GCM-sealed individually; the CRC covers the ciphertext so
-        # torn-tail truncation works without the key (store/vault.py)
+        # AES-GCM-sealed individually with its ORDINAL as associated
+        # data — a sealed record cannot be reordered, duplicated, or
+        # spliced in at another position without failing the tag. The
+        # CRC covers the ciphertext so torn-tail truncation still works
+        # without the key (store/vault.py).
         payload = vault.encrypt(
-            json.dumps(doc, separators=(",", ":")).encode())
+            json.dumps(doc, separators=(",", ":")).encode(),
+            aad=_rec_aad(seq))
         return MAGIC + _HEADER.pack(len(payload),
                                     zlib.crc32(payload)) + payload
 
     def append(self, doc: dict) -> None:
         # concurrent appenders (apply broadcasts race local commits) must
         # not interleave record bytes
-        rec = self._frame(doc)
         with self._wlock:
+            rec = self._frame(doc, self._seq)
             self._f.write(rec)
             self._f.flush()
             if self.sync:
                 os.fsync(self._f.fileno())
+            self._seq += 1
 
     def rewrite(self, docs) -> None:
         """Atomically replace the log's contents (temp file + rename).
@@ -145,14 +151,17 @@ class Journal:
         must neither hit a closed file nor land on the replaced inode."""
         with self._wlock:
             tmp = self.path + ".tmp"
+            seq = 0
             with open(tmp, "wb") as f:
                 for doc in docs:
-                    f.write(self._frame(doc))
+                    f.write(self._frame(doc, seq))
+                    seq += 1
                 f.flush()
                 os.fsync(f.fileno())
             self._f.close()
             os.replace(tmp, self.path)
             self._f = open(self.path, "ab")
+            self._seq = seq
 
     @staticmethod
     def replay(path: str):
@@ -160,8 +169,8 @@ class Journal:
             return
         with open(path, "rb") as f:
             data = f.read()
-        for _off, payload in _scan(data):
-            yield json.loads(vault.decrypt(payload))
+        for seq, (_off, payload) in enumerate(_scan(data)):
+            yield json.loads(vault.decrypt(payload, aad=_rec_aad(seq)))
 
     def close(self) -> None:
         self._f.close()
@@ -210,14 +219,24 @@ def _scan(data: bytes) -> Iterator[tuple[int, bytes]]:
         yield off, payload
 
 
-def _valid_end(path: str) -> int:
-    """Byte offset where the intact record prefix ends."""
+def _rec_aad(seq: int) -> bytes:
+    return b"wal-rec:%d" % seq
+
+
+def _scan_state(path: str) -> tuple[int, int]:
+    """(byte offset where the intact record prefix ends, record count)."""
     with open(path, "rb") as f:
         data = f.read()
-    end = 0
+    end = n = 0
     for off, _payload in _scan(data):
         end = off
-    return end
+        n += 1
+    return end, n
+
+
+def _valid_end(path: str) -> int:
+    """Byte offset where the intact record prefix ends."""
+    return _scan_state(path)[0]
 
 
 def replay(path: str) -> Iterator[tuple[int, str, object]]:
@@ -228,8 +247,8 @@ def replay(path: str) -> Iterator[tuple[int, str, object]]:
         return
     with open(path, "rb") as f:
         data = f.read()
-    for _off, payload in _scan(data):
-        doc = json.loads(vault.decrypt(payload))
+    for seq, (_off, payload) in enumerate(_scan(data)):
+        doc = json.loads(vault.decrypt(payload, aad=_rec_aad(seq)))
         if "schema" in doc:
             yield int(doc["ts"]), "schema", doc["schema"]
         elif "drop" in doc:

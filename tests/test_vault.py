@@ -108,6 +108,51 @@ def test_magic_collision_escape(tmp_path):
         vault.set_key(None)
 
 
+def test_wal_record_reorder_rejected(tmp_path):
+    """Sealed WAL records are bound to their ordinal via GCM associated
+    data: swapping two records keeps both CRCs and tags internally valid
+    but fails authentication on replay."""
+    from dgraph_tpu.store.wal import _scan
+    vault.set_key(KEY)
+    path = str(tmp_path / "wal.log")
+    w = WAL(path, sync=False)
+    w.append(Mutation(edge_sets=[(1, "friend", 2, None)]), 5)
+    w.append(Mutation(edge_sets=[(2, "friend", 3, None)]), 6)
+    w.close()
+    data = open(path, "rb").read()
+    recs = []
+    prev = 0
+    for off, _payload in _scan(data):
+        recs.append(data[prev:off])
+        prev = off
+    open(path, "wb").write(recs[1] + recs[0])  # swap
+    with pytest.raises(vault.VaultError):
+        list(replay(path))
+
+
+def test_chunk_reorder_and_truncation_rejected(monkeypatch):
+    monkeypatch.setattr(vault, "_CHUNK", 1000)
+    vault.set_key(KEY)
+    data = os.urandom(2000)  # exactly 2 chunks
+    ct = vault.encrypt(data)
+    assert vault.decrypt(ct) == data
+    # parse the chunk stream and swap the two chunks
+    import struct
+    off = 4
+    chunks = []
+    while off < len(ct):
+        (clen,) = struct.unpack_from("<Q", ct, off)
+        chunks.append(ct[off:off + 8 + 12 + clen])
+        off += 8 + 12 + clen
+    swapped = ct[:4] + chunks[1] + chunks[0]
+    with pytest.raises(vault.VaultError):
+        vault.decrypt(swapped)
+    # clean truncation at a chunk boundary also fails (total count is
+    # part of each chunk's AAD)
+    with pytest.raises(vault.VaultError):
+        vault.decrypt(ct[:4] + chunks[0])
+
+
 def test_key_sizes_and_key_file(tmp_path):
     with pytest.raises(vault.VaultError):
         vault.set_key(b"short")
