@@ -109,8 +109,27 @@ def cmd_alpha(args) -> int:
                 except Exception:  # noqa: BLE001 — heartbeat must survive
                     log.debug("tablet size report failed", exc_info=True)
 
+        def liveness_heartbeat():
+            # liveness ping + applied watermarks (reference: membership
+            # heartbeat; the watermarks seed a promoted standby's lease
+            # floor). Survives a zero failover via the client's
+            # multi-target rotation.
+            import time as _time
+            while True:
+                _time.sleep(args.heartbeat)
+                try:
+                    ts = max(alpha.mvcc.base_ts,
+                             max((l.commit_ts for l in alpha.mvcc.layers),
+                                 default=0))
+                    zero.heartbeat(alpha.groups.node_id,
+                                   group=alpha.groups.gid, max_ts=ts,
+                                   max_uid=alpha.mvcc.max_uid_seen)
+                except Exception:  # noqa: BLE001 — heartbeat must survive
+                    log.debug("zero heartbeat failed", exc_info=True)
+
         import threading
         threading.Thread(target=size_heartbeat, daemon=True).start()
+        threading.Thread(target=liveness_heartbeat, daemon=True).start()
     http_server = make_http_server(alpha, cfg.http_addr, cfg.http_port)
     serve_background(http_server)
     log.info("alpha up: grpc=%d http=%d", grpc_port,
@@ -138,12 +157,28 @@ def cmd_zero(args) -> int:
     state = ZeroState(
         replicas=args.replicas,
         journal_path=(f"{args.w}/zero.journal" if args.w else None),
-        txn_timeout_s=args.txn_timeout)
+        txn_timeout_s=args.txn_timeout,
+        liveness_s=args.liveness,
+        standby=bool(args.peer))
     server, port, _state = make_zero_server(state,
                                             f"127.0.0.1:{args.port}")
     server.start()
-    log.info("zero up: grpc=%d replicas=%d journal=%s", port,
-             args.replicas, args.w or "off")
+    log.info("zero up: grpc=%d replicas=%d journal=%s role=%s", port,
+             args.replicas, args.w or "off",
+             "standby" if args.peer else "primary")
+    if args.peer:
+        # standby: tail the primary's state machine; promote when it
+        # stays dark (reference: group-0 follower + failover)
+        from dgraph_tpu.cluster.zero import run_standby
+
+        def standby_loop():
+            if run_standby(state, args.peer,
+                           promote_after_s=args.promote_after):
+                log.warning("primary %s unreachable %.1fs — PROMOTED; "
+                            "now serving leases", args.peer,
+                            args.promote_after)
+
+        threading.Thread(target=standby_loop, daemon=True).start()
 
     def maintenance():
         import time
@@ -284,7 +319,10 @@ def main(argv=None) -> int:
                         "JAX_COORDINATOR_ADDRESS/JAX_NUM_PROCESSES/"
                         "JAX_PROCESS_ID also works")
     p.add_argument("--zero", default=None,
-                   help="zero address → join a cluster")
+                   help="zero address(es) → join a cluster; a comma-"
+                        "separated list fails over (primary,standby)")
+    p.add_argument("--heartbeat", type=float, default=3.0,
+                   help="seconds between zero liveness heartbeats")
     p.add_argument("--group", type=int, default=0,
                    help="raft-group analog to join (0 = zero picks)")
     p.add_argument("--log_level", default="info")
@@ -301,6 +339,15 @@ def main(argv=None) -> int:
                         "transaction lifetime (0 = never)")
     p.add_argument("--rebalance", action="store_true",
                    help="enable the size-based tablet rebalance loop")
+    p.add_argument("--peer", default=None,
+                   help="primary zero address → run as a STANDBY that "
+                        "tails its journal and promotes on failure")
+    p.add_argument("--promote_after", type=float, default=5.0,
+                   help="standby promotes after the primary is dark "
+                        "this long")
+    p.add_argument("--liveness", type=float, default=10.0,
+                   help="mark an alpha dead after this many seconds "
+                        "without a heartbeat (0 = off)")
     p.add_argument("--log_level", default="info")
     p.set_defaults(fn=cmd_zero)
 

@@ -27,6 +27,12 @@ SERVICE_ZERO = "dgraph_tpu.Zero"
 
 
 LEASE_BLOCK = 1000   # ts/uid leases persist at block granularity
+# HA tuning: how far (in lease blocks) issuance may outrun the standby's
+# replication ack, how long a silent standby stays attached (and gating),
+# and the doc_log length that triggers compaction when nothing is tailing
+MAX_UNACKED_BLOCKS = 4
+STANDBY_GRACE_S = 15.0
+DOC_LOG_CAP = 8192
 
 
 class ZeroState:
@@ -41,10 +47,12 @@ class ZeroState:
     trade the reference's batched lease makes."""
 
     def __init__(self, replicas: int = 1, journal_path: str | None = None,
-                 txn_timeout_s: float = 0.0):
+                 txn_timeout_s: float = 0.0, liveness_s: float = 10.0,
+                 standby: bool = False):
         self.oracle = Oracle()
         self.replicas = replicas
         self.txn_timeout_s = txn_timeout_s
+        self.liveness_s = liveness_s
         self._lock = threading.Lock()
         self._next_node = 1
         self._next_group = 1
@@ -55,6 +63,36 @@ class ZeroState:
         # group_id -> {pred: approx bytes} (rebalance input)
         self.tablet_sizes: dict[int, dict[str, int]] = {}
         self.counter = 0
+        # node_id -> monotonic last-heard time (liveness; reference: the
+        # membership-stream health Zero keeps per Alpha)
+        self.last_seen: dict[int, float] = {}
+        # every state-machine doc in order, JSON-encoded — the standby
+        # replication log (reference: the group-0 raft log followers
+        # tail). _doc_base is the absolute index of doc_log[0]: a primary
+        # with no attached standby compacts the prefix, and a follower
+        # landing below the base bootstraps from a state snapshot doc.
+        self.doc_log: list[str] = []
+        self._doc_base = 0
+        # (ts_block, uid_block) AFTER each doc — journal_tail derives the
+        # follower's acked lease floor from these
+        self._blocks_at: list[tuple[int, int]] = []
+        # identity of this doc stream; a follower seeing it change knows
+        # the primary restarted with a fresh log and resyncs from zero
+        self.log_id = ""
+        # replication ack state (primary side): highest doc index a
+        # standby confirmed + the lease blocks covered by it; issuance is
+        # gated so a promoted standby's floor always clears every id the
+        # primary ever returned (see lease_headroom_ok)
+        self._standby_acked = 0
+        self._standby_seen_at = 0.0
+        self._acked_ts_block = 0
+        self._acked_uid_block = 0
+        # standby mode: replays a primary's journal, refuses
+        # lease/commit/connect RPCs until promoted
+        self.standby = standby
+        # after promotion: txns started under the old primary (start_ts
+        # at or below this) abort — their conflict history died with it
+        self.promote_floor = 0
         self._journal = None
         self._ts_block = 0
         self._uid_block = 0
@@ -63,13 +101,26 @@ class ZeroState:
             for doc in Journal.replay(journal_path):
                 self._replay(doc)
             self._journal = Journal(journal_path)
+        if not standby and not self.log_id:
+            import uuid
+            self.log_id = uuid.uuid4().hex
+            self._log({"k": "logid", "v": self.log_id})
+        # nodes restored from the journal get a full liveness window to
+        # report in before being declared dead
+        import time as _time
+        now = _time.monotonic()
+        for nodes in self.groups.values():
+            for nid in nodes:
+                self.last_seen.setdefault(nid, now)
 
     def _replay(self, doc: dict) -> None:
+        import time as _time
         k = doc["k"]
         if k == "join":
             self.groups.setdefault(doc["g"], {})[doc["n"]] = doc["a"]
             self._next_node = max(self._next_node, doc["n"] + 1)
             self._next_group = max(self._next_group, doc["g"] + 1)
+            self.last_seen.setdefault(doc["n"], _time.monotonic())
         elif k == "tablet":
             self.tablets[doc["p"]] = doc["g"]
         elif k == "remove":
@@ -81,17 +132,72 @@ class ZeroState:
         elif k == "uid":
             self._uid_block = max(self._uid_block, doc["v"])
             self.oracle.bump_uid(doc["v"])
+        elif k == "promote":
+            self.promote_floor = max(self.promote_floor, doc["v"])
+        elif k == "logid":
+            self.log_id = doc["v"]
+        elif k == "snap":
+            # full-state bootstrap (the primary compacted its log below
+            # our cursor): replace membership/tablets wholesale; lease
+            # floors only ever ratchet up
+            self.groups = {int(g): {int(n): a for n, a in nodes.items()}
+                           for g, nodes in doc["groups"].items()}
+            self.tablets = dict(doc["tablets"])
+            self._next_node = doc["nn"]
+            self._next_group = doc["ng"]
+            self._ts_block = max(self._ts_block, doc["tsb"])
+            self._uid_block = max(self._uid_block, doc["uidb"])
+            self.oracle.bump_ts(doc["tsb"])
+            self.oracle.bump_uid(doc["uidb"])
+            self.promote_floor = max(self.promote_floor, doc["pf"])
+            now = _time.monotonic()
+            for nodes in self.groups.values():
+                for nid in nodes:
+                    self.last_seen.setdefault(nid, now)
         self.counter += 1
+        self._append_doc(doc)
+
+    def _append_doc(self, doc: dict) -> None:
+        import json as _json
+        self.doc_log.append(_json.dumps(doc, separators=(",", ":")))
+        self._blocks_at.append((self._ts_block, self._uid_block))
 
     def _log(self, doc: dict) -> None:
+        self._append_doc(doc)
         if self._journal is not None:
             self._journal.append(doc)
+        self._maybe_compact()
+
+    def _snap_doc(self) -> dict:
+        return {"k": "snap",
+                "groups": {g: dict(n) for g, n in self.groups.items()},
+                "tablets": dict(self.tablets),
+                "nn": self._next_node, "ng": self._next_group,
+                "tsb": self._ts_block, "uidb": self._uid_block,
+                "pf": self.promote_floor}
+
+    def _maybe_compact(self) -> None:
+        """Bound doc_log memory on a primary nothing is tailing (lease
+        docs accrete one per block forever). With a recently-attached
+        standby the log is left alone; a follower that lands below the
+        compacted base bootstraps from a snapshot doc instead."""
+        import time as _time
+        if len(self.doc_log) <= DOC_LOG_CAP:
+            return
+        if self._standby_seen_at and \
+                _time.monotonic() - self._standby_seen_at < STANDBY_GRACE_S:
+            return
+        drop = len(self.doc_log) // 2
+        self._doc_base += drop
+        del self.doc_log[:drop]
+        del self._blocks_at[:drop]
 
     def persist_leases(self) -> None:
         """Journal the lease watermarks at block granularity — called on
-        the issuing paths, fsyncs only when a block boundary is crossed."""
-        if self._journal is None:
-            return
+        the issuing paths, fsyncs only when a block boundary is crossed.
+        Runs even without a file journal: the in-memory doc_log is what a
+        STANDBY tails, and it must see lease blocks to keep its oracle
+        floor current."""
         ts = self.oracle.max_assigned
         uid = self.oracle.max_uid
         with self._lock:
@@ -109,6 +215,135 @@ class ZeroState:
         if not self.txn_timeout_s:
             return 0
         return self.oracle.expire_older_than(self.txn_timeout_s)
+
+    # -- liveness + standby replication (reference: membership health
+    # stream + group-0 raft log shipping) --------------------------------
+    def heartbeat(self, node_id: int, group: int = 0, max_ts: int = 0,
+                  max_uid: int = 0) -> None:
+        """Alpha liveness ping. The applied watermarks ride along so a
+        freshly-promoted standby's lease space climbs past everything any
+        live Alpha has actually seen."""
+        import time as _time
+        with self._lock:
+            self.last_seen[node_id] = _time.monotonic()
+        if max_ts:
+            self.oracle.bump_ts(max_ts)
+        if max_uid:
+            self.oracle.bump_uid(max_uid)
+
+    def dead_nodes(self) -> list[int]:
+        """Known nodes not heard from within the liveness window."""
+        import time as _time
+        if not self.liveness_s:
+            return []
+        now = _time.monotonic()
+        with self._lock:
+            known = {nid for nodes in self.groups.values() for nid in nodes}
+            return sorted(
+                nid for nid in known
+                if now - self.last_seen.get(nid, now) > self.liveness_s)
+
+    def journal_tail(self, since: int) -> tuple[list[str], int]:
+        """State-machine docs after absolute index `since` (follower
+        pull). The call doubles as the replication ACK: everything below
+        `since` provably arrived, which advances the acked lease floor
+        that gates issuance (lease_headroom_ok). A cursor below the
+        compacted base gets a full-state snapshot doc instead."""
+        import json as _json
+        import time as _time
+        with self._lock:
+            self._standby_seen_at = _time.monotonic()
+            if since > self._standby_acked:
+                self._standby_acked = since
+                pos = since - self._doc_base - 1
+                if 0 <= pos < len(self._blocks_at):
+                    self._acked_ts_block, self._acked_uid_block = \
+                        self._blocks_at[pos]
+            end = self._doc_base + len(self.doc_log)
+            if since < self._doc_base:
+                return [_json.dumps(self._snap_doc(),
+                                    separators=(",", ":"))], end
+            return self.doc_log[since - self._doc_base:], end
+
+    def lease_headroom_ok(self, n_ts: int = 1, n_uid: int = 0) -> bool:
+        """Issuance gate: with a standby attached, never hand out an id
+        more than MAX_UNACKED_BLOCKS lease blocks past what the standby
+        has confirmed — so its promotion floor (replayed blocks + the
+        same margin) always clears every id this primary ever returned.
+        The WHOLE grant counts (AssignUids hands out n ids in one call:
+        the last id of the grant must stay under the margin, not just
+        the first). A standby dark past STANDBY_GRACE_S detaches and the
+        gate lifts (availability over safety, as any 2-node HA must
+        choose)."""
+        import time as _time
+        with self._lock:
+            if not self._standby_seen_at or _time.monotonic() - \
+                    self._standby_seen_at > STANDBY_GRACE_S:
+                return True
+            margin = MAX_UNACKED_BLOCKS * LEASE_BLOCK
+            return (self.oracle.max_assigned + n_ts
+                    <= self._acked_ts_block + margin
+                    and self.oracle.max_uid + n_uid
+                    <= self._acked_uid_block + margin)
+
+    def apply_remote(self, docs_json: list[str]) -> None:
+        """Standby: replay docs pulled from the primary, persisting them
+        to our own journal so a standby restart (or chained standby)
+        keeps the full log."""
+        import json as _json
+        for dj in docs_json:
+            doc = _json.loads(dj)
+            with self._lock:
+                # _replay appends to doc_log; mirror into our file journal
+                self._replay(doc)
+            if self._journal is not None:
+                self._journal.append(doc)
+
+    def reset_replica(self) -> None:
+        """Standby resync-from-scratch (the primary's log identity
+        changed): drop replicated membership state and our journal, keep
+        the oracle floors and promote_floor — those only ratchet up and
+        guard ts/uid uniqueness across regimes."""
+        with self._lock:
+            self.groups.clear()
+            self.tablets.clear()
+            self.tablet_sizes.clear()
+            self.doc_log.clear()
+            self._blocks_at.clear()
+            self._doc_base = 0
+            self.counter = 0
+            self.log_id = ""
+            self._next_node = 1
+            self._next_group = 1
+            if self._journal is not None:
+                self._journal.rewrite([])
+
+    def promote(self) -> None:
+        """Standby → primary. The primary's issuance gate guarantees it
+        never returned an id more than MAX_UNACKED_BLOCKS blocks past our
+        last acked pull, so replayed blocks + that margin + 1 clears
+        everything it ever handed out; the promote floor then aborts
+        txns whose conflict history died with the old process."""
+        margin = (MAX_UNACKED_BLOCKS + 1) * LEASE_BLOCK
+        floor = max(self.oracle.max_assigned, self._ts_block)
+        self.oracle.bump_ts((floor // LEASE_BLOCK) * LEASE_BLOCK + margin)
+        self.oracle.bump_uid(
+            (max(self.oracle.max_uid, self._uid_block) // LEASE_BLOCK)
+            * LEASE_BLOCK + margin)
+        import time as _time
+        now = _time.monotonic()
+        with self._lock:
+            self.promote_floor = max(self.promote_floor,
+                                     self.oracle.max_assigned)
+            self._log({"k": "promote", "v": self.promote_floor})
+            self.counter += 1
+            self.standby = False
+            # the failover window ate everyone's heartbeats: restart the
+            # liveness clocks rather than declaring the fleet dead
+            for nodes in self.groups.values():
+                for nid in nodes:
+                    self.last_seen[nid] = now
+        self.persist_leases()
 
     def report_sizes(self, group: int, sizes: dict[str, int]) -> None:
         with self._lock:
@@ -162,6 +397,7 @@ class ZeroState:
         # the next lease-issuing RPC would otherwise replay lower blocks
         # and re-lease ids the joiner's store already holds
         self.persist_leases()
+        import time as _time
         with self._lock:
             # a rejoining node reclaims its recorded identity by address —
             # a journal-replayed membership must not trap a restarted
@@ -170,6 +406,7 @@ class ZeroState:
             for g, nodes in self.groups.items():
                 for nid, a in nodes.items():
                     if a == addr and (not group or group == g):
+                        self.last_seen[nid] = _time.monotonic()
                         return nid, g
             node_id = self._next_node
             self._next_node += 1
@@ -183,6 +420,7 @@ class ZeroState:
                     gid = self._next_group
             self.groups.setdefault(gid, {})[node_id] = addr
             self._next_group = max(self._next_group, gid + 1)
+            self.last_seen[node_id] = _time.monotonic()
             self._log({"k": "join", "n": node_id, "g": gid, "a": addr})
             self.counter += 1
             return node_id, gid
@@ -207,8 +445,10 @@ class ZeroState:
             return owner
 
     def membership(self) -> pb.MembershipState:
+        dead = self.dead_nodes()
         with self._lock:
             st = pb.MembershipState(counter=self.counter)
+            st.dead.extend(dead)
             for gid, nodes in self.groups.items():
                 g = pb.Group()
                 for nid, addr in nodes.items():
@@ -223,7 +463,27 @@ class ZeroService:
     def __init__(self, state: ZeroState):
         self.state = state
 
+    def _primary_only(self, ctx) -> None:
+        """Lease/commit/membership-mutating RPCs are refused while in
+        standby — a client holding both addresses must not split-brain
+        the lease space (reference: only the group-0 raft leader
+        serves)."""
+        if self.state.standby:
+            ctx.abort(grpc.StatusCode.FAILED_PRECONDITION,
+                      "zero is a standby (not promoted)")
+
+    def _lease_gate(self, ctx, n_ts: int = 1, n_uid: int = 0) -> None:
+        """Refuse id issuance that would outrun the attached standby's
+        replication ack — the invariant a safe promotion floor rests on."""
+        if n_ts + n_uid >= MAX_UNACKED_BLOCKS * LEASE_BLOCK:
+            ctx.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                      "grant larger than the replication margin")
+        if not self.state.lease_headroom_ok(n_ts, n_uid):
+            ctx.abort(grpc.StatusCode.UNAVAILABLE,
+                      "lease space awaiting standby replication; retry")
+
     def Connect(self, req: pb.ConnectRequest, ctx) -> pb.ConnectResponse:
+        self._primary_only(ctx)
         nid, gid = self.state.connect(req.addr, int(req.group),
                                       int(req.max_ts), int(req.max_uid))
         return pb.ConnectResponse(node_id=nid, group_id=gid)
@@ -232,19 +492,37 @@ class ZeroService:
         return self.state.membership()
 
     def ShouldServe(self, req: pb.TabletRequest, ctx) -> pb.Tablet:
+        self._primary_only(ctx)
         owner = self.state.should_serve(req.pred, int(req.group))
         return pb.Tablet(pred=req.pred, group=owner)
 
     def Timestamps(self, req: pb.TsRequest, ctx) -> pb.AssignedIds:
+        self._primary_only(ctx)
+        self._lease_gate(ctx)
         o = self.state.oracle
         ts = o.read_only_ts() if req.read_only else o.read_ts()
         self.state.persist_leases()
         return pb.AssignedIds(start_id=ts, end_id=ts)
 
     def AssignUids(self, req: pb.AssignRequest, ctx) -> pb.AssignedIds:
+        self._primary_only(ctx)
+        self._lease_gate(ctx, n_ts=0, n_uid=int(req.num))
         r = self.state.oracle.assign_uids(int(req.num))
         self.state.persist_leases()
         return pb.AssignedIds(start_id=r.start, end_id=r.stop - 1)
+
+    def Heartbeat(self, req: pb.HeartbeatMsg, ctx) -> pb.Payload:
+        # standbys accept heartbeats too: the watermarks seed their lease
+        # floor for promotion
+        self.state.heartbeat(int(req.node_id), int(req.group),
+                             int(req.max_ts), int(req.max_uid))
+        return pb.Payload(data=b"ok")
+
+    def JournalTail(self, req: pb.JournalTailRequest, ctx) -> pb.JournalDocs:
+        docs, nxt = self.state.journal_tail(int(req.since))
+        return pb.JournalDocs(docs_json=docs, next=nxt,
+                              standby=self.state.standby,
+                              log_id=self.state.log_id)
 
     def ReportTablets(self, req: pb.TabletSizes, ctx) -> pb.Payload:
         self.state.report_sizes(int(req.group), dict(req.sizes))
@@ -255,9 +533,18 @@ class ZeroService:
         return pb.Payload(data=b"ok" if ok else b"noop")
 
     def Commit(self, req: pb.CommitRequest, ctx) -> pb.TxnContext:
+        self._primary_only(ctx)
         if req.abort:
             self.state.oracle.abort(int(req.start_ts))
             return pb.TxnContext(start_ts=req.start_ts, aborted=True)
+        self._lease_gate(ctx)
+        if self.state.promote_floor and \
+                int(req.start_ts) <= self.state.promote_floor:
+            # the txn began under the dead primary: its conflict history
+            # (and any concurrent committers it raced) died with that
+            # process — abort rather than risk a lost-update
+            ctx.abort(grpc.StatusCode.ABORTED,
+                      "txn predates zero failover; retry")
         try:
             cts = self.state.oracle.commit(int(req.start_ts),
                                            list(req.keys))
@@ -334,6 +621,45 @@ def rebalance_once(state: ZeroState) -> bool:
     return move_tablet(state, pred, dst)
 
 
+def run_standby(state: ZeroState, primary_addr: str, poll_s: float = 1.0,
+                promote_after_s: float = 5.0, stop_event=None) -> bool:
+    """Standby loop: tail the primary's state-machine journal into
+    `state`; when the primary stays unreachable past `promote_after_s`,
+    promote and take over (reference: group-0 raft follower election,
+    collapsed to a single designated successor). Returns True when
+    promoted, False when stopped externally.
+
+    A restarted standby resumes from its own replayed log length; a
+    log-identity change (the primary restarted with a fresh log) resets
+    the replica and resyncs from zero."""
+    import time as _time
+    client = ZeroClient(primary_addr)
+    since = state._doc_base + len(state.doc_log)
+    expect_id = state.log_id or None
+    last_ok = _time.monotonic()
+    while stop_event is None or not stop_event.is_set():
+        try:
+            docs, nxt, _standby, log_id = client.journal_tail_full(since)
+            if (expect_id is not None and log_id and log_id != expect_id) \
+                    or nxt < since:
+                state.reset_replica()
+                since = 0
+                expect_id = log_id or None
+                continue
+            if log_id and expect_id is None:
+                expect_id = log_id
+            if docs:
+                state.apply_remote(docs)
+            since = nxt
+            last_ok = _time.monotonic()
+        except grpc.RpcError:
+            if _time.monotonic() - last_ok > promote_after_s:
+                state.promote()
+                return True
+        _time.sleep(poll_s)
+    return False
+
+
 def _unary(fn, req_cls):
     return grpc.unary_unary_rpc_method_handler(
         fn, request_deserializer=req_cls.FromString,
@@ -356,6 +682,8 @@ def make_zero_server(state: ZeroState | None = None,
             "Commit": _unary(svc.Commit, pb.CommitRequest),
             "ReportTablets": _unary(svc.ReportTablets, pb.TabletSizes),
             "MoveTablet": _unary(svc.MoveTablet, pb.MoveTabletRequest),
+            "Heartbeat": _unary(svc.Heartbeat, pb.HeartbeatMsg),
+            "JournalTail": _unary(svc.JournalTail, pb.JournalTailRequest),
         }),))
     port = server.add_insecure_port(addr)
     return server, port, state
@@ -363,17 +691,41 @@ def make_zero_server(state: ZeroState | None = None,
 
 class ZeroClient:
     """Client to a Zero service (reference: the zero conn every Alpha
-    holds)."""
+    holds). `target` may be a comma-separated failover list
+    ("primary:5080,standby:5081"): connectivity errors and standby
+    refusals rotate to the next address; semantic errors (txn aborts)
+    propagate."""
 
     def __init__(self, target: str):
-        self.channel = grpc.insecure_channel(target)
+        self.targets = [t.strip() for t in target.split(",") if t.strip()]
+        self._chans: dict[str, grpc.Channel] = {}
+        self._cur = 0
+
+    @property
+    def channel(self) -> grpc.Channel:
+        t = self.targets[self._cur]
+        ch = self._chans.get(t)
+        if ch is None:
+            ch = self._chans[t] = grpc.insecure_channel(t)
+        return ch
 
     def _call(self, method: str, req, resp_cls):
-        rpc = self.channel.unary_unary(
-            f"/{SERVICE_ZERO}/{method}",
-            request_serializer=lambda m: m.SerializeToString(),
-            response_deserializer=resp_cls.FromString)
-        return rpc(req)
+        last_err = None
+        for attempt in range(len(self.targets)):
+            rpc = self.channel.unary_unary(
+                f"/{SERVICE_ZERO}/{method}",
+                request_serializer=lambda m: m.SerializeToString(),
+                response_deserializer=resp_cls.FromString)
+            try:
+                return rpc(req)
+            except grpc.RpcError as e:
+                if e.code() == grpc.StatusCode.ABORTED or \
+                        len(self.targets) == 1:
+                    raise  # semantic (txn abort) or nowhere to go
+                # connectivity / standby refusal: try the next zero
+                last_err = e
+                self._cur = (self._cur + 1) % len(self.targets)
+        raise last_err
 
     def connect(self, addr: str, group: int = 0, max_ts: int = 0,
                 max_uid: int = 0) -> tuple[int, int]:
@@ -422,13 +774,32 @@ class ZeroClient:
         self._call("ReportTablets",
                    pb.TabletSizes(group=group, sizes=sizes), pb.Payload)
 
+    def heartbeat(self, node_id: int, group: int = 0, max_ts: int = 0,
+                  max_uid: int = 0) -> None:
+        self._call("Heartbeat", pb.HeartbeatMsg(
+            node_id=node_id, group=group, max_ts=max_ts, max_uid=max_uid),
+            pb.Payload)
+
+    def journal_tail(self, since: int) -> tuple[list[str], int, bool]:
+        docs, nxt, standby, _ = self.journal_tail_full(since)
+        return docs, nxt, standby
+
+    def journal_tail_full(self, since: int) \
+            -> tuple[list[str], int, bool, str]:
+        r = self._call("JournalTail", pb.JournalTailRequest(since=since),
+                       pb.JournalDocs)
+        return (list(r.docs_json), int(r.next), bool(r.standby),
+                str(r.log_id))
+
     def move_tablet(self, pred: str, dst_group: int) -> bool:
         r = self._call("MoveTablet", pb.MoveTabletRequest(
             pred=pred, dst_group=dst_group), pb.Payload)
         return r.data == b"ok"
 
     def close(self):
-        self.channel.close()
+        for ch in self._chans.values():
+            ch.close()
+        self._chans.clear()
 
 
 class RemoteOracle:

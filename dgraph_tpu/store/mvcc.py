@@ -113,6 +113,9 @@ class MVCCStore:
         self._history: list[tuple[int, Store]] = [(base_ts, base)]
         self.layers: list[_Layer] = []       # all retained, ascending ts
         self._views: dict[tuple, Store] = {}
+        # highest uid this store has ever held — the heartbeat watermark
+        # that seeds a promoted standby zero's uid lease floor
+        self.max_uid_seen = int(base.uids[-1]) if base.n_nodes else 0
 
     # -- current base (newest fold point) ------------------------------------
     @property
@@ -143,6 +146,8 @@ class MVCCStore:
             import bisect
             bisect.insort(self.layers, _Layer(commit_ts, mut),
                           key=lambda l: l.commit_ts)
+            self.max_uid_seen = max(self.max_uid_seen,
+                                    max(mut.all_uids(), default=0))
 
     def has_applied(self, commit_ts: int) -> bool:
         """Whether a commit_ts is present as a retained delta layer.
@@ -172,6 +177,8 @@ class MVCCStore:
             import bisect
             bisect.insort(self.layers, _Layer(commit_ts, mut),
                           key=lambda l: l.commit_ts)
+            self.max_uid_seen = max(self.max_uid_seen,
+                                    max(mut.all_uids(), default=0))
             self._views.clear()
 
     # -- read path ----------------------------------------------------------

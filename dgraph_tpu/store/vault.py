@@ -139,18 +139,27 @@ def decrypt(data: bytes, aad: bytes = b"") -> bytes:
             n_chunks += 1
         if off != len(data):
             raise VaultError("decryption failed: truncated chunk stream")
-        out, off, ci = [], len(MAGIC_C), 0
-        while off < len(data):
-            (clen,) = _LEN.unpack_from(data, off)
-            off += _LEN.size
-            nonce = data[off:off + _NONCE]
-            off += _NONCE
-            out.append(_aead.decrypt(
-                nonce, data[off:off + clen],
-                aad + b"|chunk:%d/%d" % (ci, n_chunks)))
-            off += clen
-            ci += 1
-        return b"".join(out)
+
+        def _chunks(indexed_aad: bool) -> bytes:
+            out, off, ci = [], len(MAGIC_C), 0
+            while off < len(data):
+                (clen,) = _LEN.unpack_from(data, off)
+                off += _LEN.size
+                nonce = data[off:off + _NONCE]
+                off += _NONCE
+                ca = (aad + b"|chunk:%d/%d" % (ci, n_chunks)
+                      if indexed_aad else (aad or None))
+                out.append(_aead.decrypt(nonce, data[off:off + clen], ca))
+                off += clen
+                ci += 1
+            return b"".join(out)
+
+        try:
+            return _chunks(True)
+        except Exception:
+            # chunked blobs sealed before (index, total) binding carried
+            # no per-chunk AAD; accept them as a migration path
+            return _chunks(False)
     except VaultError:
         raise
     except Exception as e:  # InvalidTag/short read — wrong key/tampering

@@ -170,7 +170,7 @@ class Journal:
         with open(path, "rb") as f:
             data = f.read()
         for seq, (_off, payload) in enumerate(_scan(data)):
-            yield json.loads(vault.decrypt(payload, aad=_rec_aad(seq)))
+            yield json.loads(_dec_payload(payload, seq))
 
     def close(self) -> None:
         self._f.close()
@@ -223,6 +223,16 @@ def _rec_aad(seq: int) -> bytes:
     return b"wal-rec:%d" % seq
 
 
+def _dec_payload(payload: bytes, seq: int) -> bytes:
+    """Unseal a record at ordinal `seq`. Records written before ordinal
+    binding carried no AAD; they are accepted as a migration path (the
+    next rewrite/truncate re-seals everything with ordinals)."""
+    try:
+        return vault.decrypt(payload, aad=_rec_aad(seq))
+    except vault.VaultError:
+        return vault.decrypt(payload)
+
+
 def _scan_state(path: str) -> tuple[int, int]:
     """(byte offset where the intact record prefix ends, record count)."""
     with open(path, "rb") as f:
@@ -248,7 +258,7 @@ def replay(path: str) -> Iterator[tuple[int, str, object]]:
     with open(path, "rb") as f:
         data = f.read()
     for seq, (_off, payload) in enumerate(_scan(data)):
-        doc = json.loads(vault.decrypt(payload, aad=_rec_aad(seq)))
+        doc = json.loads(_dec_payload(payload, seq))
         if "schema" in doc:
             yield int(doc["ts"]), "schema", doc["schema"]
         elif "drop" in doc:

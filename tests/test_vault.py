@@ -153,6 +153,22 @@ def test_chunk_reorder_and_truncation_rejected(monkeypatch):
         vault.decrypt(ct[:4] + chunks[0])
 
 
+def test_legacy_no_aad_records_still_replay(tmp_path):
+    """WAL records sealed by the pre-ordinal-AAD build (aad=None) must
+    stay replayable — migration path, re-sealed on the next rewrite."""
+    import struct
+    import zlib
+    vault.set_key(KEY)
+    path = str(tmp_path / "wal.log")
+    doc = b'{"ts":5,"m":{"es":[[1,"friend",2,null]],"ed":[],"vs":[],"vd":[]}}'
+    payload = vault.encrypt(doc)  # no AAD: the legacy sealing
+    rec = b"DGW1" + struct.pack("<II", len(payload),
+                                zlib.crc32(payload)) + payload
+    open(path, "wb").write(rec)
+    got = list(replay(path))
+    assert got[0][0] == 5 and got[0][2].edge_sets[0][1] == "friend"
+
+
 def test_key_sizes_and_key_file(tmp_path):
     with pytest.raises(vault.VaultError):
         vault.set_key(b"short")

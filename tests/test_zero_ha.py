@@ -1,0 +1,270 @@
+"""Zero high availability: liveness detection, standby journal tailing,
+promotion, and client failover.
+
+Reference parity model: Zero in the reference is itself a raft group —
+followers replicate the group-0 log and an election replaces a dead
+leader. Here the log is the journaled state machine (doc_log), the
+follower is a STANDBY tailing it over JournalTail, and "election" is
+collapsed to a designated successor promoting after the primary stays
+dark (cluster/zero.py run_standby).
+"""
+
+import json
+import threading
+import time
+
+import grpc
+import pytest
+
+from dgraph_tpu.cluster import start_cluster_alpha
+from dgraph_tpu.cluster.oracle import TxnAborted
+from dgraph_tpu.cluster.zero import (ZeroClient, ZeroState,
+                                     make_zero_server, run_standby)
+
+
+def test_liveness_marks_silent_nodes_dead():
+    state = ZeroState(liveness_s=0.2)
+    state.connect("127.0.0.1:1", group=1)   # node 1
+    state.connect("127.0.0.1:2", group=2)   # node 2
+    assert state.dead_nodes() == []
+    deadline = time.monotonic() + 1.0
+    while time.monotonic() < deadline:
+        state.heartbeat(1)
+        time.sleep(0.05)
+    # node 2 never heartbeat after joining; node 1 stayed chatty
+    assert state.dead_nodes() == [2]
+    assert list(state.membership().dead) == [2]
+    # a heartbeat resurrects it
+    state.heartbeat(2)
+    assert state.dead_nodes() == []
+
+
+def test_standby_replicates_and_refuses_leases():
+    pserver, pport, pstate = make_zero_server()
+    pserver.start()
+    ptarget = f"127.0.0.1:{pport}"
+    # drive the primary's state machine
+    pc = ZeroClient(ptarget)
+    pc.connect("127.0.0.1:9001", group=1)
+    pc.should_serve("name", 1)
+    for _ in range(5):
+        pc.read_ts()
+
+    sstate = ZeroState(standby=True)
+    sserver, sport, _ = make_zero_server(sstate)
+    sserver.start()
+    stop = threading.Event()
+    t = threading.Thread(target=run_standby,
+                         args=(sstate, ptarget),
+                         kwargs={"poll_s": 0.05, "promote_after_s": 60,
+                                 "stop_event": stop}, daemon=True)
+    t.start()
+    try:
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and \
+                sstate.tablets.get("name") != 1:
+            time.sleep(0.05)
+        assert sstate.tablets == pstate.tablets
+        assert sstate.groups == pstate.groups
+        # lease blocks replicated: standby's oracle is at/above anything
+        # the primary handed out
+        assert sstate.oracle.max_assigned >= 5
+
+        # an unpromoted standby refuses lease RPCs
+        sc = ZeroClient(f"127.0.0.1:{sport}")
+        with pytest.raises(grpc.RpcError) as ei:
+            sc.read_ts()
+        assert ei.value.code() == grpc.StatusCode.FAILED_PRECONDITION
+    finally:
+        stop.set()
+        t.join(timeout=2)
+        pserver.stop(None)
+        sserver.stop(None)
+
+
+def test_failover_promotes_and_preserves_ts_monotonicity():
+    pserver, pport, pstate = make_zero_server()
+    pserver.start()
+    ptarget = f"127.0.0.1:{pport}"
+    sstate = ZeroState(standby=True)
+    sserver, sport, _ = make_zero_server(sstate)
+    sserver.start()
+    starget = f"127.0.0.1:{sport}"
+    stop = threading.Event()
+    promoted = []
+    t = threading.Thread(
+        target=lambda: promoted.append(run_standby(
+            sstate, ptarget, poll_s=0.05, promote_after_s=0.3,
+            stop_event=stop)), daemon=True)
+    t.start()
+
+    try:
+        fc = ZeroClient(f"{ptarget},{starget}")  # failover client
+        issued = [fc.read_ts() for _ in range(10)]
+        old_start = fc.read_ts()  # a txn begun under the old primary
+        time.sleep(0.2)  # let the standby pull the latest lease blocks
+
+        pserver.stop(None)  # kill the primary
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and sstate.standby:
+            time.sleep(0.05)
+        assert not sstate.standby and promoted == [True]
+
+        # the same client object keeps working via rotation, and the new
+        # regime's timestamps are strictly above everything ever issued
+        new_ts = fc.read_ts()
+        assert new_ts > max(issued + [old_start])
+        # a pre-failover txn cannot commit — its conflict history died
+        # with the primary
+        with pytest.raises(TxnAborted):
+            fc.commit(old_start, ["k1"])
+        # a fresh txn commits fine
+        fresh = fc.read_ts()
+        assert fc.commit(fresh, ["k1"]) > fresh
+    finally:
+        stop.set()
+        t.join(timeout=2)
+        sserver.stop(None)
+
+
+def test_lease_gating_bounds_unacked_issuance():
+    """With a standby attached, the primary refuses to issue ids more
+    than MAX_UNACKED_BLOCKS lease blocks past the standby's ack — the
+    invariant a safe promotion floor rests on."""
+    from dgraph_tpu.cluster.zero import LEASE_BLOCK, MAX_UNACKED_BLOCKS
+    state = ZeroState()
+    server, port, _ = make_zero_server(state)
+    server.start()
+    c = ZeroClient(f"127.0.0.1:{port}")
+    try:
+        c.read_ts()                      # no standby: ungated
+        state.journal_tail(0)            # a standby attaches at index 0
+        cap = MAX_UNACKED_BLOCKS * LEASE_BLOCK
+        issued = 0
+        with pytest.raises(grpc.RpcError) as ei:
+            for _ in range(cap + 10):
+                c.read_ts()
+                issued += 1
+        assert ei.value.code() == grpc.StatusCode.UNAVAILABLE
+        assert issued < cap  # stopped before outrunning the ack margin
+        # the standby pulls (acks) the lease-block docs → gate lifts
+        _docs, nxt = state.journal_tail(0)
+        state.journal_tail(nxt)
+        c.read_ts()
+        # a uid grant counts its WHOLE size against the margin: the
+        # last id of the grant must stay under it, not just the first
+        list(c.assign_uids(cap // 2))
+        headroom = (state._acked_uid_block + cap
+                    - state.oracle.max_uid)
+        assert 0 < headroom + 1 < cap  # the probe stays a legal size
+        with pytest.raises(grpc.RpcError) as ei:
+            c.assign_uids(headroom + 1)  # whole grant would cross
+        assert ei.value.code() == grpc.StatusCode.UNAVAILABLE
+        # and a grant at/above the whole margin is a hard client error
+        with pytest.raises(grpc.RpcError) as ei:
+            c.assign_uids(cap)
+        assert ei.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+    finally:
+        server.stop(None)
+
+
+def test_standby_restart_resumes_and_logid_reset():
+    """A restarted standby resumes from its replayed journal length
+    (no duplicate docs); a primary that comes back with a FRESH log
+    identity forces a replica reset instead of a silent desync."""
+    import json
+    state = ZeroState()
+    state.connect("127.0.0.1:9001", group=1)
+    state.should_serve("name", 1)
+    n_docs = len(state.doc_log)
+
+    # standby applies the full log, then "restarts" — its doc_log length
+    # is the resume cursor
+    sb = ZeroState(standby=True)
+    docs, nxt = state.journal_tail(0)
+    sb.apply_remote(docs)
+    assert len(sb.doc_log) == n_docs and sb.log_id == state.log_id
+    again, nxt2 = state.journal_tail(len(sb.doc_log))
+    assert again == [] and nxt2 == n_docs  # nothing re-pulled
+
+    # primary restarts journal-less: fresh log identity, shorter log
+    fresh = ZeroState()
+    fresh.connect("127.0.0.1:9002", group=1)
+    assert fresh.log_id != sb.log_id
+    sb.reset_replica()
+    docs2, _ = fresh.journal_tail(0)
+    sb.apply_remote(docs2)
+    assert sb.log_id == fresh.log_id
+    assert sb.groups == fresh.groups
+
+
+def test_compaction_snapshot_bootstrap():
+    """A primary nothing tails compacts its doc_log; a follower landing
+    below the base bootstraps from a snapshot doc and converges."""
+    import dgraph_tpu.cluster.zero as zmod
+    state = ZeroState()
+    state.connect("127.0.0.1:9001", group=1)
+    state.should_serve("name", 1)
+    # force heavy lease-doc traffic past the cap (shrunk for the test)
+    old_cap = zmod.DOC_LOG_CAP
+    zmod.DOC_LOG_CAP = 8
+    try:
+        for i in range(40):
+            state.oracle.bump_ts((i + 1) * zmod.LEASE_BLOCK)
+            state.persist_leases()
+        assert state._doc_base > 0  # compaction happened
+        sb = ZeroState(standby=True)
+        docs, nxt = state.journal_tail(0)  # cursor below the base
+        assert json.loads(docs[0])["k"] == "snap"
+        sb.apply_remote(docs)
+        assert sb.groups == state.groups
+        assert sb.tablets == state.tablets
+        assert sb.oracle.max_assigned >= 40 * zmod.LEASE_BLOCK
+        # and the follower continues incrementally from there
+        state.should_serve("age", 1)
+        docs2, _ = state.journal_tail(nxt)
+        sb.apply_remote(docs2)
+        assert sb.tablets == state.tablets
+    finally:
+        zmod.DOC_LOG_CAP = old_cap
+
+
+def test_alpha_survives_zero_failover():
+    """Full-stack: an Alpha keeps committing after its Zero dies and the
+    standby takes over (multi-target --zero list)."""
+    pserver, pport, _pstate = make_zero_server()
+    pserver.start()
+    ptarget = f"127.0.0.1:{pport}"
+    sstate = ZeroState(standby=True)
+    sserver, sport, _ = make_zero_server(sstate)
+    sserver.start()
+    stop = threading.Event()
+    t = threading.Thread(target=run_standby,
+                         args=(sstate, ptarget),
+                         kwargs={"poll_s": 0.05, "promote_after_s": 0.3,
+                                 "stop_event": stop}, daemon=True)
+    t.start()
+
+    alpha, aserver, _addr = start_cluster_alpha(
+        f"{ptarget},127.0.0.1:{sport}", device_threshold=10**9)
+    try:
+        alpha.alter("name: string @index(exact) .")
+        alpha.mutate(set_nquads='_:a <name> "before-failover" .')
+        time.sleep(0.2)  # standby catches the lease blocks
+
+        pserver.stop(None)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and sstate.standby:
+            time.sleep(0.05)
+        assert not sstate.standby
+
+        # commits keep working through the promoted standby
+        alpha.mutate(set_nquads='_:b <name> "after-failover" .')
+        out = alpha.query('{ q(func: has(name)) { name } }')
+        names = sorted(r["name"] for r in out["q"])
+        assert names == ["after-failover", "before-failover"]
+    finally:
+        stop.set()
+        t.join(timeout=2)
+        aserver.stop(None)
+        sserver.stop(None)
