@@ -44,46 +44,100 @@ def shortest_path(ex, sg) -> PathData:
     if any(c.facet_keys for c in data.edge_sgs):
         return _weighted_shortest(ex, sg, data, int(src), int(dst))
     max_depth = args.depth or MAX_PATH_DEPTH
+    k = max(1, args.numpaths)
 
-    # parents[rank] = all (parent_rank, pred_index) found at rank's first
-    # BFS level — the shortest-path DAG, enumerable for numpaths > 1
-    parents: dict[int, list[tuple[int, int]]] = {int(src): []}
+    if k == 1:
+        # fast path: first-visit BFS, one shortest path
+        parents: dict[int, list[tuple[int, int]]] = {int(src): []}
+        frontier = np.array([src], np.int32)
+        found = src == dst
+        for _ in range(max_depth):
+            if found or not len(frontier):
+                break
+            level_new: dict[int, list[tuple[int, int]]] = {}
+            for i, esg in enumerate(data.edge_sgs):
+                nbrs, seg, pos = ex.expand(esg.attr, esg.is_reverse,
+                                           frontier)
+                nbrs, seg, pos = ex.filter_edges(esg.filters, nbrs, seg,
+                                                 pos)
+                for n, s in zip(nbrs.tolist(), seg.tolist()):
+                    if n not in parents:  # unseen at earlier levels
+                        level_new.setdefault(n, []).append(
+                            (int(frontier[s]), i))
+            parents.update(level_new)
+            if int(dst) in level_new:
+                found = True
+            frontier = np.array(sorted(level_new), np.int32)
+
+        if int(dst) in parents:
+            def walk(rank: int):
+                plist = parents[rank]
+                if not plist:
+                    yield [(rank, -1)]
+                    return
+                for p, pi in plist:
+                    for prefix in walk(p):
+                        yield prefix + [(rank, pi)]
+            data.paths = [next(walk(int(dst)))]
+    else:
+        data.paths = _k_shortest(ex, data, int(src), int(dst),
+                                 max_depth, k)
+    if data.paths:
+        data.nodes = np.unique(np.array([r for p in data.paths for r, _ in p],
+                                        np.int32))
+    return data
+
+
+def _k_shortest(ex, data: PathData, src: int, dst: int, max_depth: int,
+                k: int) -> list:
+    """Up to k SIMPLE paths in length order (reference: shortest with
+    numpaths returns longer paths once shorter ones are exhausted, not
+    just equal-length alternates). Level-expansion keeps EVERY (parent,
+    pred) edge per level — the full level DAG — then enumerates paths of
+    length 1, 2, ... with an on-path set to stay simple."""
+    # levels[l][node] = [(parent, pred_i)] for paths reaching node in
+    # exactly l+1 hops; frontier at level l = all nodes reached at l
+    levels: list[dict[int, list[tuple[int, int]]]] = []
     frontier = np.array([src], np.int32)
-    found = src == dst
     for _ in range(max_depth):
-        if found or not len(frontier):
+        if not len(frontier):
             break
         level_new: dict[int, list[tuple[int, int]]] = {}
         for i, esg in enumerate(data.edge_sgs):
             nbrs, seg, pos = ex.expand(esg.attr, esg.is_reverse, frontier)
             nbrs, seg, pos = ex.filter_edges(esg.filters, nbrs, seg, pos)
             for n, s in zip(nbrs.tolist(), seg.tolist()):
-                if n not in parents:  # unseen at earlier levels
-                    level_new.setdefault(n, []).append((int(frontier[s]), i))
-        parents.update(level_new)
-        if int(dst) in level_new:
-            found = True
+                pair = (int(frontier[s]), i)
+                plist = level_new.setdefault(n, [])
+                if pair not in plist:
+                    plist.append(pair)
+        levels.append(level_new)
         frontier = np.array(sorted(level_new), np.int32)
 
-    if int(dst) in parents:
-        # enumerate up to numpaths equal-length paths through the BFS DAG;
-        # each path entry is (rank, pred_index_used_to_arrive), -1 at src
-        def walk(rank: int):
-            plist = parents[rank]
-            if not plist:
-                yield [(rank, -1)]
-                return
-            for p, pi in plist:
-                for prefix in walk(p):
+    def walk_back(level: int, rank: int, on_path: frozenset):
+        """Simple paths of exactly `level+1` hops ending at rank."""
+        for p, pi in levels[level].get(rank, ()):
+            if level == 0:
+                if p == src:
+                    yield [(src, -1), (rank, pi)]
+            elif p not in on_path:
+                for prefix in walk_back(level - 1, p, on_path | {p}):
                     yield prefix + [(rank, pi)]
 
-        import itertools
-        data.paths = list(itertools.islice(walk(int(dst)),
-                                           max(1, args.numpaths)))
-    if data.paths:
-        data.nodes = np.unique(np.array([r for p in data.paths for r, _ in p],
-                                        np.int32))
-    return data
+    out: list = []
+    if src == dst:
+        out.append([(src, -1)])
+    for level in range(len(levels)):
+        if len(out) >= k:
+            break
+        # src rides the on-path set from the start: a simple path may
+        # END at src (the level-0 termination checks equality) but can
+        # never pass THROUGH it mid-walk
+        for path in walk_back(level, dst, frozenset([dst, src])):
+            out.append(path)
+            if len(out) >= k:
+                break
+    return out[:k]
 
 
 def _edge_weights(store, ex, esg, nbrs: np.ndarray, pos: np.ndarray,

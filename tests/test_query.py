@@ -680,6 +680,156 @@ CASES = [
      { var(func: uid(100)) { a as name }
        q(func: type(Person)) @groupby(alive) { min(val(a)) } }""",
      {"q": [{"@groupby": [{"alive": False}, {"alive": True}]}]}),
+
+    # -- round-3 batch 2: loop recurse, string ranges, datetime between,
+    # var-filters, math funcs, groupby aggs, combined modifiers ---------
+    ("recurse_loop_true", """
+     { r(func: uid(1)) @recurse(depth: 2, loop: true) { name friend } }""",
+     {"r": [{"name": "Michonne",
+             "friend": [{"name": "King Lear",
+                         "friend": [{"name": "Margaret"}]},
+                        {"name": "Margaret",
+                         "friend": [{"name": "Leonard"}]},
+                        {"name": "Leonard",
+                         "friend": [{"name": "Garfield"}]}]}]}),
+
+    ("lt_string_root", """
+     { q(func: lt(name, "Garfield"), orderasc: name) { name } }""",
+     {"q": [{"name": "Bear"}, {"name": "Blade Runner"},
+            {"name": "Blade Trinity"}, {"name": "Drama"}]}),
+
+    ("gt_string_root", """
+     { q(func: gt(name, "Sci"), orderasc: name) { name } }""",
+     {"q": [{"name": "SciFi"}, {"name": "The Wire"}]}),
+
+    ("between_datetime_root", """
+     { q(func: between(dob, "1950-01-01", "1990-01-01"), orderasc: dob)
+       { name } }""",
+     {"q": [{"name": "Leonard"}, {"name": "Michonne"},
+            {"name": "Margaret"}]}),
+
+    ("child_filter_uid_var", """
+     { a as var(func: uid(2, 3)) { uid }
+       q(func: uid(1)) { friend @filter(uid(a)) { name } } }""",
+     {"q": [{"friend": [{"name": "King Lear"}, {"name": "Margaret"}]}]}),
+
+    ("child_first_with_order", """
+     { q(func: uid(1)) { friend (first: 2, orderasc: name) { name } } }""",
+     {"q": [{"friend": [{"name": "King Lear"}, {"name": "Leonard"}]}]}),
+
+    ("math_sqrt_floor", """
+     { var(func: uid(2)) { a as age }
+       q(func: uid(a)) { name r: math(floor(sqrt(a))) } }""",
+     {"q": [{"name": "King Lear", "r": 8}]}),
+
+    ("math_cond", """
+     { var(func: uid(1, 5)) { a as age }
+       q(func: uid(a), orderasc: val(a)) {
+         name adult: math(cond(a >= 18, 1, 0)) } }""",
+     {"q": [{"name": "Garfield", "adult": 0},
+            {"name": "Michonne", "adult": 1}]}),
+
+    ("groupby_sum_age", """
+     { var(func: type(Person)) { a as age }
+       q(func: type(Person)) @groupby(alive) { sum(val(a)) } }""",
+     {"q": [{"@groupby": [{"alive": False, "sum(val(a))": 89},
+                          {"alive": True, "sum(val(a))": 119}]}]}),
+
+    ("filter_not_uid_var", """
+     { a as var(func: uid(2)) { uid }
+       q(func: uid(1)) { friend (orderasc: name)
+         @filter(NOT uid(a)) { name } } }""",
+     {"q": [{"friend": [{"name": "Leonard"}, {"name": "Margaret"}]}]}),
+
+    ("count_uid_with_filter", """
+     { q(func: uid(1)) { friend @filter(ge(age, 40)) { count(uid) } } }""",
+     {"q": [{"friend": [{"count": 2}]}]}),
+
+    ("order_two_blocks_independent", """
+     { asc(func: uid(2, 3), orderasc: age) { name }
+       desc(func: uid(2, 3), orderdesc: age) { name } }""",
+     {"asc": [{"name": "Margaret"}, {"name": "King Lear"}],
+      "desc": [{"name": "King Lear"}, {"name": "Margaret"}]}),
+
+    ("reverse_count_root_func", """
+     { q(func: eq(count(~friend), 2), orderasc: uid) { name } }""",
+     {"q": [{"name": "Margaret"}, {"name": "Leonard"}]}),
+
+    ("after_cursor_is_uid_space_with_order", """
+     { q(func: type(Person), orderasc: age, after: 0x4, first: 2)
+       { name } }""",
+     {"q": [{"name": "Garfield"}, {"name": "Bear"}]}),
+
+    ("normalize_two_levels_aliased", """
+     { q(func: uid(2)) @normalize {
+         n: name boss { b: name } } }""",
+     {"q": [{"n": "King Lear", "b": "Michonne"}]}),
+
+    ("cascade_on_child_block", """
+     { q(func: uid(1, 2), orderasc: uid) {
+         name friend @cascade { name nickname } } }""",
+     {"q": [{"name": "Michonne",
+             "friend": [{"name": "King Lear", "nickname": "The King"}]},
+            {"name": "King Lear"}]}),
+
+    ("facets_value_count", """
+     { q(func: uid(1)) { friend (orderasc: name) @facets(close)
+         { name } } }""",
+     {"q": [{"friend": [
+         {"name": "King Lear", "friend|close": True},
+         {"name": "Leonard"},
+         {"name": "Margaret", "friend|close": False}]}]}),
+
+    ("shortest_depth_limited", """
+     { path as shortest(from: 0x1, to: 0x6, depth: 2) { friend }
+       p(func: uid(path)) { name } }""",
+     {"_path_": [], "p": []}),
+
+    ("shortest_numpaths_longer_paths", """
+     { path as shortest(from: 0x1, to: 0x4, numpaths: 2) { friend }
+       p(func: uid(path), orderasc: uid) { name } }""",
+     # k-shortest returns LONGER paths once shorter ones exhaust
+     # (reference numpaths semantics), in length order
+     {"_path_": [{"uid": "0x1", "friend": {"uid": "0x4"}},
+                 {"uid": "0x1", "friend": {
+                     "uid": "0x3", "friend": {"uid": "0x4"}}}],
+      "p": [{"name": "Michonne"}, {"name": "Margaret"},
+            {"name": "Leonard"}]}),
+
+    ("has_reverse_root", """
+     { q(func: has(~friend), orderasc: uid) { name } }""",
+     {"q": [{"name": "King Lear"}, {"name": "Margaret"},
+            {"name": "Leonard"}, {"name": "Garfield"},
+            {"name": "Bear"}]}),
+
+    ("uid_in_multiple", """
+     { q(func: uid_in(boss, 0x1), orderasc: name) { name } }""",
+     {"q": [{"name": "King Lear"}, {"name": "Margaret"}]}),
+
+    ("eq_int_multiple_args", """
+     { q(func: eq(age, 5, 77), orderasc: age) { name age } }""",
+     {"q": [{"name": "Garfield", "age": 5},
+            {"name": "King Lear", "age": 77}]}),
+
+    ("alias_same_pred_diff_langs", """
+     { q(func: uid(7)) { de: name@de nl: name@nl } }""",
+     {"q": [{"de": "Sieben", "nl": "Zeven"}]}),
+
+    ("val_leaf_without_order", """
+     { var(func: uid(3)) { h as height }
+       q(func: uid(h)) { name tall: val(h) } }""",
+     {"q": [{"name": "Margaret", "tall": 1.55}]}),
+
+    ("two_filters_and_on_root", """
+     { q(func: type(Person), orderasc: age)
+       @filter(ge(age, 30) AND le(age, 50)) { name age } }""",
+     {"q": [{"name": "Margaret", "age": 31},
+            {"name": "Michonne", "age": 38},
+            {"name": "Leonard", "age": 45}]}),
+
+    ("multi_hop_mixed_direction", """
+     { q(func: uid(6)) { ~friend { ~friend { name } } } }""",
+     {"q": [{"~friend": [{"~friend": [{"name": "Leonard"}]}]}]}),
 ]
 
 
@@ -715,14 +865,19 @@ def test_child_groupby_is_per_parent(engine):
 
 
 def test_numpaths_enumerates_shortest_dag(engine):
-    """two equal-length paths 1→3→4 and 1→4 … use a diamond: 1→2→3, 1→3."""
+    """k-shortest in length order: direct edges first, then detours."""
     out = q(engine, """
       { path as shortest(from: 0x2, to: 0x4, numpaths: 4) { friend } }""")
-    # 2→3→4 is the only shortest path in the fixture
-    assert len(out["_path_"]) == 1
+    # 2→3→4 is the only simple path to 4 in the fixture
+    assert out["_path_"] == [{"uid": "0x2", "friend": {
+        "uid": "0x3", "friend": {"uid": "0x4"}}}]
     out2 = q(engine, """
       { path as shortest(from: 0x1, to: 0x3, numpaths: 4) { friend } }""")
-    assert out2["_path_"] == [{"uid": "0x1", "friend": {"uid": "0x3"}}]
+    # direct 1→3, then the longer 1→2→3 (and nothing else simple)
+    assert out2["_path_"] == [
+        {"uid": "0x1", "friend": {"uid": "0x3"}},
+        {"uid": "0x1", "friend": {"uid": "0x2",
+                                  "friend": {"uid": "0x3"}}}]
 
 
 def test_duplicate_value_set_semantics():
