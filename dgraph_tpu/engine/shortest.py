@@ -1,9 +1,13 @@
 """shortest(from, to) path queries.
 
 Reference parity: `query/shortest.go` (shortestPath, expandOut) — iterative
-frontier expansion with parent pointers; uniform cost BFS here (facet
-weights arrive with facet support). `numpaths > 1` returns up to k shortest
-by BFS level-DAG enumeration.
+frontier expansion with parent pointers; uniform-cost BFS or facet-weighted
+relaxation. `numpaths` returns up to k SIMPLE paths in length order
+(unweighted: level-DAG enumeration) or cost order (weighted: Yen's
+algorithm over the batched relaxation core), longer/costlier paths once
+shorter ones exhaust. minweight/maxweight bound the paths COUNTED toward
+numpaths (the reference keeps searching past under-min paths); unweighted
+edges weigh 1 for these bounds.
 
 The hop loop is the same batched CSR expansion as everything else; parent
 pointers are kept host-side (path reconstruction is inherently sequential
@@ -17,6 +21,11 @@ from dataclasses import dataclass, field
 import numpy as np
 
 MAX_PATH_DEPTH = 32
+# Yen's outer loop extracts one path per iteration; when min/maxweight
+# discard most of them the search could otherwise grind through an
+# exponential path space — bound total extractions.
+MAX_YEN_ITERS = 128
+_EPS = 1e-9
 
 
 @dataclass
@@ -45,8 +54,10 @@ def shortest_path(ex, sg) -> PathData:
         return _weighted_shortest(ex, sg, data, int(src), int(dst))
     max_depth = args.depth or MAX_PATH_DEPTH
     k = max(1, args.numpaths)
+    bounded = args.minweight > float("-inf") or \
+        args.maxweight < float("inf")
 
-    if k == 1:
+    if k == 1 and not bounded:
         # fast path: first-visit BFS, one shortest path
         parents: dict[int, list[tuple[int, int]]] = {int(src): []}
         frontier = np.array([src], np.int32)
@@ -80,8 +91,8 @@ def shortest_path(ex, sg) -> PathData:
                         yield prefix + [(rank, pi)]
             data.paths = [next(walk(int(dst)))]
     else:
-        data.paths = _k_shortest(ex, data, int(src), int(dst),
-                                 max_depth, k)
+        data.paths = _k_shortest(ex, data, int(src), int(dst), max_depth,
+                                 k, args.minweight, args.maxweight)
     if data.paths:
         data.nodes = np.unique(np.array([r for p in data.paths for r, _ in p],
                                         np.int32))
@@ -89,17 +100,41 @@ def shortest_path(ex, sg) -> PathData:
 
 
 def _k_shortest(ex, data: PathData, src: int, dst: int, max_depth: int,
-                k: int) -> list:
+                k: int, minw: float, maxw: float) -> list:
     """Up to k SIMPLE paths in length order (reference: shortest with
-    numpaths returns longer paths once shorter ones are exhausted, not
-    just equal-length alternates). Level-expansion keeps EVERY (parent,
-    pred) edge per level — the full level DAG — then enumerates paths of
-    length 1, 2, ... with an on-path set to stay simple."""
+    numpaths returns longer paths once shorter ones are exhausted).
+    Unweighted edges weigh 1, so a path of h hops costs h; only paths
+    with minw ≤ h ≤ maxw count toward k. Level expansion keeps EVERY
+    (parent, pred) edge per level — the full level DAG — and path
+    enumeration interleaves with level construction so the loop stops as
+    soon as k in-range paths exist."""
+    out: list = []
+    if src == dst:
+        # the trivial zero-hop path; cycles back to the source are not
+        # simple paths and are never returned (matching the weighted
+        # branch's semantics)
+        if minw <= 0 <= maxw:
+            out.append([(src, -1)])
+        return out
+
     # levels[l][node] = [(parent, pred_i)] for paths reaching node in
     # exactly l+1 hops; frontier at level l = all nodes reached at l
     levels: list[dict[int, list[tuple[int, int]]]] = []
+
+    def walk_back(level: int, rank: int, on_path: frozenset):
+        """Simple paths of exactly `level+1` hops ending at rank."""
+        for p, pi in levels[level].get(rank, ()):
+            if level == 0:
+                if p == src:
+                    yield [(src, -1), (rank, pi)]
+            elif p not in on_path:
+                for prefix in walk_back(level - 1, p, on_path | {p}):
+                    yield prefix + [(rank, pi)]
+
+    if np.isfinite(maxw):
+        max_depth = min(max_depth, max(int(maxw), 0))
     frontier = np.array([src], np.int32)
-    for _ in range(max_depth):
+    for level in range(max_depth):
         if not len(frontier):
             break
         level_new: dict[int, list[tuple[int, int]]] = {}
@@ -113,30 +148,15 @@ def _k_shortest(ex, data: PathData, src: int, dst: int, max_depth: int,
                     plist.append(pair)
         levels.append(level_new)
         frontier = np.array(sorted(level_new), np.int32)
-
-    def walk_back(level: int, rank: int, on_path: frozenset):
-        """Simple paths of exactly `level+1` hops ending at rank."""
-        for p, pi in levels[level].get(rank, ()):
-            if level == 0:
-                if p == src:
-                    yield [(src, -1), (rank, pi)]
-            elif p not in on_path:
-                for prefix in walk_back(level - 1, p, on_path | {p}):
-                    yield prefix + [(rank, pi)]
-
-    out: list = []
-    if src == dst:
-        out.append([(src, -1)])
-    for level in range(len(levels)):
-        if len(out) >= k:
-            break
-        # src rides the on-path set from the start: a simple path may
-        # END at src (the level-0 termination checks equality) but can
-        # never pass THROUGH it mid-walk
-        for path in walk_back(level, dst, frozenset([dst, src])):
-            out.append(path)
-            if len(out) >= k:
-                break
+        hops = level + 1
+        if minw <= hops <= maxw:
+            # src rides the on-path set: a simple path may END at src
+            # (level-0 termination checks equality) but never passes
+            # THROUGH it
+            for path in walk_back(level, dst, frozenset([dst, src])):
+                out.append(path)
+                if len(out) >= k:
+                    return out
     return out[:k]
 
 
@@ -161,9 +181,12 @@ def _edge_weights(store, ex, esg, nbrs: np.ndarray, pos: np.ndarray,
     return ws
 
 
-def _weighted_shortest(ex, sg, data: PathData, src: int,
-                       dst: int) -> PathData:
-    """Facet-weight shortest path as BATCHED frontier relaxation.
+def _weighted_one(ex, data: PathData, src: int, dst: int, wkeys,
+                  maxw: float, banned_nodes: frozenset = frozenset(),
+                  banned_edges: frozenset = frozenset()):
+    """One minimal-cost SIMPLE path src→dst as BATCHED frontier
+    relaxation, honoring banned nodes/edges (the restriction sets Yen's
+    spur searches need).
 
     The per-node priority-queue Dijkstra of the reference
     (query/shortest.go relaxes one settled node at a time) is the wrong
@@ -172,17 +195,35 @@ def _weighted_shortest(ex, sg, data: PathData, src: int,
     device) every other hop uses — Bellman-Ford rounds, exact for the
     non-negative weights the reference supports, with O(diameter) rounds
     instead of O(nodes) device round-trips. Distances settle first; the
-    equal-cost parent DAG is rebuilt afterwards in one tight-edge pass
-    (dist[u] + w == dist[v]) so `numpaths > 1` enumerates the same
-    minimal-cost DAG the per-node algorithm maintained incrementally.
-    maxweight prunes the search frontier; minweight filters the final
-    answer."""
-    args = sg.shortest
+    path is read back over one tight-edge pass (dist[u] + w == dist[v]).
+
+    Returns (cost, path[(rank, pred_i)], pcosts) — pcosts[j] is the
+    cumulative cost of path[:j+1] (exact along a tight path) — or
+    (inf, None, None)."""
     store = ex.store
-    wkeys = [(c.facet_keys[0][1] if c.facet_keys else None)
-             for c in data.edge_sgs]
-    EPS = 1e-9
     n = store.n_nodes
+    banned_arr = (np.array(sorted(banned_nodes), np.int32)
+                  if banned_nodes else None)
+    banned_us = {u for u, _, _ in banned_edges}
+
+    def relax_edges(frontier, i, esg):
+        nbrs, seg, pos = ex.expand(esg.attr, esg.is_reverse, frontier,
+                                   allow_remote=not wkeys[i])
+        nbrs, seg, pos = ex.filter_edges(esg.filters, nbrs, seg, pos)
+        if not len(nbrs):
+            return nbrs, seg, np.zeros(0)
+        ws = _edge_weights(store, ex, esg, nbrs, pos, wkeys[i])
+        keep = np.ones(len(nbrs), bool)
+        if banned_arr is not None:
+            keep &= ~np.isin(nbrs, banned_arr)
+        if banned_edges:
+            srcs = frontier[seg]
+            for j in np.nonzero(np.isin(srcs,
+                                        list(banned_us)))[0].tolist():
+                if (int(srcs[j]), int(nbrs[j]), i) in banned_edges:
+                    keep[j] = False
+        return nbrs[keep], seg[keep], ws[keep]
+
     dist = np.full(n, np.inf)
     dist[src] = 0.0
     frontier = np.array([src], np.int32)
@@ -194,17 +235,13 @@ def _weighted_shortest(ex, sg, data: PathData, src: int,
             break
         nbr_parts, nd_parts = [], []
         for i, esg in enumerate(data.edge_sgs):
-            nbrs, seg, pos = ex.expand(esg.attr, esg.is_reverse,
-                                       frontier,
-                                       allow_remote=not wkeys[i])
-            nbrs, seg, pos = ex.filter_edges(esg.filters, nbrs, seg, pos)
+            nbrs, seg, ws = relax_edges(frontier, i, esg)
             if not len(nbrs):
                 continue
-            ws = _edge_weights(store, ex, esg, nbrs, pos, wkeys[i])
             nd = dist[frontier[seg]] + ws
             # prune relaxations that can neither beat maxweight nor lie
             # on a minimal-cost path to an already-reached dst
-            keep = (nd <= args.maxweight) & (nd <= dist[dst] + EPS)
+            keep = (nd <= maxw) & (nd <= dist[dst] + _EPS)
             if keep.any():
                 nbr_parts.append(nbrs[keep])
                 nd_parts.append(nd[keep])
@@ -215,54 +252,117 @@ def _weighted_shortest(ex, sg, data: PathData, src: int,
         u_nbrs, inv = np.unique(all_nbrs, return_inverse=True)
         best = np.full(len(u_nbrs), np.inf)
         np.minimum.at(best, inv, all_nd)
-        improved = best < dist[u_nbrs] - EPS
+        improved = best < dist[u_nbrs] - _EPS
         dist[u_nbrs[improved]] = best[improved]
         frontier = u_nbrs[improved].astype(np.int32)
 
+    if not np.isfinite(dist[dst]):
+        return np.inf, None, None
+    # tight-edge pass: expand every node that can sit on a minimal path
+    # (dist ≤ dist[dst]) once, keep edges with dist[u] + w == dist[v]
     parents: dict[int, list[tuple[int, int]]] = {src: []}
-    if np.isfinite(dist[dst]):
-        # tight-edge pass: expand every node that can sit on a minimal
-        # path (dist ≤ dist[dst]) once, keep edges with
-        # dist[u] + w == dist[v] — the shortest-path DAG
-        cand = np.nonzero(np.isfinite(dist)
-                          & (dist <= dist[dst] + EPS))[0].astype(np.int32)
-        for i, esg in enumerate(data.edge_sgs):
-            nbrs, seg, pos = ex.expand(esg.attr, esg.is_reverse, cand,
-                                       allow_remote=not wkeys[i])
-            nbrs, seg, pos = ex.filter_edges(esg.filters, nbrs, seg, pos)
-            if not len(nbrs):
+    cand = np.nonzero(np.isfinite(dist)
+                      & (dist <= dist[dst] + _EPS))[0].astype(np.int32)
+    for i, esg in enumerate(data.edge_sgs):
+        nbrs, seg, ws = relax_edges(cand, i, esg)
+        if not len(nbrs):
+            continue
+        du = dist[cand[seg]]
+        tight = (np.abs(du + ws - dist[nbrs]) <= _EPS) \
+            & (dist[nbrs] <= dist[dst] + _EPS) & (nbrs != src)
+        for u, v in zip(cand[seg[tight]].tolist(), nbrs[tight].tolist()):
+            plist = parents.setdefault(int(v), [])
+            if (int(u), i) not in plist:
+                plist.append((int(u), i))
+
+    # first SIMPLE path through the tight DAG (zero-weight edges can put
+    # cycles in it; the on-path set keeps the walk simple)
+    def walk(rank: int, on_path: frozenset):
+        plist = parents.get(rank, ())
+        if not plist:
+            yield [(rank, -1)]
+            return
+        for p, pi in plist:
+            if p in on_path:
                 continue
-            ws = _edge_weights(store, ex, esg, nbrs, pos, wkeys[i])
-            du = dist[cand[seg]]
-            tight = (np.abs(du + ws - dist[nbrs]) <= EPS) \
-                & (dist[nbrs] <= dist[dst] + EPS) & (nbrs != src)
-            for u, v in zip(cand[seg[tight]].tolist(),
-                            nbrs[tight].tolist()):
-                plist = parents.setdefault(int(v), [])
-                if (int(u), i) not in plist:
-                    plist.append((int(u), i))
+            for prefix in walk(p, on_path | {p}):
+                yield prefix + [(rank, pi)]
 
-    if np.isfinite(dist[dst]) and \
-            args.minweight <= dist[dst] <= args.maxweight:
-        # zero-weight edges can put CYCLES in the tight-edge graph
-        # (u→v and v→u both at w=0); tracking the on-path set keeps the
-        # enumeration to SIMPLE paths — shortest paths never need to
-        # revisit a node, and the recursion depth stays ≤ |DAG nodes|
-        def walk(rank: int, on_path: frozenset):
-            plist = parents.get(rank, ())
-            if not plist:
-                yield [(rank, -1)]
-                return
-            for p, pi in plist:
-                if p in on_path:
-                    continue
-                for prefix in walk(p, on_path | {p}):
-                    yield prefix + [(rank, pi)]
+    path = next(walk(dst, frozenset([dst])), None)
+    if path is None:
+        return np.inf, None, None
+    # per-node dist is exact along a tight path — the cumulative costs
+    # Yen's spur budgeting needs, with no re-expansion
+    pcosts = [float(dist[r]) for r, _ in path]
+    return float(dist[dst]), path, pcosts
 
-        import itertools
-        data.paths = list(itertools.islice(walk(dst, frozenset([dst])),
-                                           max(1, args.numpaths)))
-        data.weights = [float(dist[dst])] * len(data.paths)
+
+def _weighted_shortest(ex, sg, data: PathData, src: int,
+                       dst: int) -> PathData:
+    """Facet-weight shortest path(s). `numpaths > 1` (or weight bounds)
+    runs Yen's algorithm over the batched single-path core: minimal-cost
+    SIMPLE paths in cost order, costlier paths once cheaper ones exhaust
+    — each spur search is a full batched relaxation with the root prefix
+    banned. Only paths with minweight ≤ cost ≤ maxweight count toward
+    numpaths (the reference searches past under-min paths)."""
+    import heapq
+
+    args = sg.shortest
+    wkeys = [(c.facet_keys[0][1] if c.facet_keys else None)
+             for c in data.edge_sgs]
+    k = max(1, args.numpaths)
+
+    cost, path, pcosts = _weighted_one(ex, data, src, dst, wkeys,
+                                       args.maxweight)
+    if path is None:
+        return data
+    A: list[tuple[float, list, list]] = [(cost, path, pcosts)]
+    seen_paths = {tuple(path)}
+    B: list[tuple[float, int, list, list]] = []  # (cost, tie, path, pcosts)
+    tie = 0
+
+    def in_range(c: float) -> bool:
+        return args.minweight <= c <= args.maxweight
+
+    kept = sum(1 for c, _p, _pc in A if in_range(c))
+    iters = 0
+    while kept < k and iters < MAX_YEN_ITERS:
+        iters += 1
+        _pc, prev, prev_costs = A[-1]
+        for i in range(len(prev) - 1):
+            spur = prev[i][0]
+            root = prev[:i + 1]
+            root_cost = prev_costs[i]
+            banned_edges = frozenset(
+                (p[i][0], p[i + 1][0], p[i + 1][1])
+                for _c, p, _ in A
+                if len(p) > i + 1 and p[:i + 1] == root)
+            banned_nodes = frozenset(r for r, _ in root[:-1])
+            sc, sp, spc = _weighted_one(ex, data, spur, dst, wkeys,
+                                        args.maxweight - root_cost,
+                                        banned_nodes, banned_edges)
+            if sp is None:
+                continue
+            cand_path = root + sp[1:]
+            kk = tuple(cand_path)
+            if kk in seen_paths:
+                continue
+            seen_paths.add(kk)
+            cand_pcosts = prev_costs[:i + 1] + \
+                [root_cost + c for c in spc[1:]]
+            tie += 1
+            heapq.heappush(B, (root_cost + sc, tie, cand_path,
+                               cand_pcosts))
+        if not B:
+            break
+        c2, _t, p2, pc2 = heapq.heappop(B)
+        A.append((c2, p2, pc2))
+        if in_range(c2):
+            kept += 1
+
+    final = [(c, p) for c, p, _pc in A if in_range(c)][:k]
+    data.paths = [p for _c, p in final]
+    data.weights = [c for c, _p in final]
     if data.paths:
         data.nodes = np.unique(np.array(
             [r for p in data.paths for r, _ in p], np.int32))

@@ -116,31 +116,51 @@ def test_random_graph_matches_oracle(seed):
     assert hits > 10  # the random graph actually connected things
 
 
-def test_numpaths_enumerates_equal_cost_dag():
+def test_weighted_numpaths_matches_kshortest_oracle():
+    """Weighted numpaths is k-shortest BY COST (Yen over the batched
+    core): cheaper paths first, costlier simple paths once they exhaust
+    — verified against brute-force all-simple-paths costs."""
     rng = np.random.default_rng(7)
-    store, edges = _rand_graph(rng, n=30, m=160, missing=0.0, wmax=3)
+    n, m = 10, 26
+    store, edges = _rand_graph(rng, n=n, m=m, missing=0.0, wmax=3)
     eng = Engine(store, device_threshold=10**9)
-    dist, parents = _oracle(edges, 30, 1, 0)
-    checked = 0
-    for dst in range(2, 31):
-        if dst not in dist:
-            continue
-        n_paths = _count_paths(parents, dst, 1)
+    adj = {}
+    for (s, o), w in edges.items():
+        adj.setdefault(s, []).append((o, float(w)))
+
+    def all_simple_costs(src, dst):
+        out, stack = [], [(src, [src], 0.0)]
+        while stack:
+            u, path, c = stack.pop()
+            if u == dst:
+                out.append((c, path))
+                continue
+            for v, w in adj.get(u, []):
+                if v not in path:
+                    stack.append((v, path + [v], c + w))
+        return sorted(out, key=lambda t: t[0])
+
+    K = 5
+    checked_mixed = 0
+    for dst in range(2, n + 1):
+        brute = all_simple_costs(1, dst)
         out = eng.query('{ path as shortest(from: 0x1, to: 0x%x, '
-                        'numpaths: 8) { link @facets(w) } '
-                        ' p(func: uid(path)) { name } }' % dst)
-        got = out["_path_"]
-        assert len(got) == min(8, n_paths)
+                        'numpaths: %d) { link @facets(w) } }' % (dst, K))
+        got = out.get("_path_", [])
+        want = brute[:min(K, len(brute))]
+        assert len(got) == len(want), (dst, got, want)
+        got_costs = [p["_weight_"] for p in got]
+        assert got_costs == sorted(got_costs)  # cost order
+        assert got_costs == pytest.approx([c for c, _ in want])
         seen = set()
         for p in got:
             uids = tuple(_chain(p))
-            assert uids not in seen  # distinct paths
+            assert uids not in seen and len(set(uids)) == len(uids)
             seen.add(uids)
-            assert p["_weight_"] == pytest.approx(dist[dst])
-            assert _cost(edges, list(uids)) == pytest.approx(dist[dst])
-        if n_paths > 1:
-            checked += 1
-    assert checked >= 2  # the fixture exercised real DAG fan-out
+            assert _cost(edges, list(uids)) == pytest.approx(p["_weight_"])
+        if len(want) > 1 and want[0][0] != want[-1][0]:
+            checked_mixed += 1
+    assert checked_mixed >= 3  # costlier-path mixing actually exercised
 
 
 def test_min_max_weight_filters():
@@ -155,13 +175,58 @@ def test_min_max_weight_filters():
     q = ('{ path as shortest(from: 0x1, to: 0x3%s) { link @facets(w) } '
          ' p(func: uid(path)) { name } }')
     assert eng.query(q % "")["_path_"][0]["_weight_"] == 8.0
-    # maxweight below the best path prunes it entirely
+    # maxweight below every path prunes the result entirely
     assert not eng.query(q % ", maxweight: 7").get("_path_")
     # the 2-hop path is pruned by maxweight 9? no — 8 <= 9 passes
     assert eng.query(q % ", maxweight: 9")["_path_"][0]["_weight_"] == 8.0
-    # minweight above the best cost rejects the answer (no pricier
-    # path is substituted — reference semantics: filter, not re-search)
-    assert not eng.query(q % ", minweight: 9").get("_path_")
+    # minweight above the cheapest cost keeps SEARCHING: the costlier
+    # direct edge (10) is in range and returned (reference: only
+    # in-range paths count toward numpaths)
+    got = eng.query(q % ", minweight: 9")
+    assert got["_path_"][0]["_weight_"] == 10.0
+    assert [x["name"] for x in got["p"]] == ["n1", "n3"]
+    # a window that excludes everything returns nothing
+    assert not eng.query(q % ", minweight: 11").get("_path_")
+
+
+def test_from_equals_to_consistent_across_modes():
+    """from == to returns exactly the trivial path in BOTH the
+    unweighted and weighted branches — cycles back to the source are
+    not simple paths."""
+    b = StoreBuilder(parse_schema(SCHEMA))
+    for uid in (1, 2, 3):
+        b.add_value(uid, "name", f"n{uid}")
+    b.add_edge(1, "link", 2, facets={"w": 1})
+    b.add_edge(2, "link", 1, facets={"w": 1})
+    b.add_edge(1, "link", 3, facets={"w": 1})
+    b.add_edge(3, "link", 1, facets={"w": 1})
+    eng = Engine(b.finalize(), device_threshold=10**9)
+    un = eng.query('{ path as shortest(from: 0x1, to: 0x1, numpaths: 4)'
+                   ' { link } }')
+    assert [_chain(p) for p in un["_path_"]] == [[1]]
+    w = eng.query('{ path as shortest(from: 0x1, to: 0x1, numpaths: 4)'
+                  ' { link @facets(w) } }')
+    assert [_chain(p) for p in w["_path_"]] == [[1]]
+
+
+def test_unweighted_weight_bounds_apply():
+    """Unweighted edges weigh 1: maxweight bounds hop count, minweight
+    skips shorter paths but keeps searching for longer in-range ones."""
+    b = StoreBuilder(parse_schema(SCHEMA))
+    for uid in (1, 2, 3):
+        b.add_value(uid, "name", f"n{uid}")
+    b.add_edge(1, "link", 3)            # 1 hop
+    b.add_edge(1, "link", 2)
+    b.add_edge(2, "link", 3)            # 2 hops
+    eng = Engine(b.finalize(), device_threshold=10**9)
+    q = '{ path as shortest(from: 0x1, to: 0x3%s) { link } }'
+    assert _chain(eng.query(q % "")["_path_"][0]) == [1, 3]
+    # a 2-hop path exceeds maxweight 1; the direct edge fits
+    assert _chain(eng.query(q % ", maxweight: 1")["_path_"][0]) == [1, 3]
+    # minweight 2 skips the direct edge, finds the 2-hop detour
+    assert _chain(eng.query(q % ", minweight: 2")["_path_"][0]) \
+        == [1, 2, 3]
+    assert not eng.query(q % ", minweight: 3").get("_path_")
 
 
 def test_zero_weight_cycle_yields_simple_paths_only():
