@@ -269,11 +269,34 @@ class Parser:
                 f.args.extend([body[1:], flags])
             elif t.kind == "number":
                 f.args.append(_parse_number(t.text))
+            elif t.text == "[":
+                # nested numeric array — geo coordinates:
+                # near(loc, [lon, lat], d), within(loc, [[[...]]])
+                f.args.append(self._parse_array())
             else:
                 v = self._subst(t.text)
                 f.args.append(v)
         _check_arity(f)
         return f
+
+    def _parse_array(self):
+        """JSON-style nested array of numbers; opening '[' consumed."""
+        out = []
+        while not self.accept("]"):
+            if out:
+                self.expect(",")
+                if self.peek().text == "]":  # trailing comma
+                    continue
+            t = self.next()
+            if t.text == "[":
+                out.append(self._parse_array())
+            elif t.kind == "number":
+                out.append(_parse_number(t.text))
+            else:
+                raise ParseError(
+                    f"expected number or '[' in array, got {t.text!r} "
+                    f"at {t.pos}")
+        return out
 
     # -- filter trees -------------------------------------------------------
     def parse_filter(self) -> FilterNode:
@@ -449,6 +472,20 @@ class Parser:
             sg.is_val_leaf = True
             self.expect(")")
             return sg
+        if name == "checkpwd":
+            # checkpwd(pred, "password") — verify against the stored
+            # password hash (reference: password scalar + checkpwd)
+            self.next()
+            self.expect("(")
+            sg.attr = self.name()
+            self.expect(",")
+            t = self.next()
+            if t.kind != "string":
+                raise ParseError(
+                    f"checkpwd needs a quoted password at {t.pos}")
+            sg.checkpwd_val = _unquote(t)
+            self.expect(")")
+            return sg
         if name in AGG_FUNCS and self.peek(1).text == "(":
             self.next()
             self.expect("(")
@@ -582,6 +619,7 @@ _ARITY = {  # args after the attr: (min, max)
     "gt": (1, 1), "eq": (1, 10**9), "anyofterms": (1, 10**9),
     "allofterms": (1, 10**9), "regexp": (1, 2), "match": (1, 2),
     "has": (0, 0),
+    "near": (2, 2), "within": (1, 1), "contains": (1, 1),
 }
 
 

@@ -50,7 +50,108 @@ def eval_func(store: Store, f: FuncNode, val_env: dict | None = None) -> np.ndar
         return _regexp(store, f)
     if name == "match":
         return _match(store, f)
+    if name in ("near", "within", "contains"):
+        return _geo_func(store, f, name)
     raise ValueError(f"unknown function {f.name!r}")
+
+
+def _geo_func(store: Store, f: FuncNode, name: str) -> np.ndarray:
+    """Geo queries: cell-cover candidates from the geo index (when
+    present), exact haversine / point-in-polygon verification after —
+    the reference's two-phase S2 shape (tok geo + types/geo filters).
+    Without an index the whole value column is verified."""
+    from dgraph_tpu.store import geo as G
+
+    pd = store.preds.get(f.attr)
+    if pd is None:
+        return np.zeros(0, np.int32)
+
+    def candidates(tokens) -> np.ndarray:
+        idx = pd.index.get("geo")
+        if idx is None or tokens is None:  # no index / cover too big
+            parts = [col.has() for col in pd.vals.values()]
+            return (np.unique(np.concatenate(parts)).astype(np.int32)
+                    if parts else np.zeros(0, np.int32))
+        hits = [idx[t] for t in tokens if t in idx]
+        if not hits:
+            return np.zeros(0, np.int32)
+        return np.unique(np.concatenate(hits)).astype(np.int32)
+
+    def geo_vals(rank: int):
+        for col in pd.vals.values():
+            for v in col.get(rank):
+                if isinstance(v, G.GeoVal):
+                    yield v
+
+    def _coord(arg, ctx):
+        if (not isinstance(arg, (list, tuple)) or len(arg) < 2
+                or not all(isinstance(x, (int, float)) for x in arg[:2])):
+            raise ValueError(f"{ctx} needs [longitude, latitude]")
+        return float(arg[0]), float(arg[1])
+
+    if name == "near":
+        lon, lat = _coord(f.args[0], "near()")
+        if not isinstance(f.args[1], (int, float)):
+            raise ValueError("near() needs a numeric distance in meters")
+        meters = float(f.args[1])
+        out = []
+        for r in candidates(G.cover_near(lon, lat, meters)).tolist():
+            for v in geo_vals(r):
+                pt = v.point()
+                if pt is not None and \
+                        G.haversine_m(lon, lat, *pt) <= meters:
+                    out.append(r)
+                    break
+                rings = v.rings()
+                if rings and G.dist_to_polygon_m(lon, lat,
+                                                 rings) <= meters:
+                    out.append(r)
+                    break
+        return np.array(sorted(out), np.int32)
+
+    if name == "within":
+        arg = f.args[0]
+        if not isinstance(arg, (list, tuple)) or not arg:
+            raise ValueError("within() needs polygon coordinates "
+                             "[[[lon, lat], ...]]")
+        try:
+            rings = [[_coord(pt, "within() ring position")
+                      for pt in ring] for ring in arg]
+        except (TypeError, ValueError) as e:
+            raise ValueError(f"within() polygon is malformed: {e}")
+        if not rings[0] or len(rings[0]) < 4:
+            raise ValueError("within() outer ring needs >= 4 positions")
+        xs = [x for x, _ in rings[0]]
+        ys = [y for _, y in rings[0]]
+        toks = G.cover_bbox(min(xs), min(ys), max(xs), max(ys))
+        out = []
+        for r in candidates(toks).tolist():
+            for v in geo_vals(r):
+                pt = v.point()
+                if pt is not None and G.point_in_polygon(*pt, rings):
+                    out.append(r)
+                    break
+                vrings = v.rings()
+                # a stored polygon is within the query area when its
+                # whole boundary is (vertex containment — the verify
+                # granularity the cell cover supports)
+                if vrings and all(G.point_in_polygon(x, y, rings)
+                                  for x, y in vrings[0]):
+                    out.append(r)
+                    break
+        return np.array(sorted(out), np.int32)
+
+    # contains(loc, [lon, lat]): stored POLYGONS containing the point
+    lon, lat = _coord(f.args[0], "contains()")
+    toks = {f"{p}:{G.geohash(lon, lat, p)}" for p in G.PRECISIONS}
+    out = []
+    for r in candidates(toks).tolist():
+        for v in geo_vals(r):
+            rings = v.rings()
+            if rings and G.point_in_polygon(lon, lat, rings):
+                out.append(r)
+                break
+    return np.array(sorted(out), np.int32)
 
 
 # -- helpers ----------------------------------------------------------------

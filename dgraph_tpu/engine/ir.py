@@ -83,6 +83,7 @@ class SubGraph:
     # node-type flags
     is_count: bool = False            # count(pred) leaf
     is_uid_leaf: bool = False         # the literal `uid` field
+    checkpwd_val: Optional[str] = None  # checkpwd(pred, "pw") leaf
     is_agg: bool = False              # min/max/sum/avg(val(x)) leaf
     agg_func: str = ""
     is_val_leaf: bool = False         # val(x) leaf

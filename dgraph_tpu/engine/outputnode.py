@@ -21,7 +21,8 @@ import numpy as np
 
 from dgraph_tpu.engine.execute import LevelNode
 from dgraph_tpu.engine.groupby import _aggregate
-from dgraph_tpu.store.types import Kind
+from dgraph_tpu.store.geo import GeoVal
+from dgraph_tpu.store.types import Kind, check_password
 
 
 def to_json(ex, roots: list[LevelNode]) -> dict:
@@ -202,15 +203,29 @@ class _Renderer:
                 if rank in v:
                     obj[leaf.alias] = _json_val(v[rank])
             return
-        # plain value predicate
+        if leaf.checkpwd_val is not None:
+            # checkpwd(pred, "pw"): verify against the stored hash; the
+            # hash itself never renders (reference: checkpwd)
+            vs = self._leaf_vals_for(leaf, rank, domain)
+            ok = any(check_password(leaf.checkpwd_val, str(v))
+                     for v in vs)
+            obj[leaf.alias or f"checkpwd({leaf.attr})"] = ok
+            return
+        # plain value predicate — (is_list, is_password) resolve from the
+        # schema ONCE per leaf, not per rendered node
+        info = self._is_list.get(id(leaf))
+        if info is None:
+            ps = self.store.schema.peek(leaf.attr)
+            info = self._is_list[id(leaf)] = (
+                bool(ps and ps.is_list),
+                bool(ps and ps.kind == Kind.PASSWORD))
+        is_list, is_password = info
+        if is_password:
+            return  # password hashes never render (reference semantics)
         vs = self._leaf_vals_for(leaf, rank, domain)
         if not vs:
             return
         name = leaf.alias or (f"{leaf.attr}@{leaf.lang}" if leaf.lang else leaf.attr)
-        is_list = self._is_list.get(id(leaf))
-        if is_list is None:
-            ps = self.store.schema.peek(leaf.attr)
-            is_list = self._is_list[id(leaf)] = bool(ps and ps.is_list)
         if is_list or len(vs) > 1:
             obj[name] = [_json_val(v) for v in vs]
         else:
@@ -395,6 +410,8 @@ def _uid_str(uid) -> str:
 
 
 def _json_val(v):
+    if isinstance(v, GeoVal):
+        return v.obj  # render geo scalars as GeoJSON objects
     if isinstance(v, np.datetime64):
         s = np.datetime_as_string(v, unit="us")
         if s.endswith(".000000"):

@@ -27,6 +27,7 @@ import json
 import os
 import re
 import time
+from dgraph_tpu.store.types import check_password, hash_password
 
 READ, WRITE, MODIFY = 4, 2, 1
 GROOT, GUARDIANS = "groot", "guardians"
@@ -59,22 +60,12 @@ def _check_userid(userid: str) -> str:
     return userid
 
 
-def _hash_password(password: str, salt: bytes | None = None) -> str:
-    salt = salt or os.urandom(16)
-    dk = hashlib.scrypt(password.encode(), salt=salt, n=2**14, r=8, p=1)
-    return base64.b64encode(salt).decode() + "$" + \
-        base64.b64encode(dk).decode()
+def _hash_password(password: str) -> str:
+    return hash_password(password)
 
 
 def _check_password(password: str, stored: str) -> bool:
-    try:
-        salt_b64, dk_b64 = stored.split("$", 1)
-        salt = base64.b64decode(salt_b64)
-        dk = hashlib.scrypt(password.encode(), salt=salt,
-                            n=2**14, r=8, p=1)
-        return hmac.compare_digest(dk, base64.b64decode(dk_b64))
-    except Exception:  # noqa: BLE001 — malformed hash = no access
-        return False
+    return check_password(password, stored)
 
 
 class AclManager:

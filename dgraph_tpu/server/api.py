@@ -28,7 +28,7 @@ from dgraph_tpu.loader.xidmap import XidMap
 from dgraph_tpu.store.mvcc import MVCCStore, Mutation
 from dgraph_tpu.store.schema import parse_schema
 from dgraph_tpu.store.store import Store
-from dgraph_tpu.store.types import Kind
+from dgraph_tpu.store.types import Kind, hash_password
 
 __all__ = ["Alpha", "Txn", "TxnAborted"]
 
@@ -990,7 +990,20 @@ class Txn:
             if delete:
                 m.val_dels.append((s, nq.predicate, None, nq.lang))
             else:
-                m.val_sets.append((s, nq.predicate, nq.object_value, nq.lang,
+                value = nq.object_value
+                ps = self.alpha.mvcc.schema.peek(nq.predicate)
+                if ps is not None and ps.kind == Kind.PASSWORD:
+                    # hash ONCE at ingestion: the WAL/broadcast carry the
+                    # hash, so replay is deterministic and plaintext
+                    # never reaches disk (reference: password scalar)
+                    value = hash_password(str(value))
+                elif ps is not None and ps.kind == Kind.GEO:
+                    # validate + canonicalize GeoJSON at ingestion so a
+                    # malformed literal fails the mutation, not a later
+                    # materialize (reference: geo conversion at mutate)
+                    from dgraph_tpu.store.geo import parse_geo
+                    value = parse_geo(value)
+                m.val_sets.append((s, nq.predicate, value, nq.lang,
                                    nq.facets))
 
     # -- outcome ------------------------------------------------------------

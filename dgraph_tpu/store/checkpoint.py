@@ -25,6 +25,7 @@ import numpy as np
 
 from dgraph_tpu.store import vault
 from dgraph_tpu.store.schema import parse_schema
+from dgraph_tpu.store.types import Kind
 from dgraph_tpu.store.store import (
     EdgeRel, FacetCol, PredicateData, Store, ValueColumn, build_indexes)
 # facet scalars use the WAL's codec so both durability paths (checkpoint
@@ -194,6 +195,13 @@ def load(dirname: str) -> tuple[Store, int]:
                 allow_pickle=False)
             if vals.dtype.kind == "U":  # restore string columns to object
                 vals = vals.astype(object)
+            ps = schema.get(pred)
+            if ps is not None and ps.kind == Kind.GEO and len(vals):
+                # geo columns persist as GeoJSON strings; re-wrap
+                from dgraph_tpu.store.geo import parse_geo
+                out = np.empty(len(vals), dtype=object)
+                out[:] = [parse_geo(v) for v in vals]
+                vals = out
             pd.vals[lang] = ValueColumn(
                 subj=vault.load_np(
                     os.path.join(dirname, f"{slug}.val.{lslug}.subj.npy")),

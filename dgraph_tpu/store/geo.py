@@ -1,0 +1,280 @@
+"""Geo scalar type + geohash cell index.
+
+Reference parity: `types/geo.go` + `tok/tok.go` geo tokenizer — the
+reference stores GeoJSON values (Point/Polygon) and indexes them with S2
+cell coverings; queries (`near`, `within`, `contains`) look up covering
+cells then post-filter exactly. Here the cell scheme is classic geohash
+(base32 quad subdivision) instead of S2 — same two-phase shape: coarse
+cell-token candidates from the inverted index, exact haversine /
+point-in-polygon verification after.
+
+Values are wrapped in `GeoVal` — hashable (canonical compact JSON), so
+set-semantics dedup, WAL round-trip, and checkpoint string columns all
+work unchanged.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+from dataclasses import dataclass
+
+_B32 = "0123456789bcdefghjkmnpqrstuvwxyz"
+M_PER_DEG_LAT = 111_320.0
+# points index at every precision in this ladder; query covers pick the
+# finest precision whose cells still dominate the query radius/box
+PRECISIONS = (2, 3, 4, 5, 6, 7)
+MAX_COVER_CELLS = 96   # bbox covers larger than this fall back to scan
+
+
+class GeoError(ValueError):
+    pass
+
+
+@dataclass(frozen=True)
+class GeoVal:
+    """Canonical GeoJSON value (compact-JSON string, hashable)."""
+
+    gj: str
+
+    @property
+    def obj(self) -> dict:
+        return json.loads(self.gj)
+
+    @property
+    def kind(self) -> str:
+        return self.obj.get("type", "")
+
+    def point(self) -> tuple[float, float] | None:
+        o = self.obj
+        if o.get("type") == "Point":
+            lon, lat = o["coordinates"][:2]
+            return float(lon), float(lat)
+        return None
+
+    def rings(self) -> list[list[tuple[float, float]]]:
+        """Polygon rings (outer first, then holes); [] for non-polygons."""
+        o = self.obj
+        if o.get("type") == "Polygon":
+            return [[(float(x), float(y)) for x, y in ring]
+                    for ring in o["coordinates"]]
+        return []
+
+    def __str__(self) -> str:  # export/RDF literal form
+        return self.gj
+
+
+def parse_geo(value) -> GeoVal:
+    """GeoJSON from a JSON string, dict, or GeoVal (idempotent)."""
+    if isinstance(value, GeoVal):
+        return value
+    if isinstance(value, str):
+        try:
+            obj = json.loads(value)
+        except json.JSONDecodeError as e:
+            raise GeoError(f"invalid GeoJSON string: {e}") from e
+    elif isinstance(value, dict):
+        obj = value
+    else:
+        raise GeoError(f"cannot convert {type(value).__name__} to geo")
+    t = obj.get("type")
+    if t == "Point":
+        c = obj.get("coordinates")
+        if (not isinstance(c, (list, tuple)) or len(c) < 2
+                or not all(isinstance(x, (int, float)) for x in c[:2])):
+            raise GeoError("Point needs [lon, lat] coordinates")
+    elif t == "Polygon":
+        rings = obj.get("coordinates")
+        if not isinstance(rings, (list, tuple)) or not rings or any(
+                len(r) < 4 for r in rings):
+            raise GeoError("Polygon needs rings of >= 4 positions")
+    else:
+        raise GeoError(f"unsupported GeoJSON type {t!r}")
+    return GeoVal(json.dumps(obj, separators=(",", ":"), sort_keys=True))
+
+
+# -- geohash cells ----------------------------------------------------------
+
+def geohash(lon: float, lat: float, precision: int) -> str:
+    lat_lo, lat_hi = -90.0, 90.0
+    lon_lo, lon_hi = -180.0, 180.0
+    bits = bit_count = 0
+    out = []
+    even = True
+    while len(out) < precision:
+        if even:
+            mid = (lon_lo + lon_hi) / 2
+            if lon >= mid:
+                bits = bits * 2 + 1
+                lon_lo = mid
+            else:
+                bits = bits * 2
+                lon_hi = mid
+        else:
+            mid = (lat_lo + lat_hi) / 2
+            if lat >= mid:
+                bits = bits * 2 + 1
+                lat_lo = mid
+            else:
+                bits = bits * 2
+                lat_hi = mid
+        even = not even
+        bit_count += 1
+        if bit_count == 5:
+            out.append(_B32[bits])
+            bits = bit_count = 0
+    return "".join(out)
+
+
+def cell_dims(precision: int) -> tuple[float, float]:
+    """(dlon_degrees, dlat_degrees) of one cell at `precision`."""
+    lon_bits = (5 * precision + 1) // 2
+    lat_bits = (5 * precision) // 2
+    return 360.0 / (1 << lon_bits), 180.0 / (1 << lat_bits)
+
+
+def _cell_meters(precision: int, lat: float) -> float:
+    """Smallest cell dimension in meters at `precision` near `lat`."""
+    dlon, dlat = cell_dims(precision)
+    w = dlon * M_PER_DEG_LAT * max(math.cos(math.radians(lat)), 0.05)
+    h = dlat * M_PER_DEG_LAT
+    return min(w, h)
+
+
+def haversine_m(lon1: float, lat1: float, lon2: float, lat2: float) -> float:
+    r = 6_371_000.0
+    p1, p2 = math.radians(lat1), math.radians(lat2)
+    dp = p2 - p1
+    dl = math.radians(lon2 - lon1)
+    a = math.sin(dp / 2) ** 2 + \
+        math.cos(p1) * math.cos(p2) * math.sin(dl / 2) ** 2
+    return 2 * r * math.asin(min(1.0, math.sqrt(a)))
+
+
+def tokens_for_geo(g: GeoVal) -> list[str]:
+    """Index tokens: every precision in the ladder. Points hash their
+    coordinate; polygons hash a bbox cover per precision (capped — a
+    polygon spanning more cells than the cap at some precision is
+    indexed only at coarser ones)."""
+    pt = g.point()
+    out = []
+    if pt is not None:
+        lon, lat = pt
+        for p in PRECISIONS:
+            out.append(f"{p}:{geohash(lon, lat, p)}")
+        return out
+    rings = g.rings()
+    if rings:
+        xs = [x for x, _ in rings[0]]
+        ys = [y for _, y in rings[0]]
+        for p in PRECISIONS:
+            # the coarsest precision is UNCAPPED so even a continent-
+            # scale polygon is always reachable through the index
+            cells = _bbox_cells(min(xs), min(ys), max(xs), max(ys), p,
+                                cap=None if p == PRECISIONS[0] else
+                                MAX_COVER_CELLS)
+            if cells is None:
+                break  # finer precisions only cost more cells
+            out.extend(f"{p}:{c}" for c in cells)
+    return out
+
+
+def _bbox_cells(min_lon, min_lat, max_lon, max_lat, precision,
+                cap=MAX_COVER_CELLS):
+    """Cell hashes covering a bbox at `precision`, or None past the cap."""
+    dlon, dlat = cell_dims(precision)
+    nx = int((max_lon - min_lon) / dlon) + 2
+    ny = int((max_lat - min_lat) / dlat) + 2
+    if cap is not None and nx * ny > cap:
+        return None
+    cells = set()
+    for i in range(nx):
+        for j in range(ny):
+            lon = min(min_lon + i * dlon, max_lon)
+            lat = min(min_lat + j * dlat, max_lat)
+            cells.add(geohash(lon, lat, precision))
+    return cells
+
+
+def cover_near(lon: float, lat: float, meters: float):
+    """Tokens covering a radius: finest precision whose cell dimension
+    still exceeds the radius, 3x3 block around the center (the circle
+    cannot escape the block then). None when even the COARSEST cell is
+    smaller than the radius — the caller must fall back to a scan, a
+    3x3 block could not contain the circle."""
+    if _cell_meters(PRECISIONS[0], lat) < meters:
+        return None
+    prec = PRECISIONS[0]
+    for p in PRECISIONS:
+        if _cell_meters(p, lat) >= meters:
+            prec = p
+        else:
+            break
+    dlon, dlat = cell_dims(prec)
+    toks = set()
+    for di in (-1, 0, 1):
+        for dj in (-1, 0, 1):
+            # wrap longitude across the antimeridian (a clamp would fold
+            # the western neighbor into the easternmost cell)
+            lo = ((lon + di * dlon + 180.0) % 360.0) - 180.0
+            la = min(max(lat + dj * dlat, -90.0), 90.0)
+            toks.add(f"{prec}:{geohash(lo, la, prec)}")
+    return toks
+
+
+def dist_to_polygon_m(lon: float, lat: float,
+                      rings: list[list[tuple[float, float]]]) -> float:
+    """Distance from a point to a polygon: 0 inside, else the minimum
+    distance to any outer-ring edge (local equirectangular projection —
+    accurate at query-radius scales)."""
+    if point_in_polygon(lon, lat, rings):
+        return 0.0
+    kx = M_PER_DEG_LAT * max(math.cos(math.radians(lat)), 0.05)
+    ky = M_PER_DEG_LAT
+    best = math.inf
+    for ring in rings[:1]:
+        for (x1, y1), (x2, y2) in zip(ring, ring[1:]):
+            ax, ay = (x1 - lon) * kx, (y1 - lat) * ky
+            bx, by = (x2 - lon) * kx, (y2 - lat) * ky
+            dx, dy = bx - ax, by - ay
+            L2 = dx * dx + dy * dy
+            t = 0.0 if L2 == 0 else max(
+                0.0, min(1.0, -(ax * dx + ay * dy) / L2))
+            px, py = ax + t * dx, ay + t * dy
+            best = min(best, math.hypot(px, py))
+    return best
+
+
+def cover_bbox(min_lon, min_lat, max_lon, max_lat):
+    """Tokens covering a bbox at the finest precision under the cell
+    cap; None → caller should scan."""
+    chosen = None
+    for p in PRECISIONS:
+        cells = _bbox_cells(min_lon, min_lat, max_lon, max_lat, p)
+        if cells is None:
+            break
+        chosen = (p, cells)
+    if chosen is None:
+        return None
+    p, cells = chosen
+    return {f"{p}:{c}" for c in cells}
+
+
+def point_in_polygon(lon: float, lat: float,
+                     rings: list[list[tuple[float, float]]]) -> bool:
+    """Ray casting; ring 0 is the outer boundary, the rest are holes."""
+    def in_ring(ring):
+        inside = False
+        j = len(ring) - 1
+        for i in range(len(ring)):
+            xi, yi = ring[i]
+            xj, yj = ring[j]
+            if ((yi > lat) != (yj > lat)) and \
+                    lon < (xj - xi) * (lat - yi) / (yj - yi) + xi:
+                inside = not inside
+            j = i
+        return inside
+
+    if not rings or not in_ring(rings[0]):
+        return False
+    return not any(in_ring(h) for h in rings[1:])

@@ -10,7 +10,7 @@ Grammar (the subset the reference's Alter accepts, minus enterprise):
             [@upsert] [@unique] .
     type <Name> { <pred1> <pred2> ... }
 
-where <type> is one of uid|int|float|string|bool|datetime|password|default,
+where <type> is one of uid|int|float|string|bool|datetime|password|geo|default,
 optionally wrapped in [] for list-valued predicates.
 """
 

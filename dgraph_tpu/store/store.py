@@ -514,7 +514,8 @@ def build_indexes(preds: dict[str, PredicateData]) -> None:
         if not ps.index_tokenizers:
             continue
         for tk in ps.index_tokenizers:
-            if tk not in ("exact", "hash", "term", "fulltext", "trigram"):
+            if tk not in ("exact", "hash", "term", "fulltext", "trigram",
+                          "geo"):
                 continue  # numeric/datetime ranges use sorted columns
             inv: dict[str, list[int]] = {}
             for lang, col in pd.vals.items():

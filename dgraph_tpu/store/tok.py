@@ -72,6 +72,13 @@ def trigram_tokens(value) -> list[str]:
     return sorted({s[i:i + 3] for i in range(len(s) - 2)}) if len(s) >= 3 else []
 
 
+def geo_tokens(value) -> list[str]:
+    """Geohash cell tokens at every ladder precision (reference: the S2
+    cell tokenizer; store/geo.py)."""
+    from dgraph_tpu.store.geo import parse_geo, tokens_for_geo
+    return tokens_for_geo(parse_geo(value))
+
+
 TOKENIZERS = {
     "exact": exact_tokens,
     "hash": hash_tokens,
@@ -88,6 +95,7 @@ TOKENIZERS = {
     "month": exact_tokens,
     "day": exact_tokens,
     "hour": exact_tokens,
+    "geo": geo_tokens,
 }
 
 

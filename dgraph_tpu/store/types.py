@@ -25,6 +25,7 @@ class Kind(str, Enum):
     BOOL = "bool"
     DATETIME = "datetime"
     PASSWORD = "password"
+    GEO = "geo"
     DEFAULT = "default"  # untyped: stored as string, coerced on use
 
 
@@ -35,8 +36,38 @@ NUMPY_DTYPE = {
     Kind.BOOL: np.bool_,
     Kind.DATETIME: "datetime64[us]",
     Kind.PASSWORD: object,
+    Kind.GEO: object,
     Kind.DEFAULT: object,
 }
+
+
+def hash_password(password: str) -> str:
+    """Salted scrypt hash, encoded "salt$key" (reference: password scalar
+    values store bcrypt hashes, never plaintext). Hashing happens ONCE at
+    mutation ingestion so the WAL/broadcast carry the hash and replay is
+    deterministic."""
+    import base64
+    import hashlib
+    import os
+    salt = os.urandom(16)
+    dk = hashlib.scrypt(password.encode(), salt=salt, n=2**14, r=8, p=1)
+    return base64.b64encode(salt).decode() + "$" + \
+        base64.b64encode(dk).decode()
+
+
+def check_password(password: str, stored: str) -> bool:
+    """Constant-time verification against a hash_password() value."""
+    import base64
+    import hashlib
+    import hmac
+    try:
+        salt_b64, dk_b64 = stored.split("$", 1)
+        salt = base64.b64decode(salt_b64)
+        dk = hashlib.scrypt(password.encode(), salt=salt,
+                            n=2**14, r=8, p=1)
+        return hmac.compare_digest(dk, base64.b64decode(dk_b64))
+    except Exception:  # noqa: BLE001 — malformed hash = no access
+        return False
 
 
 def parse_datetime(s: str) -> np.datetime64:
@@ -103,6 +134,9 @@ def convert(value, kind: Kind):
         if isinstance(value, _dt.datetime):
             return np.datetime64(value, "us")
         return parse_datetime(str(value))
+    if kind == Kind.GEO:
+        from dgraph_tpu.store.geo import parse_geo
+        return parse_geo(value)
     raise ValueError(f"cannot convert to {kind}")
 
 

@@ -43,6 +43,9 @@ def enc_scalar(v):
         return float(v)
     if isinstance(v, np.datetime64):
         return {"__t": "dt", "v": np.datetime_as_string(v)}
+    from dgraph_tpu.store.geo import GeoVal
+    if isinstance(v, GeoVal):
+        return {"__t": "geo", "v": v.gj}
     if v is None or isinstance(v, str):
         return v
     return {"__t": "s", "v": str(v)}
@@ -52,6 +55,9 @@ def dec_scalar(v):
     if isinstance(v, dict) and "__t" in v:
         if v["__t"] == "dt":
             return np.datetime64(v["v"])
+        if v["__t"] == "geo":
+            from dgraph_tpu.store.geo import GeoVal
+            return GeoVal(v["v"])
         return v["v"]
     return v
 

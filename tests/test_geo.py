@@ -1,0 +1,216 @@
+"""Geo scalar type + geohash index + near/within/contains queries
+(reference: types/geo.go, tok geo tokenizer, S2-cover query shape)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from dgraph_tpu.server.api import Alpha
+from dgraph_tpu.store import geo as G
+
+SCHEMA = "name: string @index(exact) .\nloc: geo @index(geo) ."
+
+# a few real-world points (lon, lat)
+PLACES = {
+    "sf_ferry": (-122.3937, 37.7955),
+    "sf_mission": (-122.4148, 37.7599),
+    "oakland": (-122.2712, 37.8044),
+    "la": (-118.2437, 34.0522),
+    "nyc": (-74.0060, 40.7128),
+}
+
+
+def _pt(lon, lat):
+    return json.dumps({"type": "Point", "coordinates": [lon, lat]})
+
+
+def _alpha():
+    a = Alpha(device_threshold=10**9)
+    a.alter(SCHEMA)
+    nq = []
+    for name, (lon, lat) in PLACES.items():
+        nq.append(f'_:{name} <name> "{name}" .')
+        nq.append(f"_:{name} <loc> {json.dumps(_pt(lon, lat))} .")
+    a.mutate(set_nquads="\n".join(nq))
+    return a
+
+
+def test_geohash_properties():
+    # nearby points share prefixes; cells nest
+    h1 = G.geohash(-122.3937, 37.7955, 7)
+    h2 = G.geohash(-122.3938, 37.7956, 7)
+    assert h1[:5] == h2[:5]
+    assert G.geohash(-122.3937, 37.7955, 4) == h1[:4]
+    # haversine sanity: SF ferry building to Oakland ≈ 10.8 km
+    d = G.haversine_m(*PLACES["sf_ferry"], *PLACES["oakland"])
+    assert 9_000 < d < 13_000
+
+
+def test_near_query():
+    a = _alpha()
+    lon, lat = PLACES["sf_ferry"]
+    out = a.query('{ q(func: near(loc, [%f, %f], 10000), orderasc: name)'
+                  ' { name } }' % (lon, lat))
+    names = [r["name"] for r in out["q"]]
+    assert names == ["sf_ferry", "sf_mission"]  # oakland is ~10.8km
+    out = a.query('{ q(func: near(loc, [%f, %f], 20000), orderasc: name)'
+                  ' { name } }' % (lon, lat))
+    assert [r["name"] for r in out["q"]] == \
+        ["oakland", "sf_ferry", "sf_mission"]
+    # tiny radius: only the exact point
+    out = a.query('{ q(func: near(loc, [%f, %f], 10)) { name } }'
+                  % (lon, lat))
+    assert [r["name"] for r in out["q"]] == ["sf_ferry"]
+
+
+def test_within_query():
+    a = _alpha()
+    # a box around the SF peninsula (lon, lat pairs, closed ring)
+    ring = [[-122.52, 37.70], [-122.52, 37.84],
+            [-122.35, 37.84], [-122.35, 37.70], [-122.52, 37.70]]
+    out = a.query('{ q(func: within(loc, %s), orderasc: name) { name } }'
+                  % json.dumps([ring]))
+    assert [r["name"] for r in out["q"]] == ["sf_ferry", "sf_mission"]
+
+
+def test_contains_query_on_stored_polygon():
+    a = Alpha(device_threshold=10**9)
+    a.alter(SCHEMA)
+    ring = [[-123.0, 37.0], [-123.0, 38.5],
+            [-121.5, 38.5], [-121.5, 37.0], [-123.0, 37.0]]
+    poly = json.dumps({"type": "Polygon", "coordinates": [ring]})
+    a.mutate(set_nquads=(
+        f'_:bay <name> "bay_area" .\n'
+        f"_:bay <loc> {json.dumps(poly)} .\n"
+        '_:other <name> "elsewhere" .\n'
+        "_:other <loc> " + json.dumps(json.dumps(
+            {"type": "Polygon", "coordinates": [[
+                [10.0, 10.0], [10.0, 11.0], [11.0, 11.0],
+                [11.0, 10.0], [10.0, 10.0]]]})) + " .\n"))
+    lon, lat = PLACES["sf_ferry"]
+    out = a.query('{ q(func: contains(loc, [%f, %f])) { name } }'
+                  % (lon, lat))
+    assert [r["name"] for r in out["q"]] == ["bay_area"]
+    out = a.query('{ q(func: contains(loc, [0.0, 0.0])) { name } }')
+    assert out["q"] == []
+
+
+def test_geo_renders_as_geojson_and_roundtrips(tmp_path):
+    a = Alpha.open(str(tmp_path / "p"), sync=False)
+    a.alter(SCHEMA)
+    a.mutate(set_nquads=f'_:x <name> "spot" .\n'
+                        f"_:x <loc> {json.dumps(_pt(1.5, -2.25))} .")
+    out = a.query('{ q(func: eq(name, "spot")) { name loc } }')
+    assert out["q"][0]["loc"] == {"type": "Point",
+                                  "coordinates": [1.5, -2.25]}
+    # WAL replay (crash) keeps the value queryable
+    a.wal.close()
+    a2 = Alpha.open(str(tmp_path / "p"), sync=False)
+    out = a2.query('{ q(func: near(loc, [1.5, -2.25], 5)) { name } }')
+    assert out["q"] == [{"name": "spot"}]
+    # checkpoint round-trip too
+    a2.checkpoint_to(str(tmp_path / "p"))
+    a3 = Alpha.open(str(tmp_path / "p"), sync=False)
+    out = a3.query('{ q(func: near(loc, [1.5, -2.25], 5)) { name } }')
+    assert out["q"] == [{"name": "spot"}]
+
+
+def test_near_matches_bruteforce_random():
+    """Index-covered near == exhaustive haversine scan on random points."""
+    rng = np.random.default_rng(4)
+    a = Alpha(device_threshold=10**9)
+    a.alter(SCHEMA)
+    pts = []
+    nq = []
+    for i in range(300):
+        lon = float(rng.uniform(-10, 10))
+        lat = float(rng.uniform(40, 55))
+        pts.append((lon, lat))
+        nq.append(f'_:p{i} <name> "p{i}" .')
+        nq.append(f"_:p{i} <loc> {json.dumps(_pt(lon, lat))} .")
+    a.mutate(set_nquads="\n".join(nq))
+    for clon, clat, radius in [(0.0, 47.0, 50_000), (5.0, 50.0, 200_000),
+                               (-8.0, 42.0, 500_000), (3.0, 44.0, 5_000)]:
+        out = a.query('{ q(func: near(loc, [%f, %f], %d)) { name } }'
+                      % (clon, clat, radius))
+        got = sorted(r["name"] for r in out["q"])
+        want = sorted(
+            f"p{i}" for i, (lon, lat) in enumerate(pts)
+            if G.haversine_m(clon, clat, lon, lat) <= radius)
+        assert got == want, (clon, clat, radius)
+
+
+def test_large_radius_falls_back_to_scan():
+    """A radius larger than the coarsest cell can't be covered by a 3x3
+    block — the cover returns None and near() scans, losing nothing."""
+    assert G.cover_near(0.0, 37.0, 700_000) is None
+    a = _alpha()
+    lon, lat = PLACES["sf_ferry"]
+    out = a.query('{ q(func: near(loc, [%f, %f], 700000), '
+                  'orderasc: name) { name } }' % (lon, lat))
+    # LA is ~559 km from SF — inside 700 km; only NYC stays out
+    assert [r["name"] for r in out["q"]] == \
+        ["la", "oakland", "sf_ferry", "sf_mission"]
+
+
+def test_near_wraps_antimeridian():
+    a = Alpha(device_threshold=10**9)
+    a.alter(SCHEMA)
+    a.mutate(set_nquads=f'_:w <name> "west" .\n'
+                        f"_:w <loc> {json.dumps(_pt(-179.99, 0.0))} .")
+    out = a.query('{ q(func: near(loc, [179.99, 0.0], 10000)) '
+                  '{ name } }')
+    assert [r["name"] for r in out["q"]] == ["west"]
+
+
+def test_near_and_within_match_stored_polygons():
+    a = Alpha(device_threshold=10**9)
+    a.alter(SCHEMA)
+    ring = [[-122.5, 37.7], [-122.5, 37.85],
+            [-122.35, 37.85], [-122.35, 37.7], [-122.5, 37.7]]
+    poly = json.dumps({"type": "Polygon", "coordinates": [ring]})
+    a.mutate(set_nquads=f'_:sf <name> "sf_poly" .\n'
+                        f"_:sf <loc> {json.dumps(poly)} .")
+    # near: a point inside the polygon is distance 0; a point ~5 km east
+    # of the boundary matches at 10 km but not at 1 km
+    out = a.query('{ q(func: near(loc, [-122.40, 37.78], 1000)) '
+                  '{ name } }')
+    assert [r["name"] for r in out["q"]] == ["sf_poly"]
+    out = a.query('{ q(func: near(loc, [-122.29, 37.78], 10000)) '
+                  '{ name } }')
+    assert [r["name"] for r in out["q"]] == ["sf_poly"]
+    out = a.query('{ q(func: near(loc, [-122.29, 37.78], 1000)) '
+                  '{ name } }')
+    assert out["q"] == []
+    # within: the stored polygon is inside a bigger query box
+    big = [[-123.0, 37.0], [-123.0, 38.5], [-121.5, 38.5],
+           [-121.5, 37.0], [-123.0, 37.0]]
+    out = a.query('{ q(func: within(loc, %s)) { name } }'
+                  % json.dumps([big]))
+    assert [r["name"] for r in out["q"]] == ["sf_poly"]
+    # ...but not inside a box that clips it
+    small = [[-122.45, 37.0], [-122.45, 38.5], [-121.5, 38.5],
+             [-121.5, 37.0], [-122.45, 37.0]]
+    out = a.query('{ q(func: within(loc, %s)) { name } }'
+                  % json.dumps([small]))
+    assert out["q"] == []
+
+
+def test_malformed_geo_args_raise_cleanly():
+    a = _alpha()
+    for q in ('{ q(func: near(loc, 5, 10)) { name } }',
+              '{ q(func: within(loc, [1, 2])) { name } }',
+              '{ q(func: within(loc, [])) { name } }',
+              '{ q(func: contains(loc, 7)) { name } }'):
+        with pytest.raises(ValueError):
+            a.query(q)
+
+
+def test_invalid_geojson_rejected():
+    a = Alpha(device_threshold=10**9)
+    a.alter(SCHEMA)
+    with pytest.raises(Exception):
+        a.mutate(set_nquads='_:x <loc> "not json" .')
+    with pytest.raises(Exception):
+        a.mutate(set_nquads='_:x <loc> "{\\"type\\": \\"Nope\\"}" .')
