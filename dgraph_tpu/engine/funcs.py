@@ -143,7 +143,7 @@ def _geo_func(store: Store, f: FuncNode, name: str) -> np.ndarray:
 
     # contains(loc, [lon, lat]): stored POLYGONS containing the point
     lon, lat = _coord(f.args[0], "contains()")
-    toks = {f"{p}:{G.geohash(lon, lat, p)}" for p in G.PRECISIONS}
+    toks = set(G.point_tokens(lon, lat, prefix="py"))
     out = []
     for r in candidates(toks).tolist():
         for v in geo_vals(r):

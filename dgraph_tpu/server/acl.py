@@ -24,7 +24,6 @@ import base64
 import hashlib
 import hmac
 import json
-import os
 import re
 import time
 from dgraph_tpu.store.types import check_password, hash_password

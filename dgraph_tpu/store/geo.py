@@ -15,6 +15,7 @@ work unchanged.
 
 from __future__ import annotations
 
+import functools
 import json
 import math
 from dataclasses import dataclass
@@ -37,8 +38,10 @@ class GeoVal:
 
     gj: str
 
-    @property
+    @functools.cached_property
     def obj(self) -> dict:
+        # cached: verify phases call point()/rings() repeatedly per value
+        # (cached_property writes to __dict__, bypassing frozen setattr)
         return json.loads(self.gj)
 
     @property
@@ -151,32 +154,41 @@ def haversine_m(lon1: float, lat1: float, lon2: float, lat2: float) -> float:
     return 2 * r * math.asin(min(1.0, math.sqrt(a)))
 
 
-def tokens_for_geo(g: GeoVal) -> list[str]:
-    """Index tokens: every precision in the ladder. Points hash their
-    coordinate; polygons hash a bbox cover per precision (capped — a
-    polygon spanning more cells than the cap at some precision is
-    indexed only at coarser ones)."""
-    pt = g.point()
+def point_tokens(lon: float, lat: float, prefix: str = "pt") -> list[str]:
+    """One token per ladder precision for a coordinate. Point and
+    polygon tokens live in SEPARATE namespaces ("pt:"/"py:") so polygon
+    lookups can scan the whole precision ladder without dragging every
+    nearby point in as a candidate."""
+    return [f"{prefix}:{p}:{geohash(lon, lat, p)}" for p in PRECISIONS]
+
+
+def polygon_cover_tokens(min_lon, min_lat, max_lon, max_lat) -> list[str]:
+    """bbox-cover tokens per precision, stopping at the first precision
+    whose cover exceeds the cap (the coarsest is UNCAPPED so even a
+    continent-scale polygon is always reachable through the index)."""
     out = []
+    for p in PRECISIONS:
+        cells = _bbox_cells(min_lon, min_lat, max_lon, max_lat, p,
+                            cap=None if p == PRECISIONS[0] else
+                            MAX_COVER_CELLS)
+        if cells is None:
+            break  # finer precisions only cost more cells
+        out.extend(f"py:{p}:{c}" for c in cells)
+    return out
+
+
+def tokens_for_geo(g: GeoVal) -> list[str]:
+    """Index tokens: points at every ladder precision; polygons by bbox
+    cover per precision (see polygon_cover_tokens)."""
+    pt = g.point()
     if pt is not None:
-        lon, lat = pt
-        for p in PRECISIONS:
-            out.append(f"{p}:{geohash(lon, lat, p)}")
-        return out
+        return point_tokens(*pt)
     rings = g.rings()
     if rings:
         xs = [x for x, _ in rings[0]]
         ys = [y for _, y in rings[0]]
-        for p in PRECISIONS:
-            # the coarsest precision is UNCAPPED so even a continent-
-            # scale polygon is always reachable through the index
-            cells = _bbox_cells(min(xs), min(ys), max(xs), max(ys), p,
-                                cap=None if p == PRECISIONS[0] else
-                                MAX_COVER_CELLS)
-            if cells is None:
-                break  # finer precisions only cost more cells
-            out.extend(f"{p}:{c}" for c in cells)
-    return out
+        return polygon_cover_tokens(min(xs), min(ys), max(xs), max(ys))
+    return []
 
 
 def _bbox_cells(min_lon, min_lat, max_lon, max_lat, precision,
@@ -210,15 +222,24 @@ def cover_near(lon: float, lat: float, meters: float):
             prec = p
         else:
             break
-    dlon, dlat = cell_dims(prec)
     toks = set()
-    for di in (-1, 0, 1):
-        for dj in (-1, 0, 1):
-            # wrap longitude across the antimeridian (a clamp would fold
-            # the western neighbor into the easternmost cell)
-            lo = ((lon + di * dlon + 180.0) % 360.0) - 180.0
-            la = min(max(lat + dj * dlat, -90.0), 90.0)
-            toks.add(f"{prec}:{geohash(lo, la, prec)}")
+    # points: the 3x3 block at the radius-matched precision. Polygons:
+    # the 3x3 block at EVERY precision up to it — a large polygon's
+    # capped cover may only exist at coarser precisions than the query's
+    # (its tokens are rare, so the coarse lookups stay cheap).
+    for p in PRECISIONS:
+        if p > prec:
+            break
+        dlon, dlat = cell_dims(p)
+        for di in (-1, 0, 1):
+            for dj in (-1, 0, 1):
+                # wrap longitude across the antimeridian (a clamp would
+                # fold the western neighbor into the easternmost cell)
+                lo = ((lon + di * dlon + 180.0) % 360.0) - 180.0
+                la = min(max(lat + dj * dlat, -90.0), 90.0)
+                toks.add(f"py:{p}:{geohash(lo, la, p)}")
+                if p == prec:
+                    toks.add(f"pt:{p}:{geohash(lo, la, p)}")
     return toks
 
 
@@ -232,7 +253,8 @@ def dist_to_polygon_m(lon: float, lat: float,
     kx = M_PER_DEG_LAT * max(math.cos(math.radians(lat)), 0.05)
     ky = M_PER_DEG_LAT
     best = math.inf
-    for ring in rings[:1]:
+    # ALL rings: a point inside a hole is closest to the hole's edge
+    for ring in rings:
         for (x1, y1), (x2, y2) in zip(ring, ring[1:]):
             ax, ay = (x1 - lon) * kx, (y1 - lat) * ky
             bx, by = (x2 - lon) * kx, (y2 - lat) * ky
@@ -246,8 +268,10 @@ def dist_to_polygon_m(lon: float, lat: float,
 
 
 def cover_bbox(min_lon, min_lat, max_lon, max_lat):
-    """Tokens covering a bbox at the finest precision under the cell
-    cap; None → caller should scan."""
+    """Tokens covering a bbox: points at the finest under-cap precision,
+    polygons across the ladder (mirrors their capped index cover, which
+    always shares at least the uncapped coarsest precision); None →
+    caller should scan."""
     chosen = None
     for p in PRECISIONS:
         cells = _bbox_cells(min_lon, min_lat, max_lon, max_lat, p)
@@ -257,7 +281,9 @@ def cover_bbox(min_lon, min_lat, max_lon, max_lat):
     if chosen is None:
         return None
     p, cells = chosen
-    return {f"{p}:{c}" for c in cells}
+    toks = {f"pt:{p}:{c}" for c in cells}
+    toks.update(polygon_cover_tokens(min_lon, min_lat, max_lon, max_lat))
+    return toks
 
 
 def point_in_polygon(lon: float, lat: float,

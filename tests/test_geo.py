@@ -197,6 +197,31 @@ def test_near_and_within_match_stored_polygons():
     assert out["q"] == []
 
 
+def test_near_finds_polygon_indexed_only_at_coarse_precision():
+    """A 1.5°-wide polygon's fine-precision cover exceeds the cell cap,
+    so it is indexed only at coarse precisions — a small-radius near()
+    must still find it through the polygon token namespace."""
+    a = Alpha(device_threshold=10**9)
+    a.alter(SCHEMA)
+    ring = [[0.0, 0.0], [0.0, 1.5], [1.5, 1.5], [1.5, 0.0], [0.0, 0.0]]
+    poly = json.dumps({"type": "Polygon", "coordinates": [ring]})
+    a.mutate(set_nquads=f'_:z <name> "zone" .\n'
+                        f"_:z <loc> {json.dumps(poly)} .")
+    out = a.query('{ q(func: near(loc, [0.75, 0.75], 1000)) { name } }')
+    assert [r["name"] for r in out["q"]] == ["zone"]
+
+
+def test_polygon_hole_distance():
+    """A point inside a hole measures distance to the HOLE's edge, and
+    a point inside the hole is not 'in' the polygon."""
+    outer = [[0.0, 0.0], [0.0, 1.0], [1.0, 1.0], [1.0, 0.0], [0.0, 0.0]]
+    hole = [[0.1, 0.1], [0.1, 0.9], [0.9, 0.9], [0.9, 0.1], [0.1, 0.1]]
+    rings = [[(x, y) for x, y in r] for r in (outer, hole)]
+    assert not G.point_in_polygon(0.5, 0.5, rings)
+    d = G.dist_to_polygon_m(0.11, 0.5, rings)
+    assert d < 2_000  # ~1.1 km to the hole edge, not ~12 km to the outer
+
+
 def test_malformed_geo_args_raise_cleanly():
     a = _alpha()
     for q in ('{ q(func: near(loc, 5, 10)) { name } }',
