@@ -54,6 +54,7 @@ class _Renderer:
         self._obj_memo: dict = {}
         self._rec_maps: dict = {}
         self._rec_obj_memo: dict = {}
+        self._facet_keys: dict = {}
 
     def _rec_rows(self, parents: np.ndarray, children: np.ndarray,
                   rank: int) -> np.ndarray:
@@ -230,6 +231,19 @@ class _Renderer:
             obj[name] = [_json_val(v) for v in vs]
         else:
             obj[name] = _json_val(vs[0])
+        if leaf.facet_keys is not None:
+            # facets on VALUE postings render as "name|key" siblings
+            # (reference: facets on scalar predicates); the (keys,
+            # aliases) extraction resolves once per leaf
+            fk = self._facet_keys.get(id(leaf))
+            if fk is None:
+                fk = self._facet_keys[id(leaf)] = (
+                    [k for _, k in leaf.facet_keys] or None,
+                    {k: a for a, k in leaf.facet_keys if a})
+            keys, aliases = fk
+            for k, v in self.store.value_facets(leaf.attr, rank,
+                                                keys).items():
+                obj[aliases.get(k) or f"{name}|{k}"] = _json_val(v)
 
     def _render_edge(self, child: LevelNode, parent: LevelNode, rank: int,
                      obj: dict, aliased_only: bool = False) -> None:

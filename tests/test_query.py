@@ -57,7 +57,8 @@ def build_store():
         b.add_value(uid, "dob", dob)
         b.add_type(uid, "Person")
     b.add_value(1, "name", "Michonne-fr", lang="fr")
-    b.add_value(2, "nickname", "The King")
+    b.add_value(2, "nickname", "The King",
+                facets={"origin": "fans", "since": 1606})
     b.add_value(3, "name", "Maggie", lang="en")
     # uid 7: tagged-only names (lang fallback-chain fixture)
     b.add_value(7, "name", "Zeven", lang="nl")
@@ -830,6 +831,15 @@ CASES = [
     ("multi_hop_mixed_direction", """
      { q(func: uid(6)) { ~friend { ~friend { name } } } }""",
      {"q": [{"~friend": [{"~friend": [{"name": "Leonard"}]}]}]}),
+
+    ("value_facets_bare", """
+     { q(func: uid(2)) { nickname @facets } }""",
+     {"q": [{"nickname": "The King", "nickname|origin": "fans",
+             "nickname|since": 1606}]}),
+
+    ("value_facets_keyed_alias", """
+     { q(func: uid(2)) { nickname @facets(o: origin) } }""",
+     {"q": [{"nickname": "The King", "o": "fans"}]}),
 ]
 
 
