@@ -10,8 +10,8 @@ the same shape, scale noted in the output:
   3. 3-hop @recurse + @filter    LDBC SNB-shaped graph (models/ldbc.py)
   4. shortest(from, to)          powerlaw follower graph (Twitter-shaped,
                                  scaled down; scale noted)
-  5. LDBC IC mix p50             SNB-shaped graph, 9 of the 14
-                                 interactive-complex templates
+  5. LDBC IC mix p50             SNB-shaped graph, all 14
+                                 interactive-complex template shapes
 
 Every number is a real `Engine.query` (parse -> execute -> JSON) wall
 time, post-warmup, best-of-N. Run: python bench_baseline.py [--platform
@@ -134,53 +134,23 @@ def config3_5(threshold, sf=1.0):
         return len(kids) + sum(count(k) for k in kids)
     edges3 = sum(count(r) for r in out3["q"])
 
-    # config 5: IC-style mix — 9 of LDBC SNB Interactive Complex's 14
-    # templates have their shape representable on this model (no
-    # forums/likes/companies, so IC5/7/10/11/14 are out of scope):
+    # config 5: the FULL LDBC SNB Interactive Complex mix — all 14
+    # template shapes on the synthetic model (models/ldbc.py):
     #   IC1  3-hop friend search by first name (ordered, paginated)
     #   IC2  recent messages by friends (orderdesc ts, top 20)
     #   IC3  friends-of-friends in given cities
     #   IC4  topics of friends' recent posts
+    #   IC5  forums my friends belong to
     #   IC6  co-occurring tags on posts tagged X
+    #   IC7  recent likers of my messages
     #   IC8  recent replies to my content (with commenter)
     #   IC9  messages by the 2-hop circle before a date
+    #   IC10 friend-of-friend recommendation (birthday window)
+    #   IC11 friends working at a given organisation
     #   IC12 expert search: friends' replies, by replied-post topic
     #   IC13 shortest knows-path between two persons
-    p_uid = hex(int(g.person_uids[len(g.person_uids) // 2]))
-    p2_uid = hex(int(g.person_uids[7]))
-    fn = g.first_name[3]
-    city2 = g.city[1] if len(g.city) > 1 else city
-    ts_mid = int(np.median(g.creation_ts))
-    tagname = "tag_1"
-    mix = [
-        ('IC1', '{ v as var(func: uid(%s)) @recurse(depth: 3, '
-         'loop: false) { knows } '
-         'q(func: uid(v), orderasc: last_name, first: 20) '
-         '@filter(eq(first_name, "%s")) '
-         '{ first_name last_name city } }' % (p_uid, fn)),
-        ('IC2', '{ q(func: uid(%s)) { knows { ~has_creator '
-         '(orderdesc: creation_ts, first: 20) { creation_ts } } } }'
-         % p_uid),
-        ('IC3', '{ q(func: uid(%s)) { knows { knows '
-         '@filter(eq(city, "%s") OR eq(city, "%s")) '
-         '{ first_name last_name city } } } }' % (p_uid, city, city2)),
-        ('IC4', '{ q(func: uid(%s)) { knows { ~has_creator '
-         '(first: 20) @filter(ge(creation_ts, %d)) '
-         '{ has_tag { tag_name } } } } }' % (p_uid, ts_mid)),
-        ('IC6', '{ t(func: eq(tag_name, "%s")) { ~has_tag (first: 50) '
-         '{ has_tag { tag_name } } } }' % tagname),
-        ('IC8', '{ q(func: uid(%s)) { ~has_creator { ~reply_of '
-         '(orderdesc: creation_ts, first: 20) { creation_ts '
-         'has_creator { first_name } } } } }' % p_uid),
-        ('IC9', '{ var(func: uid(%s)) { knows { f as knows } } '
-         'q(func: uid(f)) { ~has_creator (first: 20) '
-         '@filter(le(creation_ts, %d)) { creation_ts } } }' % (p_uid, ts_mid)),
-        ('IC12', '{ q(func: uid(%s)) { knows { ~has_creator '
-         '(first: 20) @filter(has(reply_of)) { reply_of '
-         '{ has_tag { tag_name } } } } } }' % p_uid),
-        ('IC13', '{ path as shortest(from: %s, to: %s) { knows } '
-         'p(func: uid(path)) { first_name } }' % (p_uid, p2_uid)),
-    ]
+    #   IC14 weighted knows-paths (interaction-weight facets, numpaths)
+    mix = list(ldbc.ic_templates(g).items())
     lats = []
     for _name, q in mix:
         t, _ = timed(lambda q=q: _engine(store, threshold).query(q))
@@ -192,9 +162,8 @@ def config3_5(threshold, sf=1.0):
          "edges_per_sec": round(edges3 / t3) if edges3 else 0,
          "edges": edges3},
         {"config": 5,
-         "desc": f"LDBC IC mix ({len(mix)} of 14 templates; "
-         f"IC5/7/10/11/14 need forums/likes/companies), "
-         f"SNB-shaped sf={sf}",
+         "desc": f"LDBC IC mix (all {len(mix)} interactive-complex "
+         f"template shapes), SNB-shaped sf={sf}",
          "p50_ms": round(sorted(lats)[len(lats) // 2] * 1e3, 1),
          "per_query_ms": {name: round(t * 1e3, 1)
                           for (name, _q), t in zip(mix, lats)}},

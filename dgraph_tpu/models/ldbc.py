@@ -38,16 +38,25 @@ class SNBGraph:
     n_posts: int
     n_comments: int
     n_tags: int
+    n_forums: int
+    n_orgs: int
     # entity uid ranges: [lo, hi) half-open
     person_uids: np.ndarray
     post_uids: np.ndarray
     comment_uids: np.ndarray
     tag_uids: np.ndarray
+    forum_uids: np.ndarray
+    org_uids: np.ndarray
     # edges as (src_uid, dst_uid) int64 pairs
     knows: np.ndarray          # person -> person (symmetric pairs both ways)
+    knows_weight: np.ndarray   # per knows edge, float (IC14 path weights)
     has_creator: np.ndarray    # message -> person
     reply_of: np.ndarray       # comment -> post|comment
     has_tag: np.ndarray        # message -> tag
+    has_member: np.ndarray     # forum -> person
+    container_of: np.ndarray   # forum -> post
+    likes: np.ndarray          # person -> message
+    works_at: np.ndarray       # person -> org
     # properties
     first_name: list           # per person
     last_name: list
@@ -57,12 +66,15 @@ class SNBGraph:
 
     @property
     def n_nodes(self) -> int:
-        return self.n_persons + self.n_posts + self.n_comments + self.n_tags
+        return (self.n_persons + self.n_posts + self.n_comments
+                + self.n_tags + self.n_forums + self.n_orgs)
 
     @property
     def n_edges(self) -> int:
         return (len(self.knows) + len(self.has_creator)
-                + len(self.reply_of) + len(self.has_tag))
+                + len(self.reply_of) + len(self.has_tag)
+                + len(self.has_member) + len(self.container_of)
+                + len(self.likes) + len(self.works_at))
 
 
 def generate(sf: float = 0.1, seed: int = 9) -> SNBGraph:
@@ -73,6 +85,8 @@ def generate(sf: float = 0.1, seed: int = 9) -> SNBGraph:
     n_posts = max(int(400_000 * sf), 256)
     n_comments = max(int(600_000 * sf), 256)
     n_tags = min(len(TAG_NAMES), max(int(16_080 * sf), 16))
+    n_forums = max(int(20_000 * sf), 32)
+    n_orgs = max(int(1_575 * sf), 8)
 
     uid = 1
     person_uids = np.arange(uid, uid + n_persons, dtype=np.int64)
@@ -82,6 +96,10 @@ def generate(sf: float = 0.1, seed: int = 9) -> SNBGraph:
     comment_uids = np.arange(uid, uid + n_comments, dtype=np.int64)
     uid += n_comments
     tag_uids = np.arange(uid, uid + n_tags, dtype=np.int64)
+    uid += n_tags
+    forum_uids = np.arange(uid, uid + n_forums, dtype=np.int64)
+    uid += n_forums
+    org_uids = np.arange(uid, uid + n_orgs, dtype=np.int64)
 
     # -- knows: community-clustered heavy tail ------------------------------
     # persons sit in sqrt(n)-sized communities; ~80% of friendships are
@@ -140,6 +158,36 @@ def generate(sf: float = 0.1, seed: int = 9) -> SNBGraph:
     tpick = np.minimum(rng.zipf(1.8, len(tsrc)) - 1, n_tags - 1)
     has_tag = np.stack([tsrc, tag_uids[tpick]], axis=1)
 
+    # -- forums, likes, organisations (IC5/7/10/11/14 coverage) -------------
+    # forum membership: zipf forum popularity, ~10 members each on average
+    m_cnt = np.minimum(rng.zipf(1.9, n_forums) + 4, 256)
+    fsrc = np.repeat(np.arange(n_forums), m_cnt)
+    fmem = rng.choice(n_persons, len(fsrc), p=author_w)
+    has_member = np.unique(np.stack(
+        [forum_uids[fsrc], person_uids[fmem]], axis=1), axis=0)
+    # every post lives in one forum
+    container_of = np.stack(
+        [forum_uids[rng.integers(0, n_forums, n_posts)], post_uids],
+        axis=1)
+    # likes: heavy-tailed fan activity over messages
+    n_likes = max(int(600_000 * sf), 512)
+    lik_p = rng.choice(n_persons, n_likes, p=author_w)
+    lik_m = rng.integers(0, n_msgs, n_likes)
+    likes = np.unique(np.stack(
+        [person_uids[lik_p], msg_uids[lik_m]], axis=1), axis=0)
+    # employment: one org per person, zipf org sizes
+    org_of = np.minimum(rng.zipf(1.6, n_persons) - 1, n_orgs - 1)
+    works_at = np.stack([person_uids, org_uids[org_of]], axis=1)
+    # interaction weight per knows edge (IC14's weighted paths) —
+    # symmetric per person-pair: both directed rows of a friendship
+    # carry the same weight (SNB defines it per pair)
+    pair_lo = np.minimum(knows[:, 0], knows[:, 1])
+    pair_hi = np.maximum(knows[:, 0], knows[:, 1])
+    pair_key = pair_lo * (knows.max() + 1) + pair_hi
+    uniq_pairs, inverse = np.unique(pair_key, return_inverse=True)
+    pair_w = np.round(rng.uniform(0.5, 10.0, len(uniq_pairs)), 2)
+    knows_weight = pair_w[inverse]
+
     first = [FIRST_NAMES[i % len(FIRST_NAMES)] for i in
              rng.integers(0, len(FIRST_NAMES), n_persons)]
     last = [LAST_NAMES[i % len(LAST_NAMES)] for i in
@@ -151,9 +199,13 @@ def generate(sf: float = 0.1, seed: int = 9) -> SNBGraph:
 
     return SNBGraph(
         n_persons=n_persons, n_posts=n_posts, n_comments=n_comments,
-        n_tags=n_tags, person_uids=person_uids, post_uids=post_uids,
-        comment_uids=comment_uids, tag_uids=tag_uids, knows=knows,
-        has_creator=has_creator, reply_of=reply_of, has_tag=has_tag,
+        n_tags=n_tags, n_forums=n_forums, n_orgs=n_orgs,
+        person_uids=person_uids, post_uids=post_uids,
+        comment_uids=comment_uids, tag_uids=tag_uids,
+        forum_uids=forum_uids, org_uids=org_uids, knows=knows,
+        knows_weight=knows_weight, has_creator=has_creator,
+        reply_of=reply_of, has_tag=has_tag, has_member=has_member,
+        container_of=container_of, likes=likes, works_at=works_at,
         first_name=first, last_name=last, city=city,
         birthday_year=birthday, creation_ts=creation)
 
@@ -165,11 +217,73 @@ city: string @index(exact) .
 birthday_year: int @index(int) .
 creation_ts: int @index(int) .
 tag_name: string @index(exact) .
+forum_title: string @index(exact) .
+org_name: string @index(exact) .
 knows: [uid] @reverse .
 has_creator: [uid] @reverse .
 reply_of: [uid] @reverse .
 has_tag: [uid] @reverse .
+has_member: [uid] @reverse .
+container_of: [uid] @reverse .
+likes: [uid] @reverse .
+works_at: [uid] @reverse .
 """
+
+
+def ic_templates(g: SNBGraph) -> dict[str, str]:
+    """All 14 LDBC SNB Interactive Complex template shapes as DQL — the
+    single source used by both the benchmark (bench_baseline.py config
+    5) and its regression test (tests/test_ldbc_ic.py)."""
+    import numpy as _np
+    p_uid = hex(int(g.person_uids[len(g.person_uids) // 2]))
+    p2_uid = hex(int(g.person_uids[7]))
+    fn = g.first_name[3]
+    city, city2 = g.city[0], g.city[1]
+    ts_mid = int(_np.median(g.creation_ts))
+    return {
+        "IC1": '{ v as var(func: uid(%s)) @recurse(depth: 3, '
+               'loop: false) { knows } '
+               'q(func: uid(v), orderasc: last_name, first: 20) '
+               '@filter(eq(first_name, "%s")) '
+               '{ first_name last_name city } }' % (p_uid, fn),
+        "IC2": '{ q(func: uid(%s)) { knows { ~has_creator '
+               '(orderdesc: creation_ts, first: 20) '
+               '{ creation_ts } } } }' % p_uid,
+        "IC3": '{ q(func: uid(%s)) { knows { knows '
+               '@filter(eq(city, "%s") OR eq(city, "%s")) '
+               '{ first_name last_name city } } } }'
+               % (p_uid, city, city2),
+        "IC4": '{ q(func: uid(%s)) { knows { ~has_creator (first: 20) '
+               '@filter(ge(creation_ts, %d)) '
+               '{ has_tag { tag_name } } } } }' % (p_uid, ts_mid),
+        "IC5": '{ q(func: uid(%s)) { knows { ~has_member '
+               '(orderasc: forum_title, first: 20) '
+               '{ forum_title } } } }' % p_uid,
+        "IC6": '{ t(func: eq(tag_name, "tag_1")) { ~has_tag (first: 50)'
+               ' { has_tag { tag_name } } } }',
+        "IC7": '{ q(func: uid(%s)) { ~has_creator { ~likes (first: 20) '
+               '{ first_name } } } }' % p_uid,
+        "IC8": '{ q(func: uid(%s)) { ~has_creator { ~reply_of '
+               '(orderdesc: creation_ts, first: 20) { creation_ts '
+               'has_creator { first_name } } } } }' % p_uid,
+        "IC9": '{ var(func: uid(%s)) { knows { f as knows } } '
+               'q(func: uid(f)) { ~has_creator (first: 20) '
+               '@filter(le(creation_ts, %d)) '
+               '{ creation_ts } } }' % (p_uid, ts_mid),
+        "IC10": '{ q(func: uid(%s)) { knows { knows (first: 10) '
+                '@filter(ge(birthday_year, 1985)) '
+                '{ first_name city } } } }' % p_uid,
+        "IC11": '{ q(func: uid(%s)) { knows { works_at '
+                '@filter(eq(org_name, "org_0")) { org_name } } } }'
+                % p_uid,
+        "IC12": '{ q(func: uid(%s)) { knows { ~has_creator (first: 20) '
+                '@filter(has(reply_of)) { reply_of '
+                '{ has_tag { tag_name } } } } } }' % p_uid,
+        "IC13": '{ path as shortest(from: %s, to: %s) { knows } '
+                'p(func: uid(path)) { first_name } }' % (p_uid, p2_uid),
+        "IC14": '{ path as shortest(from: %s, to: %s, numpaths: 2) '
+                '{ knows @facets(weight) } }' % (p_uid, p2_uid),
+    }
 
 
 def load_into(alpha, g: SNBGraph, batch: int = 200_000) -> None:
@@ -181,11 +295,24 @@ def load_into(alpha, g: SNBGraph, batch: int = 200_000) -> None:
                 txn.mutation.edge_sets.append((int(s), pred, int(o), ()))
             txn.commit()
 
+    def commit_weighted(pred, pairs, weights):
+        for i in range(0, len(pairs), batch):
+            txn = alpha.new_txn()
+            for (s, o), w in zip(pairs[i:i + batch],
+                                 weights[i:i + batch]):
+                txn.mutation.edge_sets.append(
+                    (int(s), pred, int(o), {"weight": float(w)}))
+            txn.commit()
+
     alpha.alter(SCHEMA)
-    commit_edges("knows", g.knows)
+    commit_weighted("knows", g.knows, g.knows_weight)
     commit_edges("has_creator", g.has_creator)
     commit_edges("reply_of", g.reply_of)
     commit_edges("has_tag", g.has_tag)
+    commit_edges("has_member", g.has_member)
+    commit_edges("container_of", g.container_of)
+    commit_edges("likes", g.likes)
+    commit_edges("works_at", g.works_at)
     txn = alpha.new_txn()
     for i, uid in enumerate(g.person_uids):
         u = int(uid)
@@ -209,4 +336,10 @@ def load_into(alpha, g: SNBGraph, batch: int = 200_000) -> None:
     for i, uid in enumerate(g.tag_uids):
         txn.mutation.val_sets.append((int(uid), "tag_name", TAG_NAMES[i],
                                       "", ()))
+    for i, uid in enumerate(g.forum_uids):
+        txn.mutation.val_sets.append((int(uid), "forum_title",
+                                      f"forum_{i}", "", ()))
+    for i, uid in enumerate(g.org_uids):
+        txn.mutation.val_sets.append((int(uid), "org_name",
+                                      f"org_{i}", "", ()))
     txn.commit()

@@ -46,14 +46,49 @@ def make_http_server(alpha: Alpha, addr: str = "127.0.0.1",
                 self._send(200, [{"status": "healthy",
                                   "uptime": int(time.time() - start_time)}])
             elif self.path == "/state":
-                st = {"counter": alpha.oracle.max_assigned,
-                      "groups": {"1": {"members": {"1": {
-                          "id": "1", "addr": f"{addr}:{port}",
-                          "leader": True}},
-                          "tablets": {p: {"predicate": p}
-                                      for p in alpha.mvcc.schema.predicates}}},
-                      "maxUID": alpha.oracle._next_uid - 1,
-                      "maxTxnTs": alpha.oracle.max_assigned}
+                if alpha.groups is not None:
+                    # cluster mode: real topology from Zero, including
+                    # liveness (reference: /state mirrors the membership
+                    # stream with health marking). Zero being down must
+                    # produce an error RESPONSE, not a crashed handler.
+                    import grpc as _grpc
+                    try:
+                        ms = alpha.groups.zero.membership()
+                    except _grpc.RpcError as e:
+                        self._send(503, {"errors": [{
+                            "message": f"zero unreachable: {e.code()}"}]})
+                        return
+                    dead = {int(d) for d in ms.dead}
+                    st = {"counter": int(ms.counter),
+                          "groups": {str(g): {
+                              "members": {str(n): {
+                                  "id": str(n), "addr": a,
+                                  # any-coordinator design: no raft
+                                  # leader; the flag marks the lowest
+                                  # live member for shape parity
+                                  "leader": int(n) == min(
+                                      (int(m) for m in grp.nodes
+                                       if int(m) not in dead),
+                                      default=int(n)),
+                                  "alive": int(n) not in dead}
+                                  for n, a in grp.nodes.items()},
+                              "tablets": {p: {"predicate": p}
+                                          for p in grp.tablets}}
+                              for g, grp in ms.groups.items()},
+                          "dead": sorted(dead),
+                          "maxUID": alpha.mvcc.max_uid_seen,
+                          "maxTxnTs": alpha.oracle.max_assigned}
+                else:
+                    st = {"counter": alpha.oracle.max_assigned,
+                          "groups": {"1": {"members": {"1": {
+                              "id": "1", "addr": f"{addr}:{port}",
+                              "leader": True, "alive": True}},
+                              "tablets": {p: {"predicate": p}
+                                          for p in
+                                          alpha.mvcc.schema.predicates}}},
+                          "dead": [],
+                          "maxUID": alpha.oracle._next_uid - 1,
+                          "maxTxnTs": alpha.oracle.max_assigned}
                 self._send(200, st)
             elif self.path == "/debug/prometheus_metrics":
                 self._send(200, METRICS.render(), "text/plain")

@@ -1,0 +1,61 @@
+"""The full LDBC SNB Interactive Complex mix (all 14 template shapes)
+runs against the synthetic SNB model — guards the benchmark queries
+(bench_baseline.py config 5) against engine/model regressions."""
+
+import numpy as np
+import pytest
+
+from dgraph_tpu.models import ldbc
+from dgraph_tpu.server.api import Alpha
+
+
+@pytest.fixture(scope="module")
+def snb():
+    g = ldbc.generate(sf=0.02)
+    a = Alpha(device_threshold=10**9)
+    ldbc.load_into(a, g)
+    return a, g
+
+
+def _templates(g):
+    return ldbc.ic_templates(g)
+
+
+def test_all_14_templates_run_and_return(snb):
+    a, g = snb
+    tpls = _templates(g)
+    assert len(tpls) == 14
+    nonempty = 0
+    for name, q in tpls.items():
+        out = a.query(q)
+        assert isinstance(out, dict), name
+        if any(v for v in out.values()):
+            nonempty += 1
+    # the model is dense enough that most templates actually hit data
+    assert nonempty >= 11, nonempty
+
+
+def test_ic14_weighted_paths_cost_ordered(snb):
+    a, g = snb
+    out = a.query(_templates(g)["IC14"])
+    paths = out.get("_path_", [])
+    if len(paths) >= 2:
+        ws = [p["_weight_"] for p in paths]
+        assert ws == sorted(ws)
+
+
+def test_ic5_membership_consistency(snb):
+    """IC5's forum titles really are forums the friend belongs to."""
+    a, g = snb
+    out = a.query(_templates(g)["IC5"])
+    member_of = {}
+    for f, p in g.has_member:
+        member_of.setdefault(int(p), set()).add(int(f))
+    titles = {f"forum_{i}": int(u) for i, u in enumerate(g.forum_uids)}
+    p_uid = int(g.person_uids[len(g.person_uids) // 2])
+    friends = {int(d) for s, d in g.knows if int(s) == p_uid}
+    for friend_obj in out["q"][0].get("knows", []):
+        for forum in friend_obj.get("~has_member", []):
+            fuid = titles[forum["forum_title"]]
+            assert any(fuid in member_of.get(fr, set())
+                       for fr in friends)

@@ -229,6 +229,41 @@ def test_compaction_snapshot_bootstrap():
         zmod.DOC_LOG_CAP = old_cap
 
 
+def test_state_endpoint_reports_liveness():
+    """/state in cluster mode mirrors Zero's membership with per-node
+    alive flags (reference: /state + health marking)."""
+    import urllib.request
+
+    from dgraph_tpu.server.http import make_http_server, serve_background
+
+    zserver, zport, zstate = make_zero_server(
+        ZeroState(liveness_s=0.3))
+    zserver.start()
+    alpha, aserver, _addr = start_cluster_alpha(
+        f"127.0.0.1:{zport}", device_threshold=10**9)
+    srv = make_http_server(alpha, "127.0.0.1", 0)
+    serve_background(srv)
+    try:
+        # a phantom second node joins and never heartbeats
+        zstate.connect("127.0.0.1:9999", group=2)
+        deadline = time.monotonic() + 2.0
+        while time.monotonic() < deadline and not zstate.dead_nodes():
+            zstate.heartbeat(alpha.groups.node_id)
+            time.sleep(0.05)
+        zstate.heartbeat(alpha.groups.node_id)
+        st = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.server_address[1]}/state").read())
+        assert st["dead"], st
+        flat = {n: m for g in st["groups"].values()
+                for n, m in g["members"].items()}
+        assert flat[str(alpha.groups.node_id)]["alive"] is True
+        assert any(not m["alive"] for m in flat.values())
+    finally:
+        srv.shutdown()
+        aserver.stop(None)
+        zserver.stop(None)
+
+
 def test_alpha_survives_zero_failover():
     """Full-stack: an Alpha keeps committing after its Zero dies and the
     standby takes over (multi-target --zero list)."""
