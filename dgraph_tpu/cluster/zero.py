@@ -126,6 +126,8 @@ class ZeroState:
         elif k == "remove":
             for nodes in self.groups.values():
                 nodes.pop(doc["n"], None)
+        elif k == "tablet_del":
+            self.tablets.pop(doc["p"], None)
         elif k == "ts":
             self._ts_block = max(self._ts_block, doc["v"])
             self.oracle.bump_ts(doc["v"])
@@ -349,6 +351,10 @@ class ZeroState:
         with self._lock:
             self.tablet_sizes[group] = dict(sizes)
 
+    def remove_tablet(self, pred: str) -> None:
+        self._call("RemoveTablet", pb.TabletRequest(pred=pred),
+                   pb.Payload)
+
     def move_tablet(self, pred: str, dst_group: int) -> bool:
         """Flip a tablet's owner (the map half of a move; the data ship
         happens first — see ZeroService.MoveTablet / rebalance_once)."""
@@ -432,6 +438,17 @@ class ZeroState:
                 nodes.pop(node_id, None)
             self._log({"k": "remove", "n": node_id})
             self.counter += 1
+
+    def remove_tablet(self, pred: str) -> None:
+        """Drop a predicate's tablet assignment (reference: DropAttr
+        deletes the tablet from Zero's map)."""
+        with self._lock:
+            if pred in self.tablets:
+                del self.tablets[pred]
+                for sizes in self.tablet_sizes.values():
+                    sizes.pop(pred, None)
+                self._log({"k": "tablet_del", "p": pred})
+                self.counter += 1
 
     def should_serve(self, pred: str, group: int) -> int:
         """Tablet assignment: first group to ask for an unowned predicate
@@ -526,6 +543,11 @@ class ZeroService:
 
     def ReportTablets(self, req: pb.TabletSizes, ctx) -> pb.Payload:
         self.state.report_sizes(int(req.group), dict(req.sizes))
+        return pb.Payload(data=b"ok")
+
+    def RemoveTablet(self, req: pb.TabletRequest, ctx) -> pb.Payload:
+        self._primary_only(ctx)
+        self.state.remove_tablet(req.pred)
         return pb.Payload(data=b"ok")
 
     def MoveTablet(self, req: pb.MoveTabletRequest, ctx) -> pb.Payload:
@@ -682,6 +704,7 @@ def make_zero_server(state: ZeroState | None = None,
             "Commit": _unary(svc.Commit, pb.CommitRequest),
             "ReportTablets": _unary(svc.ReportTablets, pb.TabletSizes),
             "MoveTablet": _unary(svc.MoveTablet, pb.MoveTabletRequest),
+            "RemoveTablet": _unary(svc.RemoveTablet, pb.TabletRequest),
             "Heartbeat": _unary(svc.Heartbeat, pb.HeartbeatMsg),
             "JournalTail": _unary(svc.JournalTail, pb.JournalTailRequest),
         }),))
@@ -737,6 +760,17 @@ class ZeroClient:
     def membership(self) -> pb.MembershipState:
         return self._call("Membership", pb.Empty(), pb.MembershipState)
 
+    def remove_tablet(self, pred: str) -> None:
+        """Drop a predicate's tablet assignment (reference: DropAttr
+        deletes the tablet from Zero's map)."""
+        with self._lock:
+            if pred in self.tablets:
+                del self.tablets[pred]
+                for sizes in self.tablet_sizes.values():
+                    sizes.pop(pred, None)
+                self._log({"k": "tablet_del", "p": pred})
+                self.counter += 1
+
     def should_serve(self, pred: str, group: int) -> int:
         r = self._call("ShouldServe",
                        pb.TabletRequest(pred=pred, group=group), pb.Tablet)
@@ -790,6 +824,10 @@ class ZeroClient:
                        pb.JournalDocs)
         return (list(r.docs_json), int(r.next), bool(r.standby),
                 str(r.log_id))
+
+    def remove_tablet(self, pred: str) -> None:
+        self._call("RemoveTablet", pb.TabletRequest(pred=pred),
+                   pb.Payload)
 
     def move_tablet(self, pred: str, dst_group: int) -> bool:
         r = self._call("MoveTablet", pb.MoveTabletRequest(

@@ -234,12 +234,11 @@ def ic_templates(g: SNBGraph) -> dict[str, str]:
     """All 14 LDBC SNB Interactive Complex template shapes as DQL — the
     single source used by both the benchmark (bench_baseline.py config
     5) and its regression test (tests/test_ldbc_ic.py)."""
-    import numpy as _np
     p_uid = hex(int(g.person_uids[len(g.person_uids) // 2]))
     p2_uid = hex(int(g.person_uids[7]))
     fn = g.first_name[3]
     city, city2 = g.city[0], g.city[1]
-    ts_mid = int(_np.median(g.creation_ts))
+    ts_mid = int(np.median(g.creation_ts))
     return {
         "IC1": '{ v as var(func: uid(%s)) @recurse(depth: 3, '
                'loop: false) { knows } '

@@ -104,6 +104,8 @@ class Alpha:
             elif kind == "drop":
                 alpha.mvcc = MVCCStore()
                 alpha.xidmap = XidMap(alpha.oracle)
+            elif kind == "drop_attr":
+                alpha.mvcc.drop_predicate(obj, ts)
             elif alpha.mvcc.has_applied(ts):
                 continue  # duplicate record (catch-up raced a broadcast)
             else:
@@ -442,6 +444,39 @@ class Alpha:
                     ts, lambda c, origin, prev: c.apply_schema(
                         schema_text, ts=ts, origin=origin, prev_ts=prev))
 
+    def drop_attr(self, pred: str) -> None:
+        """reference: api.Operation{DropAttr} — delete one predicate's
+        data + schema everywhere. Broadcast like Alter."""
+        ts = self.apply_drop_attr_broadcast(pred)
+        if self.groups is not None:
+            with self._apply_lock:
+                self._broadcast_chained(
+                    ts, lambda c, origin, prev: c.apply_drop_attr(
+                        pred, ts=ts, origin=origin, prev_ts=prev))
+            import grpc as _grpc
+            try:
+                # the tablet assignment dies with the predicate
+                # (reference: DropAttr deletes it from Zero's map)
+                self.groups.zero.remove_tablet(pred)
+            except _grpc.RpcError:
+                pass  # membership poll self-heals when zero returns
+
+    def apply_drop_attr_broadcast(self, pred: str, ts: int = 0) -> int:
+        """Receive/apply a DropAttr (no re-broadcast). The predicate's
+        tablet caches reset so a cached foreign copy can't serve dropped
+        data."""
+        with self._apply_lock:
+            ts = ts or self.oracle.read_only_ts()
+            if self.wal is not None:
+                self.wal.append_drop_attr(pred, ts)
+            self.mvcc.drop_predicate(pred, ts)
+            with self._state_lock:
+                self.tablet_versions.pop(pred, None)
+                self._stale_preds.discard(pred)
+                for k in [k for k in self._tablet_cache if k[0] == pred]:
+                    del self._tablet_cache[k]
+        return ts
+
     def drop_all(self) -> None:
         """reference: api.Operation{DropAll}. Broadcast like Alter: every
         node must drop or spanning queries diverge against survivors."""
@@ -553,6 +588,8 @@ class Alpha:
             self.apply_schema_broadcast(obj, ts=ts)
         elif kind == "drop":
             self.apply_drop_broadcast(ts=ts)
+        elif kind == "drop_attr":
+            self.apply_drop_attr_broadcast(obj, ts=ts)
         elif not self.mvcc.has_applied(ts):
             self.apply_committed(obj, ts)
 
@@ -577,6 +614,9 @@ class Alpha:
                 continue
             if kind == "drop":
                 self.apply_drop_broadcast(ts=ts)
+                continue
+            if kind == "drop_attr":
+                self.apply_drop_attr_broadcast(obj, ts=ts)
                 continue
             if self.mvcc.has_applied(ts):
                 continue

@@ -82,6 +82,8 @@ def backup(p_dir: str, dest: str, force_full: bool = False) -> dict:
                 seg.append({"ts": ts, "m": _mut_doc(obj)})
             elif k == "schema":
                 seg.append({"ts": ts, "schema": obj})
+            elif k == "drop_attr":
+                seg.append({"ts": ts, "drop_attr": obj})
             else:
                 seg.append({"ts": ts, "drop": 1})
             n += 1
@@ -146,6 +148,11 @@ def restore(dest: str, p_dir: str) -> int:
             elif "drop" in doc:
                 mvcc = MVCCStore()
                 schema = None   # post-drop alters start from scratch
+            elif "drop_attr" in doc:
+                mvcc.drop_predicate(doc["drop_attr"], ts)
+                if schema is not None:
+                    # a later schema record must not resurrect it
+                    schema.predicates.pop(doc["drop_attr"], None)
             else:
                 mvcc.apply(_doc_mut(doc["m"]), ts)
             max_ts = max(max_ts, ts)

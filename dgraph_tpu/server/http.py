@@ -59,22 +59,26 @@ def make_http_server(alpha: Alpha, addr: str = "127.0.0.1",
                             "message": f"zero unreachable: {e.code()}"}]})
                         return
                     dead = {int(d) for d in ms.dead}
+
+                    def group_doc(grp):
+                        # any-coordinator design: no raft leader; the
+                        # flag marks the lowest LIVE member for shape
+                        # parity (none when the whole group is dark)
+                        live = [int(m) for m in grp.nodes
+                                if int(m) not in dead]
+                        lead = min(live) if live else None
+                        return {
+                            "members": {str(n): {
+                                "id": str(n), "addr": a,
+                                "leader": int(n) == lead,
+                                "alive": int(n) not in dead}
+                                for n, a in grp.nodes.items()},
+                            "tablets": {p: {"predicate": p}
+                                        for p in grp.tablets}}
+
                     st = {"counter": int(ms.counter),
-                          "groups": {str(g): {
-                              "members": {str(n): {
-                                  "id": str(n), "addr": a,
-                                  # any-coordinator design: no raft
-                                  # leader; the flag marks the lowest
-                                  # live member for shape parity
-                                  "leader": int(n) == min(
-                                      (int(m) for m in grp.nodes
-                                       if int(m) not in dead),
-                                      default=int(n)),
-                                  "alive": int(n) not in dead}
-                                  for n, a in grp.nodes.items()},
-                              "tablets": {p: {"predicate": p}
-                                          for p in grp.tablets}}
-                              for g, grp in ms.groups.items()},
+                          "groups": {str(g): group_doc(grp)
+                                     for g, grp in ms.groups.items()},
                           "dead": sorted(dead),
                           "maxUID": alpha.mvcc.max_uid_seen,
                           "maxTxnTs": alpha.oracle.max_assigned}
@@ -222,6 +226,8 @@ def make_http_server(alpha: Alpha, addr: str = "127.0.0.1",
                         op = json.loads(body)
                         if op.get("drop_all"):
                             alpha.drop_all()
+                        elif op.get("drop_attr"):
+                            alpha.drop_attr(op["drop_attr"])
                         else:
                             alpha.alter(op.get("schema", ""))
                     else:

@@ -97,6 +97,8 @@ class DgraphService:
                 ctx.abort(grpc.StatusCode.PERMISSION_DENIED, str(e))
         if req.drop_all:
             self.alpha.drop_all()
+        elif req.drop_attr:
+            self.alpha.drop_attr(req.drop_attr)
         elif req.schema:
             self.alpha.alter(req.schema)
         return pb.Payload(data=b"ok")
@@ -172,6 +174,8 @@ class WorkerService:
         from dgraph_tpu.store.wal import mut_from_bytes
         if req.drop_all:
             kind, obj = "drop", None
+        elif req.drop_attr:
+            kind, obj = "drop_attr", req.drop_attr
         elif req.schema:
             kind, obj = "schema", req.schema
         else:
@@ -199,6 +203,8 @@ class WorkerService:
                     ts=ts, mut_json=mut_to_bytes(obj)))
             elif kind == "schema":
                 out.records.append(pb.LogRecord(ts=ts, schema=obj))
+            elif kind == "drop_attr":
+                out.records.append(pb.LogRecord(ts=ts, drop_attr=obj))
             else:
                 out.records.append(pb.LogRecord(ts=ts, drop=True))
         return out
@@ -295,9 +301,11 @@ class Client:
         return self._call(SERVICE_DGRAPH, "Mutate",
                           pb.MutationReq(**kw), pb.MutationResp)
 
-    def alter(self, schema: str = "", drop_all: bool = False) -> None:
+    def alter(self, schema: str = "", drop_all: bool = False,
+              drop_attr: str = "") -> None:
         self._call(SERVICE_DGRAPH, "Alter",
-                   pb.Operation(schema=schema, drop_all=drop_all),
+                   pb.Operation(schema=schema, drop_all=drop_all,
+                                drop_attr=drop_attr),
                    pb.Payload)
 
     def commit_or_abort(self, start_ts: int,
@@ -326,6 +334,8 @@ class Client:
         for rec in r.records:
             if rec.drop:
                 out.append((int(rec.ts), "drop", None))
+            elif rec.drop_attr:
+                out.append((int(rec.ts), "drop_attr", rec.drop_attr))
             elif rec.schema:
                 out.append((int(rec.ts), "schema", rec.schema))
             else:
@@ -344,6 +354,13 @@ class Client:
                    prev_ts: int = 0) -> None:
         self._call(SERVICE_WORKER, "ApplyMutation",
                    pb.MutationMsg(drop_all=True, commit_ts=ts,
+                                  origin=origin, prev_ts=prev_ts),
+                   pb.Payload)
+
+    def apply_drop_attr(self, pred: str, ts: int = 0, origin: int = 0,
+                        prev_ts: int = 0) -> None:
+        self._call(SERVICE_WORKER, "ApplyMutation",
+                   pb.MutationMsg(drop_attr=pred, commit_ts=ts,
                                   origin=origin, prev_ts=prev_ts),
                    pb.Payload)
 

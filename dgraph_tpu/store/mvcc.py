@@ -55,6 +55,18 @@ class Mutation:
             out.add(s)
         return out
 
+    def exclude(self, preds) -> "Mutation":
+        """Complement of restrict: everything EXCEPT the given tablets
+        (straggler absorption filters predicates dropped between the
+        commit and a fold point)."""
+        return Mutation(
+            edge_sets=[e for e in self.edge_sets if e[1] not in preds],
+            edge_dels=[e for e in self.edge_dels if e[1] not in preds],
+            val_sets=[v for v in self.val_sets if v[1] not in preds],
+            val_dels=[v for v in self.val_dels if v[1] not in preds],
+            touch_uids=sorted(self.all_uids()),
+        )
+
     def restrict(self, preds) -> "Mutation":
         """Subset for the tablets in `preds`, carrying the FULL vocab set
         (reference: per-group pb.Mutations split in MutateOverNetwork)."""
@@ -113,6 +125,10 @@ class MVCCStore:
         self._history: list[tuple[int, Store]] = [(base_ts, base)]
         self.layers: list[_Layer] = []       # all retained, ascending ts
         self._views: dict[tuple, Store] = {}
+        # pred -> [drop_ts, ...]: DropAttr history; stragglers landing
+        # below a drop must not resurrect the predicate in post-drop
+        # folds (see absorb_straggler)
+        self.dropped: dict[str, list[int]] = {}
         # highest uid this store has ever held — the heartbeat watermark
         # that seeds a promoted standby zero's uid lease floor
         self.max_uid_seen = int(base.uids[-1]) if base.n_nodes else 0
@@ -171,7 +187,15 @@ class MVCCStore:
             patched = []
             for fold_ts, store in self._history:
                 if fold_ts >= commit_ts:
-                    store = _materialize(store, [_Layer(commit_ts, mut)])
+                    # a predicate dropped between this commit and the
+                    # fold must stay dropped — resurrecting it here
+                    # would diverge from nodes that applied the commit
+                    # BEFORE the drop
+                    gone = {p for p, dts in self.dropped.items()
+                            if any(commit_ts < d <= fold_ts
+                                   for d in dts)}
+                    eff = mut.exclude(gone) if gone else mut
+                    store = _materialize(store, [_Layer(commit_ts, eff)])
                 patched.append((fold_ts, store))
             self._history = patched
             import bisect
@@ -228,6 +252,28 @@ class MVCCStore:
             store = _materialize(fold_store, pending)
             self._history.append((new_ts, store))
             return store
+
+    def drop_predicate(self, pred: str, drop_ts: int) -> None:
+        """Remove a predicate's data and schema at drop_ts (reference:
+        api.Operation{DropAttr}). Materialises the newest state minus the
+        predicate as a fold point: reads at or above drop_ts see it gone,
+        reads below still resolve against the prior folds/layers."""
+        with self._lock:
+            fold_ts, fold_store = self._history[-1]
+            pending = [l for l in self.layers if l.commit_ts > fold_ts]
+            # only pending layers need re-materialising; untouched
+            # predicates' CSR blocks are SHARED with the previous fold
+            store = (_materialize(fold_store, pending) if pending
+                     else fold_store)
+            schema = store.schema.clone()
+            schema.predicates.pop(pred, None)
+            preds = {p: pd for p, pd in store.preds.items() if p != pred}
+            new_store = Store(uids=store.uids, schema=schema, preds=preds)
+            new_ts = max(drop_ts, fold_ts,
+                         pending[-1].commit_ts if pending else 0)
+            self._history.append((new_ts, new_store))
+            self.dropped.setdefault(pred, []).append(drop_ts)
+            self._views.clear()
 
     def rebuild_base(self, schema: Schema | None = None) -> Store:
         """Re-materialise the newest state under `schema` and fold — the

@@ -200,12 +200,17 @@ class WAL(Journal):
         """Durably record a DropAll (replay resets, not resurrects)."""
         super().append({"ts": ts, "drop": 1})
 
+    def append_drop_attr(self, pred: str, ts: int) -> None:
+        """Durably record a DropAttr (replay re-drops the predicate)."""
+        super().append({"ts": ts, "drop_attr": pred})
+
     def truncate(self, upto_ts: int) -> None:
         """Drop records with commit_ts ≤ upto_ts (checkpoint just absorbed
         them); the tail survives atomically."""
         self.rewrite(
             ({"ts": ts, "m": _mut_doc(obj)} if kind == "mut"
              else {"ts": ts, "drop": 1} if kind == "drop"
+             else {"ts": ts, "drop_attr": obj} if kind == "drop_attr"
              else {"ts": ts, "schema": obj})
             for ts, kind, obj in replay(self.path) if ts > upto_ts)
 
@@ -269,5 +274,7 @@ def replay(path: str) -> Iterator[tuple[int, str, object]]:
             yield int(doc["ts"]), "schema", doc["schema"]
         elif "drop" in doc:
             yield int(doc["ts"]), "drop", None
+        elif "drop_attr" in doc:
+            yield int(doc["ts"]), "drop_attr", doc["drop_attr"]
         else:
             yield int(doc["ts"]), "mut", _doc_mut(doc["m"])

@@ -494,3 +494,26 @@ def test_tablet_cache_survives_vocab_growth():
     a1.remote_hop_max = 4096
     for s in (s1, s2, zserver):
         s.stop(None)
+
+
+def test_drop_attr_broadcasts(cluster):
+    """DropAttr reaches every node like Alter (spanning queries must not
+    diverge against survivors)."""
+    a1, a2 = cluster
+    load_fixture(a1)
+    a1.drop_attr("age")
+    for node in (a1, a2):
+        out = node.query('{ q(func: eq(name, "alice")) { name age } }')
+        assert out["q"] == [{"name": "alice"}], out
+
+
+def test_drop_attr_removes_zero_tablet(cluster):
+    a1, _a2 = cluster
+    load_fixture(a1)
+    assert "age" in {t for g in
+                     a1.groups.zero.membership().groups.values()
+                     for t in g.tablets}
+    a1.drop_attr("age")
+    assert "age" not in {t for g in
+                         a1.groups.zero.membership().groups.values()
+                         for t in g.tablets}

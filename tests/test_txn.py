@@ -258,3 +258,72 @@ def test_serve_task_read_leaves_no_pending_txn():
     assert not a._active_reads
     # no undecided txn may remain: the watermark equals the next fresh ts
     assert a.oracle.min_active_ts() == a.oracle.max_assigned + 1
+
+
+def test_drop_attr_removes_data_and_schema(tmp_path):
+    """api.Operation{DropAttr}: predicate data + schema gone at the drop
+    ts, WAL replay reproduces it after a crash."""
+    from dgraph_tpu.server.api import Alpha
+    p = str(tmp_path / "p")
+    a = Alpha.open(p, sync=False)
+    a.alter("name: string @index(exact) .\nage: int @index(int) .")
+    a.mutate(set_nquads='_:a <name> "alice" .\n_:a <age> "30"^^<xs:int> .')
+    a.drop_attr("age")
+    out = a.query('{ q(func: eq(name, "alice")) { name age } }')
+    assert out["q"] == [{"name": "alice"}]
+    assert a.mvcc.schema.peek("age") is None
+    # ge(age, ...) finds nothing (index gone too)
+    assert a.query('{ q(func: ge(age, 0)) { name } }')["q"] == []
+    # crash-replay keeps the drop
+    a.wal.close()
+    a2 = Alpha.open(p, sync=False)
+    out = a2.query('{ q(func: eq(name, "alice")) { name age } }')
+    assert out["q"] == [{"name": "alice"}]
+    # the predicate is re-creatable afterwards
+    a2.alter("age: int .")
+    a2.mutate(set_nquads='_:b <name> "bob" .\n_:b <age> "41"^^<xs:int> .')
+    out = a2.query('{ q(func: eq(name, "bob")) { age } }')
+    assert out["q"] == [{"age": 41}]
+
+
+def test_drop_attr_in_backup_chain(tmp_path):
+    from dgraph_tpu.server.api import Alpha
+    from dgraph_tpu.server.backup import backup, restore
+    p, dest, p2 = (str(tmp_path / d) for d in ("p", "bk", "p2"))
+    a = Alpha.open(p, sync=False)
+    a.alter("name: string @index(exact) .\nnick: string .")
+    a.mutate(set_nquads='_:a <name> "alice" .\n_:a <nick> "al" .')
+    a.checkpoint_to(p)
+    backup(p, dest)
+    a2 = Alpha.open(p, sync=False)
+    a2.drop_attr("nick")
+    a2.mutate(set_nquads='_:b <name> "bob" .')
+    a2.wal.close()
+    backup(p, dest)  # incremental carrying the drop_attr record
+    restore(dest, p2)
+    r = Alpha.open(p2, sync=False)
+    names = sorted(x["name"] for x in
+                   r.query('{ q(func: has(name)) { name nick } }')["q"])
+    assert names == ["alice", "bob"]
+    out = r.query('{ q(func: eq(name, "alice")) { nick } }')
+    assert out["q"] == []  # nick dropped before the restore point
+
+
+def test_straggler_below_drop_does_not_resurrect():
+    """A commit broadcast absorbed AFTER a DropAttr with a LOWER ts must
+    not resurrect the dropped predicate in post-drop reads."""
+    from dgraph_tpu.server.api import Alpha
+    from dgraph_tpu.store.mvcc import Mutation
+    a = Alpha(device_threshold=10**9)
+    a.alter("name: string @index(exact) .\nage: int .")
+    a.mutate(set_nquads='_:a <name> "alice" .')
+    # reserve a commit ts, then drop BEFORE the straggler arrives
+    straggler_ts = a.oracle.read_only_ts() + 1
+    a.oracle.bump_ts(straggler_ts)
+    a.drop_attr("age")
+    uid = int(a.mvcc.base.uids[-1])
+    mut = Mutation(val_sets=[(uid, "age", 99, "", None)],
+                   touch_uids=[uid])
+    a.mvcc.absorb_straggler(mut, straggler_ts)
+    out = a.query('{ q(func: eq(name, "alice")) { name age } }')
+    assert out["q"] == [{"name": "alice"}], out
