@@ -351,10 +351,6 @@ class ZeroState:
         with self._lock:
             self.tablet_sizes[group] = dict(sizes)
 
-    def remove_tablet(self, pred: str) -> None:
-        self._call("RemoveTablet", pb.TabletRequest(pred=pred),
-                   pb.Payload)
-
     def move_tablet(self, pred: str, dst_group: int) -> bool:
         """Flip a tablet's owner (the map half of a move; the data ship
         happens first — see ZeroService.MoveTablet / rebalance_once)."""
@@ -759,17 +755,6 @@ class ZeroClient:
 
     def membership(self) -> pb.MembershipState:
         return self._call("Membership", pb.Empty(), pb.MembershipState)
-
-    def remove_tablet(self, pred: str) -> None:
-        """Drop a predicate's tablet assignment (reference: DropAttr
-        deletes the tablet from Zero's map)."""
-        with self._lock:
-            if pred in self.tablets:
-                del self.tablets[pred]
-                for sizes in self.tablet_sizes.values():
-                    sizes.pop(pred, None)
-                self._log({"k": "tablet_del", "p": pred})
-                self.counter += 1
 
     def should_serve(self, pred: str, group: int) -> int:
         r = self._call("ShouldServe",

@@ -201,7 +201,17 @@ class Parser:
             lang = self._lang_chain()
         return attr, lang
 
-    def _lang_chain(self) -> str:
+    def _lang_chain(self, allow_star: bool = False) -> str:
+        if self.peek().text == "*":
+            # `name@*` is an OUTPUT form: every language, keyed per tag.
+            # In function args / order specs it would silently match no
+            # value column, so it is rejected there.
+            if not allow_star:
+                raise ParseError(
+                    f"@* is only valid on selection fields "
+                    f"(at {self.peek().pos})")
+            self.next()
+            return "*"
         if self.accept("."):
             parts = ["."]       # bare `name@.`: any language
         else:
@@ -397,6 +407,14 @@ class Parser:
             if name in ("orderasc", "orderdesc") and self.accept(":"):
                 sg.facet_orders.append(Order(
                     attr=self.name(), desc=(name == "orderdesc")))
+            elif self.peek().text == "as":
+                # `v as key`: bind facet values to a value variable
+                # keyed by CHILD uid (reference: facet variables);
+                # binding alone does not request output
+                self.next()
+                if sg.facet_vars is None:
+                    sg.facet_vars = []
+                sg.facet_vars.append((name, self.name()))
             elif self.accept(":"):
                 want_output()
                 sg.facet_keys.append((name, self.name()))  # alias: key
@@ -527,13 +545,13 @@ class Parser:
                 attr = attr[1:]
             sg.attr = attr
         if self.peek().text == "@" and \
-                (self.peek(1).text == "." or
+                (self.peek(1).text in (".", "*") or
                  (self.peek(1).kind == "name" and
                   self.peek(1).text not in ("filter", "recurse", "cascade",
                                             "normalize", "groupby",
                                             "facets"))):
             self.next()
-            sg.lang = self._lang_chain()
+            sg.lang = self._lang_chain(allow_star=True)
         if self.accept("("):
             self._parse_child_args(sg)
         self._parse_directives(sg)

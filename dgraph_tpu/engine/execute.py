@@ -292,6 +292,29 @@ class Executor:
         keep = np.isin(nbrs, allowed)
         return nbrs[keep], seg[keep], (pos[keep] if len(pos) else pos)
 
+    def _bind_facet_vars(self, sg: SubGraph, nbrs, pos) -> None:
+        """@facets(v as key): value var keyed by CHILD rank. A child
+        reached over several edges sums numeric facet values (reference:
+        facet-variable aggregation)."""
+        cols = self.store.edge_facets(
+            sg.attr, self.facet_positions(sg, pos),
+            [k for _, k in sg.facet_vars])
+        for var, key in sg.facet_vars:
+            vals = cols.get(key)
+            m: dict = {}
+            if vals is not None:
+                for c, v in zip(nbrs.tolist(), vals):
+                    if v is None:
+                        continue
+                    prev = m.get(c)
+                    if (prev is not None and not isinstance(v, bool)
+                            and isinstance(v, (int, float))
+                            and isinstance(prev, (int, float))):
+                        m[int(c)] = prev + v
+                    else:
+                        m[int(c)] = v
+            self.val_vars[var] = m
+
     def facet_filter_edges(self, sg: SubGraph, pred: str,
                            nbrs: np.ndarray, seg: np.ndarray,
                            pos: np.ndarray):
@@ -521,6 +544,8 @@ class Executor:
                          matrix_pos=pos)
         if sg.var_name:
             self.uid_vars[sg.var_name] = nodes
+        if sg.facet_vars:
+            self._bind_facet_vars(sg, nbrs, pos)
         if sg.groupby:
             from dgraph_tpu.engine.groupby import process_groupby_rows
             node.groups = process_groupby_rows(self, node)
@@ -685,7 +710,7 @@ def _needs_facets(sg) -> bool:
     """Whether a block consumes edge positions (facet render/filter/order)
     — remote per-hop results carry none."""
     return (sg.facet_keys is not None or sg.facet_filter is not None
-            or bool(sg.facet_orders))
+            or sg.facet_vars is not None or bool(sg.facet_orders))
 
 
 def expands(schema, sg: SubGraph) -> bool:

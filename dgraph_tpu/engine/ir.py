@@ -104,6 +104,7 @@ class SubGraph:
     # facets (reference: @facets on edges/value leaves)
     # None = not requested; [] = all keys; else [(alias, key), ...]
     facet_keys: Optional[list] = None
+    facet_vars: Optional[list] = None  # [(var, key)]: @facets(v as k)
     facet_filter: Optional[FilterNode] = None  # leaf FuncNode.attr = key
     facet_orders: list[Order] = field(default_factory=list)
 

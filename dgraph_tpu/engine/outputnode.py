@@ -212,6 +212,29 @@ class _Renderer:
                      for v in vs)
             obj[leaf.alias or f"checkpwd({leaf.attr})"] = ok
             return
+        if leaf.lang == "*":
+            # name@*: every language version, keyed per tag (untagged
+            # renders under the bare name) — reference lang@* semantics.
+            # The password guard applies here too: pwd@* must not leak.
+            info = self._is_list.get(id(leaf))
+            if info is None:
+                ps = self.store.schema.peek(leaf.attr)
+                info = self._is_list[id(leaf)] = (
+                    bool(ps and ps.is_list),
+                    bool(ps and ps.kind == Kind.PASSWORD))
+            if info[1]:
+                return
+            pd = self.store.preds.get(leaf.attr)
+            base = leaf.alias or leaf.attr
+            for lang in sorted(pd.vals) if pd else ():
+                col = pd.vals[lang]
+                vs = col.get(rank)
+                if not vs:
+                    continue
+                key = base if not lang else f"{base}@{lang}"
+                obj[key] = (_json_val(vs[0]) if len(vs) == 1
+                            else [_json_val(v) for v in vs])
+            return
         # plain value predicate — (is_list, is_password) resolve from the
         # schema ONCE per leaf, not per rendered node
         info = self._is_list.get(id(leaf))

@@ -259,19 +259,39 @@ class MVCCStore:
         predicate as a fold point: reads at or above drop_ts see it gone,
         reads below still resolve against the prior folds/layers."""
         with self._lock:
-            fold_ts, fold_store = self._history[-1]
-            pending = [l for l in self.layers if l.commit_ts > fold_ts]
+            # seed = newest fold strictly below the drop; commits BELOW
+            # drop_ts fold into the dropped snapshot, commits ABOVE it
+            # stay layered — a post-drop write legitimately re-creates
+            # the predicate (rebirth), and an out-of-order commit with
+            # ts > drop_ts must stay visible exactly like it is on a
+            # node that applied the drop first.
+            below = [(t, s) for t, s in self._history if t < drop_ts]
+            above = [(t, s) for t, s in self._history if t >= drop_ts]
+            seed_ts, seed = below[-1] if below else self._history[0]
+            pend = [l for l in self.layers
+                    if seed_ts < l.commit_ts < drop_ts]
             # only pending layers need re-materialising; untouched
             # predicates' CSR blocks are SHARED with the previous fold
-            store = (_materialize(fold_store, pending) if pending
-                     else fold_store)
+            store = _materialize(seed, pend) if pend else seed
             schema = store.schema.clone()
             schema.predicates.pop(pred, None)
             preds = {p: pd for p, pd in store.preds.items() if p != pred}
-            new_store = Store(uids=store.uids, schema=schema, preds=preds)
-            new_ts = max(drop_ts, fold_ts,
-                         pending[-1].commit_ts if pending else 0)
-            self._history.append((new_ts, new_store))
+            dropped_store = Store(uids=store.uids, schema=schema,
+                                  preds=preds)
+            new_hist = below + [(max(drop_ts, seed_ts), dropped_store)]
+            # former folds at/above the drop (a rollup raced the drop
+            # broadcast) rebuild from the dropped snapshot plus retained
+            # layers — gc can't have pruned them (its watermark is below
+            # any ts the oracle could issue for the drop)
+            prev_ts, prev_store = new_hist[-1]
+            for t, _old in above:
+                lay = [l for l in self.layers
+                       if prev_ts < l.commit_ts <= t]
+                prev_store = (_materialize(prev_store, lay) if lay
+                              else prev_store)
+                prev_ts = t
+                new_hist.append((t, prev_store))
+            self._history = new_hist
             self.dropped.setdefault(pred, []).append(drop_ts)
             self._views.clear()
 

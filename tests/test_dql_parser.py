@@ -195,3 +195,13 @@ def test_tokenize_division_vs_regex():
     assert any(t.text == "/" and t.kind == "op" for t in toks)
     toks2 = tokenize("regexp(name, /ab c/)")
     assert any(t.kind == "regex" for t in toks2)
+
+
+def test_lang_star_rejected_outside_selection():
+    import pytest
+
+    from dgraph_tpu.dql.parser import ParseError, parse
+    with pytest.raises(ParseError):
+        parse('{ q(func: eq(name@*, "x")) { name } }')
+    with pytest.raises(ParseError):
+        parse('{ q(func: has(name), orderasc: name@*) { name } }')

@@ -75,3 +75,10 @@ def test_password_update_replaces():
     q = ('{ q(func: eq(name, "carol")) { o: checkpwd(pass, "old") '
          'n: checkpwd(pass, "new") } }')
     assert a.query(q)["q"] == [{"o": False, "n": True}]
+
+
+def test_password_not_leaked_via_lang_star():
+    a = _alpha()
+    a.mutate(set_nquads='_:u <name> "eve" .\n_:u <pass> "pw" .')
+    out = a.query('{ q(func: eq(name, "eve")) { name pass@* } }')
+    assert out["q"] == [{"name": "eve"}]

@@ -840,6 +840,26 @@ CASES = [
     ("value_facets_keyed_alias", """
      { q(func: uid(2)) { nickname @facets(o: origin) } }""",
      {"q": [{"nickname": "The King", "o": "fans"}]}),
+
+    ("facet_var_cross_block", """
+     { var(func: uid(1)) { friend @facets(s as since) }
+       q(func: uid(2, 3), orderasc: uid) { name v: val(s) } }""",
+     {"q": [{"name": "King Lear", "v": 2004},
+            {"name": "Margaret", "v": 2010}]}),
+
+    ("facet_var_in_order", """
+     { var(func: uid(1)) { friend @facets(s as since) }
+       q(func: uid(2, 3, 4), orderdesc: val(s)) { name } }""",
+     {"q": [{"name": "Margaret"}, {"name": "King Lear"},
+            {"name": "Leonard"}]}),
+
+    ("lang_star_tagged_only", """
+     { q(func: uid(7)) { name@* } }""",
+     {"q": [{"name@de": "Sieben", "name@nl": "Zeven"}]}),
+
+    ("lang_star_mixed_untagged", """
+     { q(func: uid(1)) { name@* } }""",
+     {"q": [{"name": "Michonne", "name@fr": "Michonne-fr"}]}),
 ]
 
 
@@ -998,3 +1018,18 @@ def test_query_errors(name, query):
     e = Engine(build_store(), device_threshold=10**9)
     with pytest.raises((ParseError, ValueError)):
         e.query(query)
+
+
+def test_facet_var_sums_numeric_on_multi_parent():
+    """A child reached over several facet-carrying edges sums numeric
+    facet values into the variable (reference: facet-var aggregation)."""
+    b = StoreBuilder(parse_schema("link: [uid] .\nname: string ."))
+    for u in (1, 2, 3):
+        b.add_value(u, "name", f"n{u}")
+    b.add_edge(1, "link", 3, facets={"w": 5})
+    b.add_edge(2, "link", 3, facets={"w": 7})
+    e = Engine(b.finalize(), device_threshold=10**9)
+    out = e.query("""
+      { var(func: uid(1, 2)) { link @facets(t as w) }
+        q(func: uid(3)) { name total: val(t) } }""")
+    assert out["q"] == [{"name": "n3", "total": 12}]

@@ -327,3 +327,31 @@ def test_straggler_below_drop_does_not_resurrect():
     a.mvcc.absorb_straggler(mut, straggler_ts)
     out = a.query('{ q(func: eq(name, "alice")) { name age } }')
     assert out["q"] == [{"name": "alice"}], out
+
+
+def test_drop_attr_with_out_of_order_later_commit():
+    """A commit with ts ABOVE the drop applied BEFORE the drop arrives
+    stays visible (rebirth), and reads between the two see the gap —
+    matching a node that applied them in order."""
+    from dgraph_tpu.server.api import Alpha
+    from dgraph_tpu.store.mvcc import Mutation
+    a = Alpha(device_threshold=10**9)
+    a.alter("name: string @index(exact) .\nage: int .")
+    a.mutate(set_nquads='_:a <name> "alice" .\n_:a <age> "30"^^<xs:int> .')
+    uid = int(a.query('{ q(func: eq(name, "alice")) { uid } }'
+                      )["q"][0]["uid"], 16)
+    drop_ts = a.oracle.read_only_ts() + 1
+    later_ts = drop_ts + 5
+    a.oracle.bump_ts(later_ts)
+    # the later commit lands FIRST (out-of-order broadcast)
+    a.mvcc.apply(Mutation(val_sets=[(uid, "age", 99, "", None)],
+                          touch_uids=[uid]), later_ts)
+    a.apply_drop_attr_broadcast("age", ts=drop_ts)
+    # at/above the later commit: reborn value visible
+    out = a.query('{ q(func: eq(name, "alice")) { age } }',
+                  read_ts=later_ts)
+    assert out["q"] == [{"age": 99}], out
+    # between drop and the later commit: the predicate is gone
+    out = a.query('{ q(func: eq(name, "alice")) { name age } }',
+                  read_ts=drop_ts)
+    assert out["q"] == [{"name": "alice"}], out
