@@ -22,12 +22,23 @@ def cmd_alpha(args) -> int:
     from dgraph_tpu.server.http import make_http_server, serve_background
     from dgraph_tpu.server.task import make_server
 
-    cfg = load_config(AlphaConfig, args.config, {
+    overrides = {
         "p_dir": args.p, "http_port": args.http_port,
         "grpc_port": args.grpc_port, "log_level": args.log_level,
         "mesh_devices": args.mesh_devices,
         "encryption_key_file": args.encryption_key_file,
-        "encryption_strict": args.encryption_strict or None})
+        "encryption_strict": args.encryption_strict or None}
+    if args.store:
+        # grouped superflag (reference: z.SuperFlag, e.g.
+        # --badger "compression=zstd; numgoroutines=8")
+        from dgraph_tpu.utils.config import parse_superflag
+        probe = AlphaConfig()
+        for k, v in parse_superflag(args.store).items():
+            if not hasattr(probe, k):
+                raise SystemExit(f"unknown --store key {k!r}")
+            if overrides.get(k) is None:  # dedicated flags win
+                overrides[k] = v
+    cfg = load_config(AlphaConfig, args.config, overrides)
     xlog.setup(cfg.log_level)
     log = xlog.get("alpha")
     if cfg.encryption_key_file:
@@ -307,6 +318,9 @@ def main(argv=None) -> int:
     p.add_argument("--config", default=None)
     p.add_argument("--http_port", type=int, default=None)
     p.add_argument("--grpc_port", type=int, default=None)
+    p.add_argument("--store", default=None,
+                   help="grouped engine knobs, 'k=v; k=v' (superflag): "
+                        "device_threshold, rollup_every, mesh_devices, …")
     p.add_argument("--mesh-devices", type=int, default=None,
                    dest="mesh_devices",
                    help="SPMD engine over N devices (-1 = all, 0 = off)")

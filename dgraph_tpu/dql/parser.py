@@ -551,7 +551,7 @@ class Parser:
                                             "normalize", "groupby",
                                             "facets"))):
             self.next()
-            sg.lang = self._lang_chain(allow_star=True)
+            sg.lang = self._lang_chain(allow_star=not sg.var_name)
         if self.accept("("):
             self._parse_child_args(sg)
         self._parse_directives(sg)

@@ -55,6 +55,7 @@ class _Renderer:
         self._rec_maps: dict = {}
         self._rec_obj_memo: dict = {}
         self._facet_keys: dict = {}
+        self._star_langs: dict = {}
 
     def _rec_rows(self, parents: np.ndarray, children: np.ndarray,
                   rank: int) -> np.ndarray:
@@ -224,15 +225,20 @@ class _Renderer:
                     bool(ps and ps.kind == Kind.PASSWORD))
             if info[1]:
                 return
+            is_list = info[0]
             pd = self.store.preds.get(leaf.attr)
+            langs = self._star_langs.get(id(leaf))
+            if langs is None:
+                langs = self._star_langs[id(leaf)] = (
+                    sorted(pd.vals) if pd else ())
             base = leaf.alias or leaf.attr
-            for lang in sorted(pd.vals) if pd else ():
-                col = pd.vals[lang]
-                vs = col.get(rank)
+            for lang in langs:
+                vs = pd.vals[lang].get(rank)
                 if not vs:
                     continue
                 key = base if not lang else f"{base}@{lang}"
-                obj[key] = (_json_val(vs[0]) if len(vs) == 1
+                obj[key] = (_json_val(vs[0])
+                            if len(vs) == 1 and not is_list
                             else [_json_val(v) for v in vs])
             return
         # plain value predicate — (is_list, is_password) resolve from the

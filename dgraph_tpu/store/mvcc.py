@@ -259,38 +259,37 @@ class MVCCStore:
         predicate as a fold point: reads at or above drop_ts see it gone,
         reads below still resolve against the prior folds/layers."""
         with self._lock:
-            # seed = newest fold strictly below the drop; commits BELOW
-            # drop_ts fold into the dropped snapshot, commits ABOVE it
-            # stay layered — a post-drop write legitimately re-creates
-            # the predicate (rebirth), and an out-of-order commit with
-            # ts > drop_ts must stay visible exactly like it is on a
-            # node that applied the drop first.
+            def strip(st: Store) -> Store:
+                schema = st.schema.clone()
+                schema.predicates.pop(pred, None)
+                return Store(uids=st.uids, schema=schema,
+                             preds={p: pd for p, pd in st.preds.items()
+                                    if p != pred})
+
+            # Folds strictly below the drop are untouched. The drop fold
+            # materialises seed + commits BELOW drop_ts; commits ABOVE it
+            # stay layered (a post-drop write legitimately re-creates the
+            # predicate, and an out-of-order commit with ts > drop_ts
+            # must stay visible exactly as on a node that applied the
+            # drop first). Folds already AT/ABOVE the drop (a rollup or
+            # tablet resync raced the broadcast) are patched IN PLACE —
+            # only the dropped predicate is removed, so snapshot-derived
+            # content (install_tablet, rebuild_base) survives; rebirth
+            # commits absorbed into such a raced fold are lost with it,
+            # the same outcome the drop's issuer intended.
             below = [(t, s) for t, s in self._history if t < drop_ts]
             above = [(t, s) for t, s in self._history if t >= drop_ts]
-            seed_ts, seed = below[-1] if below else self._history[0]
-            pend = [l for l in self.layers
-                    if seed_ts < l.commit_ts < drop_ts]
-            # only pending layers need re-materialising; untouched
-            # predicates' CSR blocks are SHARED with the previous fold
-            store = _materialize(seed, pend) if pend else seed
-            schema = store.schema.clone()
-            schema.predicates.pop(pred, None)
-            preds = {p: pd for p, pd in store.preds.items() if p != pred}
-            dropped_store = Store(uids=store.uids, schema=schema,
-                                  preds=preds)
-            new_hist = below + [(max(drop_ts, seed_ts), dropped_store)]
-            # former folds at/above the drop (a rollup raced the drop
-            # broadcast) rebuild from the dropped snapshot plus retained
-            # layers — gc can't have pruned them (its watermark is below
-            # any ts the oracle could issue for the drop)
-            prev_ts, prev_store = new_hist[-1]
-            for t, _old in above:
-                lay = [l for l in self.layers
-                       if prev_ts < l.commit_ts <= t]
-                prev_store = (_materialize(prev_store, lay) if lay
-                              else prev_store)
-                prev_ts = t
-                new_hist.append((t, prev_store))
+            new_hist = list(below)
+            if below:
+                seed_ts, seed = below[-1]
+                pend = [l for l in self.layers
+                        if seed_ts < l.commit_ts < drop_ts]
+                st = _materialize(seed, pend) if pend else seed
+                fold_ts = max(drop_ts, seed_ts)
+                if not above or above[0][0] > fold_ts:
+                    new_hist.append((fold_ts, strip(st)))
+            for t, s in above:
+                new_hist.append((t, strip(s)))
             self._history = new_hist
             self.dropped.setdefault(pred, []).append(drop_ts)
             self._views.clear()

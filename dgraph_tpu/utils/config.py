@@ -67,5 +67,17 @@ def load_config(cls, path: str | None = None, overrides: dict | None = None):
     for k, v in (overrides or {}).items():
         if v is not None and hasattr(cfg, k):
             fieldtype = type(getattr(cfg, k))
+            if fieldtype is bool and isinstance(v, str):
+                # bool("false") is True — parse by word, and REJECT
+                # unrecognized input (a typo must not silently disable
+                # a security knob; reference: strconv.ParseBool errors)
+                low = v.strip().lower()
+                if low in ("1", "true", "yes", "on"):
+                    v = True
+                elif low in ("0", "false", "no", "off", ""):
+                    v = False
+                else:
+                    raise ValueError(
+                        f"invalid boolean {v!r} for config key {k!r}")
             setattr(cfg, k, fieldtype(v))
     return cfg
