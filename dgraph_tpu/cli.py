@@ -314,7 +314,8 @@ def main(argv=None) -> int:
                           "cannot be read)")
 
     p = sub.add_parser("alpha", help="run the data server", parents=[enc])
-    p.add_argument("--p", default="p", help="posting snapshot dir")
+    p.add_argument("--p", default=None,
+                   help="posting snapshot dir (default: p)")
     p.add_argument("--config", default=None)
     p.add_argument("--http_port", type=int, default=None)
     p.add_argument("--grpc_port", type=int, default=None)

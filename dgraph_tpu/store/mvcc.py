@@ -289,7 +289,21 @@ class MVCCStore:
                 if not above or above[0][0] > fold_ts:
                     new_hist.append((fold_ts, strip(st)))
             for t, s in above:
-                new_hist.append((t, strip(s)))
+                st = strip(s)
+                # re-apply the dropped predicate's REBIRTH commits (ts
+                # in (drop_ts, t]) from retained layers, so a rollup
+                # that absorbed them before the drop arrived does not
+                # make visibility depend on local rollup timing
+                reb = []
+                for l in self.layers:
+                    if drop_ts < l.commit_ts <= t:
+                        r = l.mut.restrict({pred})
+                        if (r.edge_sets or r.edge_dels or r.val_sets
+                                or r.val_dels):
+                            reb.append(_Layer(l.commit_ts, r))
+                if reb:
+                    st = _materialize(st, reb)
+                new_hist.append((t, st))
             self._history = new_hist
             self.dropped.setdefault(pred, []).append(drop_ts)
             self._views.clear()

@@ -74,7 +74,7 @@ def load_config(cls, path: str | None = None, overrides: dict | None = None):
                 low = v.strip().lower()
                 if low in ("1", "true", "yes", "on"):
                     v = True
-                elif low in ("0", "false", "no", "off", ""):
+                elif low in ("0", "false", "no", "off"):
                     v = False
                 else:
                     raise ValueError(
