@@ -339,7 +339,13 @@ def main() -> None:
                                                remaining)
         if "stage2" in cpu_stages:
             stages, platform = cpu_stages, "cpu"
-            err = f"tpu failed ({err}); measured on XLA cpu backend"
+            err = (f"tpu failed ({err}); measured on XLA cpu backend. "
+                   f"Prior real-TPU measurements of this workload are "
+                   f"recorded in BASELINE.md (669.9M edges/s at 4096 "
+                   f"lanes; 673.4M on a re-run). If stage0 died before "
+                   f"any compile, suspect the chip tunnel (it has "
+                   f"wedged for hours historically) — the stage "
+                   f"telemetry distinguishes that from a code failure")
         else:
             err = f"tpu: {err}; cpu fallback: {cpu_err}"
 
