@@ -4,8 +4,8 @@ Reference parity: `chunker/` (`ParseRDF` n-quad lexing into `api.NQuad`,
 `ParseJSON` nested-object flattening with blank-node generation). The
 subset covers what the reference's live/bulk loaders and mutation API
 accept day-to-day: uid/blank subjects, string objects with language tags
-and `^^` type hints, star deletion, facets omitted (tracked in schema as a
-later layer).
+and `^^` type hints, star deletion, RDF facet parens, and JSON facets via
+the "pred|facet" key convention (index maps for lists).
 """
 
 from __future__ import annotations
@@ -139,18 +139,62 @@ def _node_ref(it: dict, counter: list) -> str:
     return str(uid)
 
 
+def _pop_facets(it: dict) -> dict[str, dict]:
+    """Extract "pred|facet" keys (reference: chunker/json.go facet
+    convention) → {pred: {facet: value}}. Scalar facets sit beside the
+    value key in the SAME object; edge facets sit inside the CHILD
+    object, keyed by the edge predicate."""
+    fac: dict[str, dict] = {}
+    for k in [k for k in it if "|" in k]:
+        pred, _, fkey = k.partition("|")
+        if pred and fkey:
+            fac.setdefault(pred, {})[fkey] = it.pop(k)
+    return fac
+
+
+def _facets_at(fac_entry: dict | None, idx: int) -> dict | None:
+    """Resolve a parent-level facet entry for list element `idx`:
+    {"0": v, "1": w} index maps pick per element (reference:
+    chunker/json.go list-facet convention); plain values apply to every
+    element."""
+    if not fac_entry:
+        return None
+    out = {}
+    for fkey, v in fac_entry.items():
+        if (isinstance(v, dict) and v
+                and all(isinstance(x, str) and x.isdigit() for x in v)):
+            if str(idx) in v:
+                out[fkey] = v[str(idx)]
+        else:
+            out[fkey] = v
+    return out or None
+
+
 def _flatten(it: dict, counter: list, out: list[NQuad]) -> None:
     subj = _node_ref(it, counter)
+    fac = _pop_facets(it)
     for k, v in list(it.items()):
         if k == "uid":
             continue
         vals = v if isinstance(v, list) else [v]
-        for one in vals:
+        for idx, one in enumerate(vals):
             if isinstance(one, dict):
                 ref = _node_ref(one, counter)
-                out.append(NQuad(subject=subj, predicate=k, object_id=ref))
+                # edge facets: parent-level "k|facet" (index-mapped for
+                # lists) merged with keys inside the child object under
+                # the edge predicate's name — child-internal wins; the
+                # child's OWN scalar facets stay for its _flatten pass
+                edge_fac = _facets_at(fac.get(k), idx) or {}
+                for fk in [fk for fk in one
+                           if fk.startswith(k + "|")]:
+                    edge_fac[fk.partition("|")[2]] = one.pop(fk)
+                out.append(NQuad(subject=subj, predicate=k,
+                                 object_id=ref,
+                                 facets=edge_fac or None))
                 _flatten(one, counter, out)
             elif one is None:
                 out.append(NQuad(subject=subj, predicate=k, is_star=True))
             else:
-                out.append(NQuad(subject=subj, predicate=k, object_value=one))
+                out.append(NQuad(subject=subj, predicate=k,
+                                 object_value=one,
+                                 facets=_facets_at(fac.get(k), idx)))

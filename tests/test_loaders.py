@@ -181,3 +181,51 @@ def test_bulk_multiprocess_map(tmp_path):
     a = Alpha.open(str(tmp_path / "p"))
     out = a.query('{ q(func: eq(name, "user-7")) { follows { name } } }')
     assert out == {"q": [{"follows": [{"name": "user-8"}]}]}
+
+
+def test_json_mutation_facets_roundtrip():
+    """JSON mutations carry facets via the "pred|facet" convention:
+    scalar facets beside the value key, edge facets inside the child
+    object (reference: chunker/json.go)."""
+    a = Alpha(device_threshold=10**9)
+    a.alter("name: string @index(exact) .\nfriend: [uid] @reverse .")
+    a.mutate(set_json=[{
+        "uid": "_:a", "name": "alice", "name|origin": "books",
+        "friend": [{"uid": "_:b", "name": "bob", "name|origin": "tv",
+                    "friend|since": 2004}]}])
+    out = a.query('{ q(func: eq(name, "alice")) { name @facets '
+                  'friend @facets(since) { name @facets } } }')
+    assert out["q"] == [{
+        "name": "alice", "name|origin": "books",
+        "friend": [{"name": "bob", "name|origin": "tv",
+                    "friend|since": 2004}]}]
+
+
+def test_json_facets_parse_shapes():
+    from dgraph_tpu.loader.chunker import parse_json
+    nqs = parse_json([{"uid": "_:x", "name": "n", "name|f": 1,
+                       "knows": {"uid": "0x5", "knows|w": 2.5}}])
+    by_pred = {(q.predicate, q.object_id or q.object_value): q
+               for q in nqs}
+    assert by_pred[("name", "n")].facets == {"f": 1}
+    assert by_pred[("knows", "0x5")].facets == {"w": 2.5}
+
+
+def test_json_list_facet_index_maps():
+    """Parent-level "pred|facet" with a {"0": ...} index map applies per
+    list element; plain values apply to all (reference convention)."""
+    from dgraph_tpu.loader.chunker import parse_json
+    nqs = parse_json([{
+        "uid": "_:a",
+        "langs": ["en", "fr"], "langs|level": {"0": "native"},
+        "tags": ["x", "y"], "tags|src": "web",
+        "friend": [{"uid": "0x1"}, {"uid": "0x2"}],
+        "friend|since": {"1": 2020}}])
+    got = {(q.predicate, q.object_id or q.object_value): q.facets
+           for q in nqs}
+    assert got[("langs", "en")] == {"level": "native"}
+    assert got[("langs", "fr")] is None
+    assert got[("tags", "x")] == {"src": "web"}
+    assert got[("tags", "y")] == {"src": "web"}
+    assert got[("friend", "0x1")] is None
+    assert got[("friend", "0x2")] == {"since": 2020}
