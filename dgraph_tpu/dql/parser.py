@@ -39,6 +39,39 @@ def parse(src: str, variables: dict | None = None) -> list[SubGraph]:
     return Parser(tokenize(src), variables or {}).parse_request()
 
 
+def parse_schema_query(src: str):
+    """`schema {}` / `schema(pred: [a, b]) { predicate type ... }` →
+    (pred_filter | None, field_filter | None), or None when `src` is not
+    a schema query (reference: the schema{} introspection request the
+    gql parser special-cases)."""
+    toks = tokenize(src)
+    p = Parser(toks, {})
+    if p.peek().text != "schema":
+        return None
+    p.next()
+    preds = None
+    if p.accept("("):
+        p.expect("pred")
+        p.expect(":")
+        preds = []
+        if p.accept("["):
+            while not p.accept("]"):
+                preds.append(p.name())
+                p.accept(",")
+        else:
+            preds.append(p.name())
+        p.expect(")")
+    fields = None
+    p.expect("{")
+    while not p.accept("}"):
+        if fields is None:
+            fields = []
+        fields.append(p.name())
+    if p.peek().kind != "eof":
+        raise ParseError("trailing input after schema query")
+    return preds, fields
+
+
 class Parser:
     def __init__(self, toks: list[Token], variables: dict):
         self.toks = toks

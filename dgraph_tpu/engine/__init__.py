@@ -33,8 +33,14 @@ class Engine:
         """(json, executor): the executor carries the bound uid/val vars —
         the seam upsert blocks substitute from (reference: edgraph
         doQueryInUpsert returns the query's var map)."""
-        from dgraph_tpu.dql.parser import parse
+        from dgraph_tpu.dql.parser import parse, parse_schema_query
         from dgraph_tpu.engine.varorder import execution_order
+
+        sq = parse_schema_query(q)
+        if sq is not None:
+            # introspection has no executor/vars: callers needing one
+            # (upserts) reject schema queries explicitly
+            return self._schema_query(*sq), None
 
         blocks = parse(q, variables)
         ex = Executor(self.store, device_threshold=self.device_threshold,
@@ -44,6 +50,38 @@ class Engine:
             results[i] = ex.run_block(blocks[i])
         roots = [results[i] for i in range(len(blocks))]  # textual order out
         return to_json(ex, roots), ex
+
+    def _schema_query(self, preds, fields) -> dict:
+        """schema{} introspection (reference: the schema node list the
+        reference returns: predicate/type/index/tokenizer/... plus type
+        definitions)."""
+        out = []
+        schema = self.store.schema
+        for name in sorted(schema.predicates):
+            if preds is not None and name not in preds:
+                continue
+            ps = schema.predicates[name]
+            d = {"predicate": name, "type": ps.kind.value}
+            if ps.is_list:
+                d["list"] = True
+            if ps.index_tokenizers:
+                d["index"] = True
+                d["tokenizer"] = list(ps.index_tokenizers)
+            for flag in ("reverse", "count", "lang", "upsert", "unique"):
+                if getattr(ps, flag):
+                    d[flag] = True
+            if fields is not None:
+                d = {k: v for k, v in d.items()
+                     if k in fields or k == "predicate"}
+            out.append(d)
+        resp = {"schema": out}
+        if preds is None:
+            types = [{"name": t,
+                      "fields": [{"name": f} for f in td.fields]}
+                     for t, td in sorted(schema.types.items())]
+            if types:
+                resp["types"] = types
+        return resp
 
 
 __all__ = [

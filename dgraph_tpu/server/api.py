@@ -299,6 +299,9 @@ class Alpha:
         the executor's rank-space var bindings to uid space."""
         import numpy as np
 
+        from dgraph_tpu.dql.parser import parse_schema_query
+        if parse_schema_query(query_src) is not None:
+            raise ValueError("schema{} queries cannot drive an upsert")
         with self._reading(txn.start_ts) as ts:
             store = self.mvcc.read_view(ts)
             if self.groups is not None:

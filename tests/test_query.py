@@ -1033,3 +1033,15 @@ def test_facet_var_sums_numeric_on_multi_parent():
       { var(func: uid(1, 2)) { link @facets(t as w) }
         q(func: uid(3)) { name total: val(t) } }""")
     assert out["q"] == [{"name": "n3", "total": 12}]
+
+
+def test_schema_query_introspection():
+    """schema{} / schema(pred:) {} (reference: the gql schema request)."""
+    e = Engine(build_store(), device_threshold=10**9)
+    out = e.query("schema {}")
+    by = {d["predicate"]: d for d in out["schema"]}
+    assert by["friend"]["type"] == "uid" and by["friend"]["reverse"]
+    assert by["name"]["index"] and "exact" in by["name"]["tokenizer"]
+    assert {t["name"] for t in out["types"]} == {"Film", "Person"}
+    sel = e.query("schema(pred: [name]) { type }")
+    assert sel == {"schema": [{"predicate": "name", "type": "string"}]}
