@@ -860,6 +860,16 @@ CASES = [
     ("lang_star_mixed_untagged", """
      { q(func: uid(1)) { name@* } }""",
      {"q": [{"name": "Michonne", "name@fr": "Michonne-fr"}]}),
+
+    ("count_pred_into_var", """
+     { var(func: type(Person)) { c as count(friend) }
+       q(func: uid(1)) { f: val(c) } }""",
+     {"q": [{"f": 3}]}),
+
+    ("order_by_count_var", """
+     { var(func: type(Person)) { c as count(friend) }
+       q(func: uid(c), orderdesc: val(c), first: 2) { name } }""",
+     {"q": [{"name": "Michonne"}, {"name": "King Lear"}]}),
 ]
 
 
