@@ -48,41 +48,65 @@ def _expands(store, c: SubGraph) -> bool:
     return expands(store.schema, c)
 
 
-def plan_batch(store, queries_blocks) -> _BatchPlan | None:
-    """Inspect parsed queries; a plan comes back only when every query
-    fits one lane-kernel launch."""
-    if len(queries_blocks) < MIN_BATCH:
+def _eligible(store, blocks):
+    """(signature, root_sg) when the query fits the lane kernel, else
+    None. The signature is what must MATCH across a kernel launch."""
+    if len(blocks) != 1:
         return None
-    sig = None
-    roots = []
-    for blocks in queries_blocks:
-        if len(blocks) != 1:
-            return None
-        sg = blocks[0]
-        r = sg.recurse
-        if r is not None and r.depth and r.depth > MAX_KERNEL_DEPTH:
-            return None
-        if (r is None or r.loop or not r.depth or sg.shortest is not None
-                or sg.filters is not None or sg.first or sg.offset
-                or sg.after or sg.orders or sg.groupby or sg.cascade
-                or sg.normalize or sg.var_name):
-            return None
-        edge_sgs = [c for c in sg.children if _expands(store, c)]
-        if len(edge_sgs) != 1:
-            return None
-        e = edge_sgs[0]
-        if (e.filters is not None or e.facet_filter is not None
-                or e.facet_orders or e.facet_keys is not None
-                or e.first or e.offset or e.after or e.orders
-                or e.var_name):
-            return None
-        s = (e.attr, e.is_reverse, r.depth)
-        if sig is None:
-            sig = s
-        elif sig != s:
-            return None
-        roots.append(sg)
-    return _BatchPlan(roots, sig[0], sig[1], sig[2])
+    sg = blocks[0]
+    r = sg.recurse
+    if r is not None and r.depth and r.depth > MAX_KERNEL_DEPTH:
+        return None
+    if (r is None or r.loop or not r.depth or sg.shortest is not None
+            or sg.filters is not None or sg.first or sg.offset
+            or sg.after or sg.orders or sg.groupby or sg.cascade
+            or sg.normalize or sg.var_name):
+        return None
+    edge_sgs = [c for c in sg.children if _expands(store, c)]
+    if len(edge_sgs) != 1:
+        return None
+    e = edge_sgs[0]
+    if (e.filters is not None or e.facet_filter is not None
+            or e.facet_orders or e.facet_keys is not None
+            or e.first or e.offset or e.after or e.orders
+            or e.var_name):
+        return None
+    return (e.attr, e.is_reverse, r.depth), sg
+
+
+def plan_batch(store, queries_blocks) -> _BatchPlan | None:
+    """Inspect parsed queries; a plan comes back only when EVERY query
+    fits one lane-kernel launch (the homogeneous fast path)."""
+    plans, leftover = plan_batch_groups(store, queries_blocks)
+    if len(plans) == 1 and not leftover:
+        return plans[0][0]
+    return None
+
+
+def plan_batch_groups(store, queries_blocks):
+    """Split a MIXED batch into structurally-compatible kernel groups:
+    ([(plan, original_indices)], leftover_indices). Groups smaller than
+    MIN_BATCH fall back to per-query execution with the leftovers —
+    one incompatible query no longer disables the kernel for the rest
+    (reference: the per-goroutine mix, served batch-wise here)."""
+    groups: dict = {}
+    leftover: list[int] = []
+    for i, blocks in enumerate(queries_blocks):
+        er = _eligible(store, blocks)
+        if er is None:
+            leftover.append(i)
+        else:
+            groups.setdefault(er[0], []).append((i, er[1]))
+    plans = []
+    for sig, items in groups.items():
+        if len(items) < MIN_BATCH:
+            leftover.extend(i for i, _ in items)
+        else:
+            plans.append((_BatchPlan([sg for _, sg in items],
+                                     sig[0], sig[1], sig[2]),
+                          [i for i, _ in items]))
+    leftover.sort()
+    return plans, leftover
 
 
 def run_batch(store, plan: _BatchPlan, device_threshold: int) -> list:

@@ -218,7 +218,7 @@ class Alpha:
         throughput path, engine/batch.py); everything else falls back to
         per-query execution. Returns one JSON dict per query, in order."""
         from dgraph_tpu.dql.parser import parse
-        from dgraph_tpu.engine.batch import plan_batch, run_batch
+        from dgraph_tpu.engine.batch import plan_batch_groups, run_batch
 
         with self._reading(read_ts) as ts:
             store = self.mvcc.read_view(ts)
@@ -227,31 +227,62 @@ class Alpha:
                 store = routed_view(self, store, ts)
             if self.acl is not None and acl_user is not None:
                 store = self.acl.readable_view(acl_user, store)
+            from dgraph_tpu.utils import logging as xlog
+            results: list = [None] * len(dqls)
+            leftover = list(range(len(dqls)))
             try:
-                blocks = [parse(q) for q in dqls]
-                plan = plan_batch(store, blocks)
-                if plan is not None:
-                    out = run_batch(store, plan, self.device_threshold)
-                    if out is not None:
-                        self._maybe_gc()
-                        return out
+                # per-query parse isolation: a syntax error sends THAT
+                # query to the per-query path (which reproduces its
+                # error object) without disabling the kernel for the
+                # parseable rest
+                parsed = {}
+                for i, q in enumerate(dqls):
+                    try:
+                        parsed[i] = parse(q)
+                    except Exception:  # noqa: BLE001 — re-raised per-query
+                        pass
+                plans, group_left = plan_batch_groups(
+                    store, [parsed[i] for i in sorted(parsed)])
+                order = sorted(parsed)
+                plans = [(p, [order[j] for j in idxs])
+                         for p, idxs in plans]
+                leftover = sorted(
+                    [order[j] for j in group_left]
+                    + [i for i in range(len(dqls)) if i not in parsed])
+                # each compatible group is ONE lane-kernel launch; a
+                # failing group degrades to per-query, not to a failed
+                # batch
+                for plan, idxs in plans:
+                    try:
+                        out = run_batch(store, plan,
+                                        self.device_threshold)
+                    except Exception:  # noqa: BLE001 — optimization only
+                        xlog.get("alpha").debug(
+                            "batch group failed; per-query fallback",
+                            exc_info=True)
+                        out = None
+                    if out is None:
+                        leftover.extend(idxs)
+                        continue
+                    for i, o in zip(idxs, out):
+                        results[i] = o
+                leftover.sort()
             except Exception:  # noqa: BLE001 — batch is an optimization
-                from dgraph_tpu.utils import logging as xlog
                 xlog.get("alpha").debug("batch plan failed; per-query "
                                         "fallback", exc_info=True)
+                leftover = list(range(len(dqls)))
             # per-query fallback with per-query error isolation: one bad
             # query yields an error OBJECT in its slot, never a failed
             # batch (the other results still return)
             eng = Engine(store, device_threshold=self.device_threshold,
                          mesh=self.mesh)
-            out = []
-            for q in dqls:
+            for i in leftover:
                 try:
-                    out.append(eng.query(q))
+                    results[i] = eng.query(dqls[i])
                 except Exception as e:  # noqa: BLE001
-                    out.append({"errors": [{"message": str(e)}]})
+                    results[i] = {"errors": [{"message": str(e)}]}
         self._maybe_gc()
-        return out
+        return results
 
     def mutate(self, *, set_nquads: str | None = None,
                del_nquads: str | None = None,

@@ -139,3 +139,30 @@ def test_batch_kernel_cache_reuse(alpha):
     n_before = len(cache_holder._ell_cache)
     alpha.query_batch(qs)       # second batch: no rebuild
     assert len(cache_holder._ell_cache) == n_before
+
+
+def test_mixed_batch_splits_into_groups(alpha):
+    """A mixed batch splits into compatible kernel groups plus per-query
+    leftovers; results come back in order, identical to the per-query
+    engine, and error slots stay isolated."""
+    from dgraph_tpu.engine.batch import plan_batch_groups
+    store = alpha.mvcc.read_view(alpha.oracle.read_only_ts())
+    fwd = ['{ q(func: eq(name, "p%d")) @recurse(depth: 3) '
+           '{ name follows } }' % i for i in range(5)]
+    rev = ['{ q(func: eq(name, "p%d")) @recurse(depth: 2) '
+           '{ name ~follows } }' % i for i in range(4)]
+    odd = ['{ q(func: eq(name, "p1")) { name } }',
+           '{ q(func: bogus_func(name)) { name } }']
+    qs = [fwd[0], rev[0], fwd[1], odd[0], rev[1], fwd[2], rev[2],
+          fwd[3], odd[1], rev[3], fwd[4]]
+    plans, leftover = plan_batch_groups(store, [parse(q) for q in qs
+                                                if "bogus" not in q])
+    assert len(plans) == 2  # fwd-depth3 and rev-depth2 groups
+
+    outs = alpha.query_batch(qs)
+    eng = Engine(store, device_threshold=10**9)
+    for q, o in zip(qs, outs):
+        if "bogus" in q:
+            assert "errors" in o, o
+        else:
+            assert o == eng.query(q), q
