@@ -13,10 +13,11 @@ the same shape, scale noted in the output:
   5. LDBC IC mix p50             SNB-shaped graph, all 14
                                  interactive-complex template shapes
 
-Every number is a real `Engine.query` (parse -> execute -> JSON) wall
-time, post-warmup, best-of-N. Run: python bench_baseline.py [--platform
-cpu|tpu]. Prints one JSON line per config plus a markdown table ready for
-BASELINE.md.
+Every number is a real `Engine.query_bytes` (parse -> execute -> JSON
+response bytes, i.e. the full serving path through the native emitter)
+wall time, post-warmup, best-of-N. Run: python bench_baseline.py
+[--platform cpu|tpu]. Prints one JSON line per config plus a markdown
+table ready for BASELINE.md.
 """
 
 from __future__ import annotations
@@ -93,7 +94,8 @@ def config1_2(threshold):
 
     # config 1: 1-hop expand(starring) over every drama film
     q1 = '{ q(func: eq(genre, "drama")) { name starring { uid } } }'
-    t1, out1 = timed(lambda: _engine(store, threshold).query(q1))
+    t1, raw1 = timed(lambda: _engine(store, threshold).query_bytes(q1))
+    out1 = json.loads(raw1)
     edges1 = sum(len(r.get("starring", [])) for r in out1["q"])
 
     # config 2: 2-hop co-star (actor -> ~starring -> film -> starring)
@@ -103,7 +105,8 @@ def config1_2(threshold):
     busiest_uid = int(store.uid_of(np.array([busiest]))[0])
     q2 = ('{ q(func: uid(%s)) { ~starring { starring { uid } } } }'
           % hex(busiest_uid))
-    t2, out2 = timed(lambda: _engine(store, threshold).query(q2))
+    t2, raw2 = timed(lambda: _engine(store, threshold).query_bytes(q2))
+    out2 = json.loads(raw2)
     films = out2["q"][0]["~starring"]
     edges2 = len(films) + sum(len(f["starring"]) for f in films)
     return [
@@ -127,7 +130,8 @@ def config3_5(threshold, sf=1.0):
 
     q3 = ('{ q(func: eq(city, "%s")) @recurse(depth: 3, loop: false) '
           '{ uid knows @filter(ge(birthday_year, 1980)) } }' % city)
-    t3, out3 = timed(lambda: _engine(store, threshold).query(q3))
+    t3, raw3 = timed(lambda: _engine(store, threshold).query_bytes(q3))
+    out3 = json.loads(raw3)
 
     def count(node):
         kids = node.get("knows", [])
@@ -153,7 +157,7 @@ def config3_5(threshold, sf=1.0):
     mix = list(ldbc.ic_templates(g).items())
     lats = []
     for _name, q in mix:
-        t, _ = timed(lambda q=q: _engine(store, threshold).query(q))
+        t, _ = timed(lambda q=q: _engine(store, threshold).query_bytes(q))
         lats.append(t)
     return [
         {"config": 3, "desc": f"3-hop @recurse+@filter, SNB-shaped sf={sf} "
@@ -190,7 +194,8 @@ def config4(threshold, n=1 << 18, avg=24.0):
     src_uid, dst_uid = hex(int(uids[n - 3])), hex(int(uids[100]))
     q = ('{ path as shortest(from: %s, to: %s) { follows } '
          '  path(func: uid(path)) { uid } }' % (src_uid, dst_uid))
-    t, out = timed(lambda: _engine(store, threshold).query(q))
+    t, raw = timed(lambda: _engine(store, threshold).query_bytes(q))
+    out = json.loads(raw)
     return [{"config": 4,
              "desc": f"shortest(from,to), follower-shaped {n} nodes "
              f"{rel.nnz} edges (Twitter-2010 1/159 node scale)",

@@ -33,13 +33,32 @@ class Engine:
         """(json, executor): the executor carries the bound uid/val vars —
         the seam upsert blocks substitute from (reference: edgraph
         doQueryInUpsert returns the query's var map)."""
+        res, ex = self._run(q, variables)
+        if ex is None:
+            return res, None
+        return to_json(ex, res), ex
+
+    def query_bytes(self, q: str, variables: dict | None = None) -> bytes:
+        """Serialized response bytes — the serving path. Uses the native
+        emitter (engine/emit.py) where the block shape allows, skipping
+        per-object Python assembly entirely (reference: outputnode.go
+        ToJson writes bytes, never a generic map)."""
+        from dgraph_tpu.engine.emit import to_json_bytes
+        res, ex = self._run(q, variables)
+        if ex is None:
+            import json
+            return json.dumps(res, separators=(",", ":")).encode()
+        return to_json_bytes(ex, res)
+
+    def _run(self, q: str, variables: dict | None = None):
+        """Parse + execute: (LevelNode roots, executor), or for schema{}
+        introspection (dict, None) — callers needing vars (upserts)
+        reject schema queries explicitly."""
         from dgraph_tpu.dql.parser import parse, parse_schema_query
         from dgraph_tpu.engine.varorder import execution_order
 
         sq = parse_schema_query(q)
         if sq is not None:
-            # introspection has no executor/vars: callers needing one
-            # (upserts) reject schema queries explicitly
             return self._schema_query(*sq), None
 
         blocks = parse(q, variables)
@@ -49,7 +68,7 @@ class Engine:
         for i in execution_order(blocks):
             results[i] = ex.run_block(blocks[i])
         roots = [results[i] for i in range(len(blocks))]  # textual order out
-        return to_json(ex, roots), ex
+        return roots, ex
 
     def _schema_query(self, preds, fields) -> dict:
         """schema{} introspection (reference: the schema node list the

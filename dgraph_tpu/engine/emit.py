@@ -1,0 +1,242 @@
+"""Native JSON emission: lower executed LevelNode trees to columnar specs.
+
+Reference parity: `query/outputnode.go` (`fastJsonNode`, `ToJson`) — the
+reference renders responses with a purpose-built byte encoder instead of
+generic marshalling; this module plays that role for the serving path.
+A block whose feature set fits the columnar form (plain value / uid /
+count / val leaves plus uid edges) lowers to flat arrays — per-leaf
+pre-encoded JSON fragments aligned to the level's rank domain, per-child
+CSR row maps in domain-position space — and native/emit.cpp walks them,
+so no per-object Python dict/list assembly happens while serving.
+Feature-rich blocks (@normalize, @cascade, @groupby, @recurse, facets,
+shortest) fall back to the dict renderer per block.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import json
+from json.encoder import encode_basestring_ascii as _esc
+
+import numpy as np
+
+from dgraph_tpu import native
+from dgraph_tpu.engine.execute import LevelNode
+from dgraph_tpu.engine.outputnode import _Renderer, _json_val, to_json
+from dgraph_tpu.store.types import Kind
+
+_SEP = (",", ":")
+
+
+def to_json_bytes(ex, roots: list[LevelNode]) -> bytes:
+    """Serialized `to_json` result; byte-identical semantics (parsed JSON
+    equality) with the dict path, native-emitted where eligible."""
+    if not native.HAVE_EMIT:
+        return json.dumps(to_json(ex, roots), separators=_SEP).encode()
+    r: _Renderer | None = None
+    parts: dict[str, bytes] = {}
+    path_objs: list | None = None
+    for node in roots:
+        if node.sg.is_internal:
+            continue
+        if node.sg.shortest is not None:
+            if r is None:
+                r = _Renderer(ex)
+            if path_objs is None:
+                path_objs = []
+                parts["_path_"] = b"[]"  # pins insertion order
+            path_objs.extend(r.render_paths(node))
+            continue
+        name = node.sg.alias or node.sg.attr or "q"
+        payload = _emit_native(ex, node) if _eligible(node) else None
+        if payload is None:
+            if r is None:
+                r = _Renderer(ex)
+            payload = json.dumps(r.render_block(node),
+                                 separators=_SEP).encode()
+        parts[name] = payload
+    if path_objs is not None:
+        parts["_path_"] = json.dumps(path_objs, separators=_SEP).encode()
+    return b"{" + b",".join(
+        _esc(k).encode() + b":" + v for k, v in parts.items()) + b"}"
+
+
+def _eligible(node: LevelNode) -> bool:
+    sg = node.sg
+    if (node.groups is not None or node.recurse_data is not None
+            or node.path_data is not None or sg.normalize or sg.cascade
+            or sg.facet_keys is not None):
+        return False
+    for leaf in node.leaf_sgs:
+        if (leaf.is_agg or leaf.math_expr is not None
+                or leaf.checkpwd_val is not None or leaf.lang == "*"
+                or leaf.facet_keys is not None
+                or (leaf.is_count and leaf.is_uid_leaf)):
+            return False
+    return all(_eligible(child) for child in node.children)
+
+
+def _emit_native(ex, node: LevelNode) -> bytes | None:
+    """One eligible root block → JSON array bytes (None = lower failed,
+    caller falls back to the dict renderer)."""
+    keep: list = []     # pins every buffer the C side reads
+    levels: list = []   # DgLevel structs in child-first order
+    spec = _lower_level(ex, node, keep, levels)
+    if spec is None:
+        return None
+    dom = node.nodes
+    display = node.display if node.display is not None else dom
+    pos = _positions(dom, np.asarray(display))
+    if pos is None:
+        return None
+    return native.emit_block(spec, pos, len(levels))
+
+
+def _positions(dom: np.ndarray, ranks: np.ndarray) -> np.ndarray | None:
+    """Ranks → positions in the sorted domain; None if any rank is absent
+    (renderer semantics would need per-rank store fallbacks — punt)."""
+    if not len(ranks):
+        return np.zeros(0, np.int32)
+    if not len(dom):
+        return None
+    pos = np.minimum(np.searchsorted(dom, ranks), len(dom) - 1)
+    if not np.array_equal(dom[pos], ranks):
+        return None
+    return pos.astype(np.int32)
+
+
+def _lower_level(ex, node: LevelNode, keep: list, levels: list):
+    dom = node.nodes
+    leaves = []
+    for leaf in node.leaf_sgs:
+        lowered = _lower_leaf(ex, leaf, dom, keep)
+        if lowered is not None:
+            leaves.append(lowered)
+    children = []
+    for child in node.children:
+        clevel = _lower_level(ex, child, keep, levels)
+        if clevel is None:
+            return None
+        row_child, indptr = _row_map(child, len(dom))
+        if row_child is None:
+            return None
+        name = child.sg.alias or (
+            f"~{child.sg.attr}" if child.sg.is_reverse else child.sg.attr)
+        key = _key(name, keep)
+        keep += [row_child, indptr]
+        children.append(native.DgChild(
+            key=_bp(key), key_len=len(key), level=ctypes.pointer(clevel),
+            row_indptr=_vp(indptr), row_child=_vp(row_child)))
+    leaf_arr = (native.DgLeaf * len(leaves))(*leaves) if leaves else None
+    child_arr = (native.DgChild * len(children))(*children) if children \
+        else None
+    keep += [leaf_arr, child_arr]
+    lvl = native.DgLevel(
+        n=len(dom),
+        n_leaves=len(leaves),
+        leaves=ctypes.cast(leaf_arr, ctypes.POINTER(native.DgLeaf))
+        if leaf_arr else None,
+        n_children=len(children),
+        children=ctypes.cast(child_arr, ctypes.POINTER(native.DgChild))
+        if child_arr else None,
+        level_id=len(levels))
+    levels.append(lvl)
+    return lvl
+
+
+def _row_map(child: LevelNode, n_parent: int):
+    """(row_child positions, row_indptr): the child's matrix grouped by
+    parent position, stable matrix order preserved (same grouping the
+    dict renderer's _rows performs)."""
+    seg = np.asarray(child.matrix_seg)
+    order = np.argsort(seg, kind="stable")
+    indptr = np.searchsorted(seg[order],
+                             np.arange(n_parent + 1)).astype(np.int64)
+    ranks = np.asarray(child.matrix_child)[order]
+    pos = _positions(child.nodes, ranks)
+    return pos, indptr
+
+
+def _lower_leaf(ex, leaf, dom: np.ndarray, keep: list):
+    """One leaf SubGraph → DgLeaf column; None = leaf renders nothing
+    (password predicates)."""
+    store = ex.store
+    n = len(dom)
+    if leaf.is_uid_leaf:
+        key = _key(leaf.alias or "uid", keep)
+        uids = np.ascontiguousarray(
+            store.uid_of(dom) if n else np.zeros(0), np.int64)
+        keep.append(uids)
+        return native.DgLeaf(key=_bp(key), key_len=len(key), kind=1,
+                             nums=_vp(uids))
+    if leaf.is_count:
+        rel = store.rel(leaf.attr, leaf.is_reverse)
+        counts = np.ascontiguousarray(
+            rel.degree(dom) if n else np.zeros(0), np.int64)
+        keep.append(counts)
+        name = leaf.alias or \
+            f"count({'~' if leaf.is_reverse else ''}{leaf.attr})"
+        key = _key(name, keep)
+        return native.DgLeaf(key=_bp(key), key_len=len(key), kind=2,
+                             nums=_vp(counts))
+    if leaf.is_val_leaf:
+        var = ex.val_vars.get(leaf.attr, {})
+        frags = ["" if int(rk) not in var else _enc(_json_val(var[int(rk)]))
+                 for rk in dom.tolist()]
+        return _frag_leaf(leaf.alias or f"val({leaf.attr})", frags, keep)
+    # plain value predicate
+    ps = store.schema.peek(leaf.attr)
+    if ps and ps.kind == Kind.PASSWORD:
+        return None  # hashes never render (reference semantics)
+    is_list = bool(ps and ps.is_list)
+    vmap = store.values_for_many(leaf.attr, dom, leaf.lang)
+    frags = [""] * n
+    for i, rk in enumerate(dom.tolist()):
+        vs = vmap.get(rk)
+        if not vs:
+            continue
+        if is_list or len(vs) > 1:
+            frags[i] = "[" + ",".join(_enc(_json_val(v)) for v in vs) + "]"
+        else:
+            frags[i] = _enc(_json_val(vs[0]))
+    name = leaf.alias or (
+        f"{leaf.attr}@{leaf.lang}" if leaf.lang else leaf.attr)
+    return _frag_leaf(name, frags, keep)
+
+
+def _frag_leaf(name: str, frags: list[str], keep: list):
+    blob = "".join(frags).encode("ascii")
+    off = np.zeros(len(frags) + 1, np.int64)
+    if frags:
+        np.cumsum(np.fromiter((len(f) for f in frags), np.int64,
+                              len(frags)), out=off[1:])
+    key = _key(name, keep)
+    keep += [blob, off]
+    return native.DgLeaf(key=_bp(key), key_len=len(key), kind=0,
+                         frag_off=_vp(off), frag_blob=_bp(blob))
+
+
+def _enc(v) -> str:
+    """One post-_json_val scalar → its JSON fragment (always ASCII)."""
+    t = type(v)
+    if t is str:
+        return _esc(v)
+    if t is bool:
+        return "true" if v else "false"
+    if t is int:
+        return repr(v)
+    return json.dumps(v, separators=_SEP)
+
+
+def _key(name: str, keep: list) -> bytes:
+    key = (_esc(name) + ":").encode("ascii")
+    keep.append(key)
+    return key
+
+
+def _vp(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.c_void_p)
+
+
+def _bp(b: bytes):
+    return ctypes.cast(ctypes.c_char_p(b), ctypes.c_void_p)

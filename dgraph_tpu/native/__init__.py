@@ -45,13 +45,21 @@ def _load():
         ctypes.c_int64, ctypes.c_int32,
         ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
         ctypes.POINTER(ctypes.c_uint64)]
+    if hasattr(lib, "dg_emit_block"):  # older .so builds predate the emitter
+        lib.dg_emit_block.restype = ctypes.c_int64
+        lib.dg_emit_block.argtypes = [
+            ctypes.POINTER(DgLevel), ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int64, ctypes.c_int64,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8))]
+        lib.dg_emit_free.restype = None
+        lib.dg_emit_free.argtypes = [ctypes.POINTER(ctypes.c_uint8)]
     _lib = lib
     return lib
 
 
 def build(quiet: bool = True) -> bool:
     """Compile libdgtpu.so in place (reference role: `go build`)."""
-    global _lib, HAVE_NATIVE
+    global _lib, HAVE_NATIVE, HAVE_EMIT
     try:
         subprocess.run(["make", "-C", _DIR],
                        capture_output=quiet, check=True, timeout=120)
@@ -59,10 +67,62 @@ def build(quiet: bool = True) -> bool:
         return False
     _lib = None
     HAVE_NATIVE = _load() is not None
+    HAVE_EMIT = HAVE_NATIVE and hasattr(_lib, "dg_emit_block")
     return HAVE_NATIVE
 
 
+class DgLeaf(ctypes.Structure):
+    """Mirrors emit.cpp DgLeaf (a pre-encoded column of one JSON key)."""
+    _fields_ = [
+        ("key", ctypes.c_void_p), ("key_len", ctypes.c_int64),
+        ("kind", ctypes.c_int32), ("pad_", ctypes.c_int32),
+        ("frag_off", ctypes.c_void_p), ("frag_blob", ctypes.c_void_p),
+        ("nums", ctypes.c_void_p),
+    ]
+
+
+class DgLevel(ctypes.Structure):
+    pass
+
+
+class DgChild(ctypes.Structure):
+    """Mirrors emit.cpp DgChild (one uid edge: key + CSR row map)."""
+    _fields_ = [
+        ("key", ctypes.c_void_p), ("key_len", ctypes.c_int64),
+        ("level", ctypes.POINTER(DgLevel)),
+        ("row_indptr", ctypes.c_void_p), ("row_child", ctypes.c_void_p),
+    ]
+
+
+DgLevel._fields_ = [
+    ("n", ctypes.c_int64),
+    ("n_leaves", ctypes.c_int64), ("leaves", ctypes.POINTER(DgLeaf)),
+    ("n_children", ctypes.c_int64), ("children", ctypes.POINTER(DgChild)),
+    ("level_id", ctypes.c_int64),
+]
+
+
+def emit_block(root: DgLevel, display: np.ndarray, n_levels: int) -> bytes:
+    """Emit one block's JSON array from a lowered level tree.
+
+    `display`: int32 domain positions to render at the root. The caller
+    keeps every referenced numpy array / bytes object alive for the call.
+    """
+    lib = _load()
+    display = np.ascontiguousarray(display, np.int32)
+    out = ctypes.POINTER(ctypes.c_uint8)()
+    n = lib.dg_emit_block(ctypes.byref(root), _ptr(display, ctypes.c_int32),
+                          len(display), n_levels, ctypes.byref(out))
+    if n < 0:
+        raise MemoryError("dg_emit_block allocation failed")
+    try:
+        return ctypes.string_at(out, n)
+    finally:
+        lib.dg_emit_free(out)
+
+
 HAVE_NATIVE = _load() is not None
+HAVE_EMIT = HAVE_NATIVE and hasattr(_lib, "dg_emit_block")
 
 
 def _ptr(a: np.ndarray, ct):

@@ -192,6 +192,17 @@ class Alpha:
                 if not self._active_reads[ts]:
                     del self._active_reads[ts]
 
+    def _query_view(self, ts: int, acl_user: str | None):
+        """Store view a query at `ts` executes against (MVCC snapshot →
+        tablet routing → ACL restriction, in that order)."""
+        store = self.mvcc.read_view(ts)
+        if self.groups is not None:
+            from dgraph_tpu.cluster.routed import routed_view
+            store = routed_view(self, store, ts)
+        if self.acl is not None and acl_user is not None:
+            store = self.acl.readable_view(acl_user, store)
+        return store
+
     def query(self, dql: str, variables: dict | None = None,
               read_ts: int | None = None,
               acl_user: str | None = None) -> dict:
@@ -200,16 +211,24 @@ class Alpha:
         unreadable predicates are invisible (reference: query rewriting
         drops unauthorized predicates)."""
         with self._reading(read_ts) as ts:
-            store = self.mvcc.read_view(ts)
-            if self.groups is not None:
-                from dgraph_tpu.cluster.routed import routed_view
-                store = routed_view(self, store, ts)
-            if self.acl is not None and acl_user is not None:
-                store = self.acl.readable_view(acl_user, store)
+            store = self._query_view(ts, acl_user)
             out = Engine(store, device_threshold=self.device_threshold,
                          mesh=self.mesh).query(dql, variables)
         self._maybe_gc()
         return out
+
+    def query_raw(self, dql: str, variables: dict | None = None,
+                  read_ts: int | None = None,
+                  acl_user: str | None = None) -> bytes:
+        """Serving-path query: response BYTES via the native JSON emitter
+        (engine/emit.py), never a Python object tree (reference:
+        outputnode.go ToJson writes bytes straight into the response)."""
+        with self._reading(read_ts) as ts:
+            store = self._query_view(ts, acl_user)
+            raw = Engine(store, device_threshold=self.device_threshold,
+                         mesh=self.mesh).query_bytes(dql, variables)
+        self._maybe_gc()
+        return raw
 
     def query_batch(self, dqls: list, read_ts: int | None = None,
                     acl_user: str | None = None) -> list:
@@ -221,12 +240,7 @@ class Alpha:
         from dgraph_tpu.engine.batch import plan_batch_groups, run_batch
 
         with self._reading(read_ts) as ts:
-            store = self.mvcc.read_view(ts)
-            if self.groups is not None:
-                from dgraph_tpu.cluster.routed import routed_view
-                store = routed_view(self, store, ts)
-            if self.acl is not None and acl_user is not None:
-                store = self.acl.readable_view(acl_user, store)
+            store = self._query_view(ts, acl_user)
             from dgraph_tpu.utils import logging as xlog
             results: list = [None] * len(dqls)
             leftover = list(range(len(dqls)))

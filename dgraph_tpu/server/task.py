@@ -48,14 +48,13 @@ class DgraphService:
             ctx.abort(grpc.StatusCode.UNAUTHENTICATED, str(e))
 
     def Query(self, req: pb.Request, ctx) -> pb.Response:
-        import json
         t0 = time.perf_counter()
         acl_user = self._acl_user(ctx)
         start_ts = req.start_ts or None
-        out = self.alpha.query(req.query, dict(req.vars) or None,
-                               read_ts=start_ts, acl_user=acl_user)
+        raw = self.alpha.query_raw(req.query, dict(req.vars) or None,
+                                   read_ts=start_ts, acl_user=acl_user)
         return pb.Response(
-            json=json.dumps(out).encode(),
+            json=raw,
             txn=pb.TxnContext(start_ts=start_ts or 0),
             latency_us=int((time.perf_counter() - t0) * 1e6))
 
