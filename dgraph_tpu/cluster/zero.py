@@ -492,7 +492,10 @@ class ZeroService:
             ctx.abort(grpc.StatusCode.INVALID_ARGUMENT,
                       "grant larger than the replication margin")
         if not self.state.lease_headroom_ok(n_ts, n_uid):
-            ctx.abort(grpc.StatusCode.UNAVAILABLE,
+            # RESOURCE_EXHAUSTED (not UNAVAILABLE): a deliberate answer
+            # for THIS caller — connectivity-style codes would invite
+            # client-side failover to the standby, which can only refuse
+            ctx.abort(grpc.StatusCode.RESOURCE_EXHAUSTED,
                       "lease space awaiting standby replication; retry")
 
     def Connect(self, req: pb.ConnectRequest, ctx) -> pb.ConnectResponse:
@@ -655,6 +658,7 @@ def run_standby(state: ZeroState, primary_addr: str, poll_s: float = 1.0,
     since = state._doc_base + len(state.doc_log)
     expect_id = state.log_id or None
     last_ok = _time.monotonic()
+    apply_fails = 0  # consecutive replica-apply failures (backoff)
     while stop_event is None or not stop_event.is_set():
         try:
             docs, nxt, _standby, log_id = client.journal_tail_full(since)
@@ -674,6 +678,28 @@ def run_standby(state: ZeroState, primary_addr: str, poll_s: float = 1.0,
             if _time.monotonic() - last_ok > promote_after_s:
                 state.promote()
                 return True
+        except Exception:  # noqa: BLE001 — a malformed doc must not kill
+            # the standby thread silently (failover would be lost with no
+            # log line); resync the replica from zero and keep tailing.
+            # A deterministically-bad doc would otherwise re-download the
+            # whole journal every poll — back off exponentially and log
+            # loudly only on the first consecutive failure.
+            from dgraph_tpu.utils import logging as xlog
+            if apply_fails == 0:
+                xlog.get("zero").error(
+                    "standby apply failed; resetting replica",
+                    exc_info=True)
+            else:
+                xlog.get("zero").debug(
+                    "standby apply still failing (attempt %d)",
+                    apply_fails + 1, exc_info=True)
+            state.reset_replica()
+            since = 0
+            expect_id = None
+            _time.sleep(min(poll_s * (2 ** apply_fails), 30.0))
+            apply_fails += 1
+            continue
+        apply_fails = 0
         _time.sleep(poll_s)
     return False
 
@@ -738,9 +764,16 @@ class ZeroClient:
             try:
                 return rpc(req)
             except grpc.RpcError as e:
-                if e.code() == grpc.StatusCode.ABORTED or \
-                        len(self.targets) == 1:
-                    raise  # semantic (txn abort) or nowhere to go
+                code = e.code()
+                if (code == grpc.StatusCode.ABORTED
+                        or code == grpc.StatusCode.INVALID_ARGUMENT
+                        or code == grpc.StatusCode.RESOURCE_EXHAUSTED
+                        or len(self.targets) == 1):
+                    # semantic errors (txn abort, oversized grant, the
+                    # primary's lease gate asking THIS caller to retry)
+                    # must reach the caller — rotating to the standby
+                    # would mask them behind its FAILED_PRECONDITION
+                    raise
                 # connectivity / standby refusal: try the next zero
                 last_err = e
                 self._cur = (self._cur + 1) % len(self.targets)

@@ -123,6 +123,9 @@ def _geo_func(store: Store, f: FuncNode, name: str) -> np.ndarray:
             raise ValueError("within() outer ring needs >= 4 positions")
         xs = [x for x, _ in rings[0]]
         ys = [y for _, y in rings[0]]
+        # cover_bbox returns None for antimeridian-crossing query rings
+        # (naive bbox would cover the wrong side) — candidates() then
+        # scans and the exact verify below decides
         toks = G.cover_bbox(min(xs), min(ys), max(xs), max(ys))
         out = []
         for r in candidates(toks).tolist():
@@ -133,10 +136,13 @@ def _geo_func(store: Store, f: FuncNode, name: str) -> np.ndarray:
                     break
                 vrings = v.rings()
                 # a stored polygon is within the query area when its
-                # whole boundary is (vertex containment — the verify
-                # granularity the cell cover supports)
-                if vrings and all(G.point_in_polygon(x, y, rings)
-                                  for x, y in vrings[0]):
+                # whole boundary is: vertices AND edge midpoints are
+                # tested, so a concave query edge cutting between two
+                # contained vertices is caught (segment-granularity
+                # approximation of exact S2 containment)
+                if vrings and all(
+                        G.point_in_polygon(x, y, rings)
+                        for x, y in _ring_probes(vrings[0])):
                     out.append(r)
                     break
         return np.array(sorted(out), np.int32)
@@ -155,6 +161,17 @@ def _geo_func(store: Store, f: FuncNode, name: str) -> np.ndarray:
 
 
 # -- helpers ----------------------------------------------------------------
+
+def _ring_probes(ring):
+    """Vertices plus edge midpoints of a polygon ring — the containment
+    probe set within() tests against the query area."""
+    n = len(ring)
+    for i in range(n):
+        x1, y1 = ring[i]
+        yield x1, y1
+        x2, y2 = ring[(i + 1) % n]
+        yield (x1 + x2) / 2.0, (y1 + y2) / 2.0
+
 
 def _schema_kind(store: Store, attr: str) -> Kind:
     ps = store.schema.peek(attr)

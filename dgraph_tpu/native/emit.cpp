@@ -125,7 +125,10 @@ struct Emitter {
       }
     }
     out.push_back('}');
-    m[p] = {start, (int64_t)out.size() - start};
+    int64_t len = (int64_t)out.size() - start;
+    // never memoize "{}": empty objects get truncated by the caller, so
+    // a remembered span would dangle past out.size() once rolled back
+    if (len > 2) m[p] = {start, len};
   }
 };
 

@@ -179,7 +179,9 @@ def polygon_cover_tokens(min_lon, min_lat, max_lon, max_lat) -> list[str]:
 
 def tokens_for_geo(g: GeoVal) -> list[str]:
     """Index tokens: points at every ladder precision; polygons by bbox
-    cover per precision (see polygon_cover_tokens)."""
+    cover per precision (see polygon_cover_tokens). A polygon whose ring
+    spans >180° of longitude crosses the antimeridian: its bbox splits
+    at ±180 into two covers so index lookups from either side find it."""
     pt = g.point()
     if pt is not None:
         return point_tokens(*pt)
@@ -187,8 +189,23 @@ def tokens_for_geo(g: GeoVal) -> list[str]:
     if rings:
         xs = [x for x, _ in rings[0]]
         ys = [y for _, y in rings[0]]
-        return polygon_cover_tokens(min(xs), min(ys), max(xs), max(ys))
+        out = []
+        for lo, hi in lon_spans(xs):
+            out.extend(polygon_cover_tokens(lo, min(ys), hi, max(ys)))
+        return sorted(set(out))
     return []
+
+
+def lon_spans(xs: list[float]) -> list[tuple[float, float]]:
+    """Longitude interval(s) of a ring: one (min, max) span normally;
+    split at ±180 when the naive span exceeds 180° (antimeridian
+    crossing — the ring's lons live at both ends of the axis)."""
+    lo, hi = min(xs), max(xs)
+    if hi - lo <= 180.0:
+        return [(lo, hi)]
+    east = [x for x in xs if x >= 0.0]
+    west = [x for x in xs if x < 0.0]
+    return [(min(east), 180.0), (-180.0, max(west))]
 
 
 def _bbox_cells(min_lon, min_lat, max_lon, max_lat, precision,
@@ -272,6 +289,11 @@ def cover_bbox(min_lon, min_lat, max_lon, max_lat):
     polygons across the ladder (mirrors their capped index cover, which
     always shares at least the uncapped coarsest precision); None →
     caller should scan."""
+    if max_lon - min_lon > 180.0:
+        # a >180° span means the ring crosses the antimeridian and the
+        # naive min/max bbox covers the WRONG side — cells would silently
+        # miss every matching value. Force the exact-scan fallback.
+        return None
     chosen = None
     for p in PRECISIONS:
         cells = _bbox_cells(min_lon, min_lat, max_lon, max_lat, p)

@@ -30,7 +30,8 @@ import numpy as np
 from dgraph_tpu.store import vault
 from dgraph_tpu.store.mvcc import Mutation
 
-MAGIC = b"DGW1"
+MAGIC = b"DGW1"   # legacy frames (pre ordinal binding) — read-only
+MAGIC2 = b"DGW2"  # current frames: payload AAD-bound to the ordinal
 _HEADER = struct.Struct("<II")  # len, crc32
 
 
@@ -116,8 +117,9 @@ class Journal:
         # records written after corrupt bytes would be unreachable by
         # replay (it stops at the first bad record) — acked-but-invisible.
         self._seq = 0  # ordinal of the next record (encryption AAD)
+        needs_reseal = False
         if os.path.exists(path):
-            valid_end, self._seq = _scan_state(path)
+            valid_end, self._seq, needs_reseal = _scan_state(path)
             if valid_end < os.path.getsize(path):
                 with open(path, "r+b") as f:
                     f.truncate(valid_end)
@@ -125,6 +127,20 @@ class Journal:
                     os.fsync(f.fileno())
         self._wlock = threading.Lock()
         self._f = open(path, "ab")
+        if needs_reseal:
+            self._reseal_legacy()
+
+    def _reseal_legacy(self) -> None:
+        """Legacy frames (pre-ordinal DGW1, or plaintext written before
+        the key was enabled) would otherwise validate at every position
+        forever — an indefinite replay/reorder window. The frame magic
+        makes detection free (_scan_state flags them during the normal
+        open scan); when any are present the whole file rewrites as
+        ordinal-sealed DGW2 frames, closing the migration path eagerly."""
+        with open(self.path, "rb") as f:
+            data = f.read()
+        self.rewrite(json.loads(_dec_payload(p, seq, legacy))
+                     for seq, (_off, p, legacy) in enumerate(_scan(data)))
 
     @staticmethod
     def _frame(doc: dict, seq: int) -> bytes:
@@ -137,8 +153,8 @@ class Journal:
         payload = vault.encrypt(
             json.dumps(doc, separators=(",", ":")).encode(),
             aad=_rec_aad(seq))
-        return MAGIC + _HEADER.pack(len(payload),
-                                    zlib.crc32(payload)) + payload
+        return MAGIC2 + _HEADER.pack(len(payload),
+                                     zlib.crc32(payload)) + payload
 
     def append(self, doc: dict) -> None:
         # concurrent appenders (apply broadcasts race local commits) must
@@ -175,8 +191,8 @@ class Journal:
             return
         with open(path, "rb") as f:
             data = f.read()
-        for seq, (_off, payload) in enumerate(_scan(data)):
-            yield json.loads(_dec_payload(payload, seq))
+        for seq, (_off, payload, legacy) in enumerate(_scan(data)):
+            yield json.loads(_dec_payload(payload, seq, legacy))
 
     def close(self) -> None:
         self._f.close()
@@ -215,44 +231,58 @@ class WAL(Journal):
             for ts, kind, obj in replay(self.path) if ts > upto_ts)
 
 
-def _scan(data: bytes) -> Iterator[tuple[int, bytes]]:
-    """Yield (record_end_offset, payload) for every intact record."""
+def _scan(data: bytes) -> Iterator[tuple[int, bytes, bool]]:
+    """Yield (record_end_offset, payload, is_legacy_frame) for every
+    intact record. Legacy = a DGW1 frame (sealed before ordinal AAD
+    binding); only those may use the no-AAD decrypt fallback."""
     off = 0
     hdr = len(MAGIC) + _HEADER.size
     while off + hdr <= len(data):
-        if data[off:off + len(MAGIC)] != MAGIC:
+        magic = data[off:off + len(MAGIC)]
+        if magic != MAGIC and magic != MAGIC2:
             return
         ln, crc = _HEADER.unpack(data[off + len(MAGIC):off + hdr])
         payload = data[off + hdr:off + hdr + ln]
         if len(payload) < ln or zlib.crc32(payload) != crc:
             return
         off += hdr + ln
-        yield off, payload
+        yield off, payload, magic == MAGIC
 
 
 def _rec_aad(seq: int) -> bytes:
     return b"wal-rec:%d" % seq
 
 
-def _dec_payload(payload: bytes, seq: int) -> bytes:
-    """Unseal a record at ordinal `seq`. Records written before ordinal
-    binding carried no AAD; they are accepted as a migration path (the
-    next rewrite/truncate re-seals everything with ordinals)."""
+def _dec_payload(payload: bytes, seq: int, legacy: bool = False) -> bytes:
+    """Unseal a record at ordinal `seq`. ONLY legacy (DGW1) frames may
+    fall back to the no-AAD seal — a DGW2 frame that fails its ordinal
+    check is tampering, not migration (Journal.__init__ re-seals legacy
+    files on open, so the fallback only runs for read-only replay of a
+    not-yet-migrated file)."""
+    if not legacy:
+        return vault.decrypt(payload, aad=_rec_aad(seq))
     try:
         return vault.decrypt(payload, aad=_rec_aad(seq))
     except vault.VaultError:
         return vault.decrypt(payload)
 
 
-def _scan_state(path: str) -> tuple[int, int]:
-    """(byte offset where the intact record prefix ends, record count)."""
+def _scan_state(path: str) -> tuple[int, int, bool]:
+    """(intact-prefix end offset, record count, needs_reseal): the last
+    is True when encryption is active and any frame is legacy (DGW1) or
+    still plaintext — detected from the frame headers alone, so a fully
+    migrated log pays nothing extra on open."""
     with open(path, "rb") as f:
         data = f.read()
     end = n = 0
-    for off, _payload in _scan(data):
+    mig = False
+    enc = vault.active()
+    for off, payload, legacy in _scan(data):
         end = off
         n += 1
-    return end, n
+        if enc and (legacy or not vault.is_encrypted(payload)):
+            mig = True
+    return end, n, mig
 
 
 def _valid_end(path: str) -> int:
@@ -268,8 +298,8 @@ def replay(path: str) -> Iterator[tuple[int, str, object]]:
         return
     with open(path, "rb") as f:
         data = f.read()
-    for seq, (_off, payload) in enumerate(_scan(data)):
-        doc = json.loads(_dec_payload(payload, seq))
+    for seq, (_off, payload, legacy) in enumerate(_scan(data)):
+        doc = json.loads(_dec_payload(payload, seq, legacy))
         if "schema" in doc:
             yield int(doc["ts"]), "schema", doc["schema"]
         elif "drop" in doc:

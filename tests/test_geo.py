@@ -239,3 +239,52 @@ def test_invalid_geojson_rejected():
         a.mutate(set_nquads='_:x <loc> "not json" .')
     with pytest.raises(Exception):
         a.mutate(set_nquads='_:x <loc> "{\\"type\\": \\"Nope\\"}" .')
+
+
+def test_antimeridian_bbox_forces_scan_and_split_tokens():
+    """A ring spanning >180 deg of longitude crosses the antimeridian:
+    the naive min/max bbox covers the WRONG side. cover_bbox must force
+    the scan fallback; stored crossing polygons index BOTH sides."""
+    assert G.cover_bbox(-179.0, -1.0, 179.0, 1.0) is None
+    # lon_spans splits the ring at +/-180
+    spans = G.lon_spans([179.0, -179.0, -179.5, 179.5])
+    assert spans == [(179.0, 180.0), (-180.0, -179.0)]
+    # non-crossing rings keep one span
+    assert G.lon_spans([10.0, 12.0]) == [(10.0, 12.0)]
+    # a stored crossing polygon gets cover tokens on both sides, so
+    # contains() candidates from either side of the line can find it
+    gv = G.parse_geo({"type": "Polygon", "coordinates": [[
+        [179.0, -1.0], [-179.0, -1.0], [-179.0, 1.0],
+        [179.0, 1.0], [179.0, -1.0]]]})
+    toks = G.tokens_for_geo(gv)
+    east = [t for t in toks if G.geohash(179.5, 0.0, 2) in t]
+    west = [t for t in toks if G.geohash(-179.5, 0.0, 2) in t]
+    assert east and west
+
+
+def test_within_concave_polygon_rejects_bulging_edge():
+    """A stored polygon whose VERTICES all sit inside a concave (U-shaped)
+    query area but whose edge crosses the notch must NOT match within()
+    (edge-midpoint probes catch the bulge)."""
+    a = Alpha(device_threshold=10**9)
+    a.alter(SCHEMA)
+    # U-shape: two tall arms joined at the bottom, open notch in the
+    # middle (x in [4, 6], y > 2 is OUTSIDE)
+    u_ring = [[0.0, 0.0], [10.0, 0.0], [10.0, 10.0], [6.0, 10.0],
+              [6.0, 2.0], [4.0, 2.0], [4.0, 10.0], [0.0, 10.0],
+              [0.0, 0.0]]
+    # bar: thin rectangle from the left arm to the right arm at y=5 —
+    # every vertex inside an arm, the long edges cross the notch
+    bar = {"type": "Polygon", "coordinates": [[
+        [1.0, 4.9], [9.0, 4.9], [9.0, 5.1], [1.0, 5.1], [1.0, 4.9]]]}
+    # square fully inside the left arm: must match
+    left = {"type": "Polygon", "coordinates": [[
+        [1.0, 4.0], [3.0, 4.0], [3.0, 6.0], [1.0, 6.0], [1.0, 4.0]]]}
+    a.mutate(set_nquads=(
+        f'_:bar <name> "bar" .\n'
+        f"_:bar <loc> {json.dumps(json.dumps(bar))} .\n"
+        f'_:left <name> "left" .\n'
+        f"_:left <loc> {json.dumps(json.dumps(left))} .\n"))
+    out = a.query('{ q(func: within(loc, %s), orderasc: name) { name } }'
+                  % json.dumps([u_ring]))
+    assert [r["name"] for r in out["q"]] == ["left"]

@@ -122,7 +122,7 @@ def test_wal_record_reorder_rejected(tmp_path):
     data = open(path, "rb").read()
     recs = []
     prev = 0
-    for off, _payload in _scan(data):
+    for off, _payload, _legacy in _scan(data):
         recs.append(data[prev:off])
         prev = off
     open(path, "wb").write(recs[1] + recs[0])  # swap
@@ -297,3 +297,51 @@ def test_cli_key_flag(tmp_path):
         checkpoint.load(out)
     assert main(["debug", "--p", out,
                  "--encryption_key_file", str(kf)]) == 0
+
+
+def test_legacy_no_aad_records_resealed_on_open(tmp_path, monkeypatch):
+    """Records sealed before ordinal binding validate at any position via
+    the migration fallback; opening the journal for writing must re-seal
+    them eagerly so the fallback window closes."""
+    import json as _json
+
+    from dgraph_tpu.store import wal as walmod
+
+    vault.set_key(KEY)
+    path = str(tmp_path / "j.log")
+    # forge a pre-ordinal log: DGW1 frames, every record sealed with
+    # EMPTY aad (what the pre-ordinal build wrote)
+    with monkeypatch.context() as m:
+        m.setattr(walmod, "MAGIC2", walmod.MAGIC)
+        m.setattr(walmod, "_rec_aad", lambda seq: b"")
+        legacy = walmod.Journal(path, sync=False)
+        for i in range(3):
+            legacy.append({"i": i})
+        legacy.close()
+    # sanity: these records do NOT verify at their ordinals yet
+    with open(path, "rb") as f:
+        recs = list(walmod._scan(f.read()))
+    assert all(leg for _off, _p, leg in recs)
+    with pytest.raises(vault.VaultError):
+        vault.decrypt(recs[0][1], aad=walmod._rec_aad(0))
+
+    j = walmod.Journal(path, sync=False)  # open -> eager re-seal
+    j.append({"i": 3})
+    j.close()
+    with open(path, "rb") as f:
+        recs = list(walmod._scan(f.read()))
+    assert len(recs) == 4
+    assert not any(leg for _off, _p, leg in recs)  # all DGW2 now
+    for seq, (_off, p, _leg) in enumerate(recs):
+        # ordinal-bound now: correct aad verifies ...
+        doc = _json.loads(vault.decrypt(p, aad=walmod._rec_aad(seq)))
+        assert doc == {"i": seq}
+        # ... and the legacy no-AAD path no longer does
+        with pytest.raises(vault.VaultError):
+            vault.decrypt(p)
+    assert [d["i"] for d in walmod.Journal.replay(path)] == [0, 1, 2, 3]
+    # a fully-migrated log re-opens with NO reseal rewrite (mtime probe)
+    import os as _os
+    before = _os.stat(path).st_mtime_ns
+    walmod.Journal(path, sync=False).close()
+    assert _os.stat(path).st_mtime_ns == before

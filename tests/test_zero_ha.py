@@ -145,7 +145,7 @@ def test_lease_gating_bounds_unacked_issuance():
             for _ in range(cap + 10):
                 c.read_ts()
                 issued += 1
-        assert ei.value.code() == grpc.StatusCode.UNAVAILABLE
+        assert ei.value.code() == grpc.StatusCode.RESOURCE_EXHAUSTED
         assert issued < cap  # stopped before outrunning the ack margin
         # the standby pulls (acks) the lease-block docs → gate lifts
         _docs, nxt = state.journal_tail(0)
@@ -159,7 +159,7 @@ def test_lease_gating_bounds_unacked_issuance():
         assert 0 < headroom + 1 < cap  # the probe stays a legal size
         with pytest.raises(grpc.RpcError) as ei:
             c.assign_uids(headroom + 1)  # whole grant would cross
-        assert ei.value.code() == grpc.StatusCode.UNAVAILABLE
+        assert ei.value.code() == grpc.StatusCode.RESOURCE_EXHAUSTED
         # and a grant at/above the whole margin is a hard client error
         with pytest.raises(grpc.RpcError) as ei:
             c.assign_uids(cap)
@@ -303,3 +303,71 @@ def test_alpha_survives_zero_failover():
         t.join(timeout=2)
         aserver.stop(None)
         sserver.stop(None)
+
+
+def test_semantic_errors_do_not_rotate_to_standby():
+    """INVALID_ARGUMENT (oversized grant) and the primary's lease-gate
+    RESOURCE_EXHAUSTED lease gate are answers for THIS caller — rotating to the
+    standby would mask them behind its FAILED_PRECONDITION."""
+    from dgraph_tpu.cluster.zero import LEASE_BLOCK, MAX_UNACKED_BLOCKS
+    pserver, pport, pstate = make_zero_server()
+    pserver.start()
+    sstate = ZeroState(standby=True)
+    sserver, sport, _ = make_zero_server(sstate)
+    sserver.start()
+    c = ZeroClient(f"127.0.0.1:{pport},127.0.0.1:{sport}")
+    cap = MAX_UNACKED_BLOCKS * LEASE_BLOCK
+    try:
+        # oversized grant: a hard client error from the primary, not a
+        # reason to ask the standby
+        with pytest.raises(grpc.RpcError) as ei:
+            c.assign_uids(cap)
+        assert ei.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+        assert c.targets[c._cur].endswith(str(pport))  # did not rotate
+        # lease gate: attach a fake standby ack stream, outrun it
+        pstate.journal_tail(0)
+        with pytest.raises(grpc.RpcError) as ei:
+            for _ in range(cap + 10):
+                c.read_ts()
+        assert ei.value.code() == grpc.StatusCode.RESOURCE_EXHAUSTED
+        assert c.targets[c._cur].endswith(str(pport))  # did not rotate
+    finally:
+        pserver.stop(None)
+        sserver.stop(None)
+
+
+def test_standby_survives_bad_doc_and_still_promotes():
+    """A doc that fails to apply must not kill the standby thread
+    silently — it resets/resyncs and failover still happens when the
+    primary dies."""
+    pserver, pport, pstate = make_zero_server()
+    pserver.start()
+    pc = ZeroClient(f"127.0.0.1:{pport}")
+    pc.connect("127.0.0.1:9001", group=1)
+    for _ in range(3):
+        pc.read_ts()
+
+    sstate = ZeroState(standby=True)
+    calls = {"n": 0}
+    real_apply = sstate.apply_remote
+
+    def flaky_apply(docs):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise ValueError("malformed doc")
+        return real_apply(docs)
+
+    sstate.apply_remote = flaky_apply
+    promoted = []
+    t = threading.Thread(
+        target=lambda: promoted.append(run_standby(
+            sstate, f"127.0.0.1:{pport}", poll_s=0.05,
+            promote_after_s=0.5)), daemon=True)
+    t.start()
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline and calls["n"] < 2:
+        time.sleep(0.05)
+    assert calls["n"] >= 2, "standby thread died on the bad doc"
+    pserver.stop(None)  # primary goes dark -> promotion
+    t.join(timeout=10)
+    assert promoted == [True] and not sstate.standby
