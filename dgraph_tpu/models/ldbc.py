@@ -230,15 +230,29 @@ works_at: [uid] @reverse .
 """
 
 
+def ic_params(g: SNBGraph) -> dict:
+    """Concrete parameter choices for the IC templates — the SINGLE
+    source both ic_templates and the golden oracle (tests/test_ldbc_ic)
+    read, so they can never diverge silently."""
+    return {
+        "p": int(g.person_uids[len(g.person_uids) // 2]),
+        "p2": int(g.person_uids[7]),
+        "fn": g.first_name[3],
+        "city": g.city[0], "city2": g.city[1],
+        "ts_mid": int(np.median(g.creation_ts)),
+    }
+
+
 def ic_templates(g: SNBGraph) -> dict[str, str]:
     """All 14 LDBC SNB Interactive Complex template shapes as DQL — the
     single source used by both the benchmark (bench_baseline.py config
     5) and its regression test (tests/test_ldbc_ic.py)."""
-    p_uid = hex(int(g.person_uids[len(g.person_uids) // 2]))
-    p2_uid = hex(int(g.person_uids[7]))
-    fn = g.first_name[3]
-    city, city2 = g.city[0], g.city[1]
-    ts_mid = int(np.median(g.creation_ts))
+    pr = ic_params(g)
+    p_uid = hex(pr["p"])
+    p2_uid = hex(pr["p2"])
+    fn = pr["fn"]
+    city, city2 = pr["city"], pr["city2"]
+    ts_mid = pr["ts_mid"]
     return {
         "IC1": '{ v as var(func: uid(%s)) @recurse(depth: 3, '
                'loop: false) { knows } '
