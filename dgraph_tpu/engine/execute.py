@@ -154,9 +154,10 @@ class Executor:
 
     @classmethod
     def _reassemble_shards(cls, srel, nbrs_s, seg_s, pos_s, counts):
-        nbrs_s, seg_s, pos_s = (np.asarray(nbrs_s), np.asarray(seg_s),
-                                np.asarray(pos_s))
-        counts = np.asarray(counts)
+        from dgraph_tpu.parallel.mesh import host_np
+        nbrs_s, seg_s, pos_s = (host_np(nbrs_s), host_np(seg_s),
+                                host_np(pos_s))
+        counts = host_np(counts)
         return cls._stitch_edge_parts(
             (nbrs_s[d, :int(counts[d])], seg_s[d, :int(counts[d])],
              pos_s[d, :int(counts[d])], srel.pos_lo[d])
@@ -181,9 +182,11 @@ class Executor:
         fr = ops.pad_to(frontier, _bucket(len(frontier)))
         deg = self.store.rel(pred, reverse).degree(frontier)
         edge_cap = self._shard_edge_cap(srel, frontier, deg)
+        from dgraph_tpu.parallel.mesh import host_np
         nbrs_s, seg_s, pos_s, totals, max_shard = matrix_hop(
             self.mesh, srel, fr, edge_cap)
-        assert int(max_shard) <= edge_cap, (int(max_shard), edge_cap)
+        max_shard = int(host_np(max_shard))
+        assert max_shard <= edge_cap, (max_shard, edge_cap)
         return self._reassemble_shards(srel, nbrs_s, seg_s, pos_s, totals)
 
     def _expand_mesh_ring(self, pred: str, reverse: bool,
@@ -208,12 +211,13 @@ class Executor:
         per_pair = np.zeros((d, d))
         np.add.at(per_pair, (chunk_of, shard_of), deg)
         edge_cap = _bucket(max(int(per_pair.max()), 1))
+        from dgraph_tpu.parallel.mesh import host_np
         nbrs_a, seg_a, pos_a, totals, max_e = ring_matrix_hop(
             self.mesh, srel, chunks, edge_cap)
-        assert int(max_e) <= edge_cap, (int(max_e), edge_cap)
-        nbrs_a, seg_a, pos_a = (np.asarray(nbrs_a), np.asarray(seg_a),
-                                np.asarray(pos_a))
-        totals = np.asarray(totals)
+        assert int(host_np(max_e)) <= edge_cap, edge_cap
+        nbrs_a, seg_a, pos_a = (host_np(nbrs_a), host_np(seg_a),
+                                host_np(pos_a))
+        totals = host_np(totals)
         nbrs, seg, pos = self._stitch_edge_parts(
             (nbrs_a[dev, i, :int(totals[dev, i])],
              seg_a[dev, i, :int(totals[dev, i])] + ((dev - i) % d) * per,
@@ -640,10 +644,11 @@ class Executor:
 
         srel = self.store.sharded_rel(sg.attr, sg.is_reverse, self.mesh)
         edge_cap = self._shard_edge_cap(srel, frontier, deg)
+        from dgraph_tpu.parallel.mesh import host_np
         nbrs_s, seg_s, pos_s, kept, _totals, max_shard = matrix_level(
             self.mesh, srel, fr, allowed_d, sg.offset, first,
             edge_cap, use_allowed)
-        assert int(max_shard) <= edge_cap, (int(max_shard), edge_cap)
+        assert int(host_np(max_shard)) <= edge_cap, edge_cap
         return self._reassemble_shards(srel, nbrs_s, seg_s, pos_s, kept)
 
     # -- leaves, vars, expand(_all_) ----------------------------------------

@@ -154,7 +154,8 @@ def _fused_recurse(ex, root, data: RecurseData, depth: int) -> None:
          frontiers) = recurse_fused_matrix(
             ex.mesh, srel, fr, edge_cap=edge_cap, out_cap=out_cap,
             seen_cap=seen_cap, depth=depth)
-        need_out, need_seen, need_edge = (int(x) for x in np.asarray(needs))
+        from dgraph_tpu.parallel.mesh import host_np
+        need_out, need_seen, need_edge = (int(x) for x in host_np(needs))
         if (need_out <= out_cap and need_seen <= seen_cap
                 and need_edge <= edge_cap):
             break
@@ -164,9 +165,9 @@ def _fused_recurse(ex, root, data: RecurseData, depth: int) -> None:
     else:
         raise RuntimeError("recurse caps failed to converge")
 
-    nbrs_s = np.asarray(nbrs_s)      # [D, depth, edge_cap]
-    seg_s = np.asarray(seg_s)
-    frontiers = np.asarray(frontiers)  # [depth, out_cap]
+    nbrs_s = host_np(nbrs_s)         # [D, depth, edge_cap]
+    seg_s = host_np(seg_s)
+    frontiers = host_np(frontiers)   # [depth, out_cap]
     parts_p, parts_c = [], []
     for h in range(depth):
         fr_h = frontiers[h]
@@ -180,5 +181,5 @@ def _fused_recurse(ex, root, data: RecurseData, depth: int) -> None:
     if parts_p:
         data.edges[0] = (np.concatenate(parts_p).astype(np.int32),
                          np.concatenate(parts_c).astype(np.int32))
-    seen = np.asarray(seen)
+    seen = host_np(seen)
     data.all_nodes = seen[seen != SENTINEL32].astype(np.int32)

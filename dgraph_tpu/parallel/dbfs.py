@@ -83,7 +83,8 @@ def shard_mask(mask: np.ndarray, n_shards: int, rows: int) -> np.ndarray:
 def unshard_mask(slabs: np.ndarray, n_nodes: int) -> np.ndarray:
     """[D, R, B] → [N, B]."""
     d, r, b = slabs.shape
-    return np.asarray(slabs).reshape(d * r, b)[:n_nodes]
+    from dgraph_tpu.parallel.mesh import host_np
+    return host_np(slabs).reshape(d * r, b)[:n_nodes]
 
 
 @functools.lru_cache(maxsize=32)

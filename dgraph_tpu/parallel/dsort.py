@@ -147,7 +147,8 @@ def mesh_topk(mesh: Mesh, store, pred: str, lang: str, ranks: np.ndarray,
     # shared across cardinalities within a bucket, not compiled per count
     kk = cap if k >= len(ranks) else min(k, cap)
     top_r, top_v = _build_topk(mesh, cap, kk, rows)(keys_s, row_lo, cand)
-    top_r = np.asarray(top_r)
+    from dgraph_tpu.parallel.mesh import host_np
+    top_r = host_np(top_r)
     out = top_r[np.asarray(valid_mask_np(top_r))]
     return out[:min(k, len(ranks))]
 
@@ -206,7 +207,8 @@ def mesh_row_sort(mesh: Mesh, store, pred: str, lang: str,
     # matters
     nb = ops.pad_to(np.asarray(nbrs, np.int32), cap)
     sg_ = ops.pad_to(np.asarray(seg, np.int32), cap)
-    order = np.asarray(_build_row_sort(mesh, cap, rows, desc)(
+    from dgraph_tpu.parallel.mesh import host_np
+    order = host_np(_build_row_sort(mesh, cap, rows, desc)(
         keys_s, row_lo, nb, sg_))
     # padded slots carry a maxint row key, so they sort strictly last:
     # the first len(nbrs) slots are the real permutation

@@ -52,6 +52,20 @@ def init_distributed(coordinator: str | None = None,
     return jax.process_count() > 1
 
 
+def host_np(x) -> np.ndarray:
+    """Kernel output → host numpy, multi-process safe (reference: the
+    coordinator gathering pb.Result legs). Single-process arrays fetch
+    directly; under a multi-host runtime an array spanning non-local
+    devices allgathers over DCN first (fully-replicated outputs read the
+    local copy without any transfer)."""
+    if isinstance(x, jax.Array) and not x.is_fully_addressable:
+        if x.is_fully_replicated:
+            return np.asarray(x.addressable_data(0))
+        from jax.experimental import multihost_utils
+        x = multihost_utils.process_allgather(x, tiled=True)
+    return np.asarray(x)
+
+
 def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
     """A 1-D mesh over the first `n_devices` devices (default: all)."""
     if devices is None:

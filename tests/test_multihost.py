@@ -1,0 +1,78 @@
+"""Multi-host DCN execution: two OS processes join one jax.distributed
+CPU runtime and answer mesh queries.
+
+Reference parity: the reference's systest runs real multi-node clusters
+(docker-compose); the analog here is two processes × 2 virtual CPU
+devices forming one 4-device global mesh over the distributed runtime
+(SURVEY §2.3 comm-backend row: DCN via jax.distributed). This actually
+executes parallel/mesh.py init_distributed and the engine's multi-process
+result gathering (parallel/mesh.py host_np)."""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+WORKER = r"""
+import os, sys
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+jax.config.update("jax_platforms", "cpu")
+pid = int(sys.argv[1]); port = sys.argv[2]
+from dgraph_tpu.parallel.mesh import init_distributed, make_mesh
+joined = init_distributed(f"127.0.0.1:{port}", 2, pid)
+assert joined and jax.process_count() == 2, jax.process_count()
+assert len(jax.devices()) == 4 and len(jax.local_devices()) == 2
+
+import numpy as np
+from dgraph_tpu.engine import Engine
+from dgraph_tpu.store import StoreBuilder, parse_schema
+
+# identical deterministic store in both processes (the reference analog:
+# every Alpha loads its tablet copy)
+b = StoreBuilder(parse_schema(
+    "name: string @index(exact) .\nfriend: [uid] @reverse ."))
+rng = np.random.default_rng(5)
+n = 500
+for u in range(1, n + 1):
+    b.add_value(u, "name", f"p{u}")
+src = rng.integers(1, n + 1, 3000); dst = rng.integers(1, n + 1, 3000)
+for s, d in zip(src.tolist(), dst.tolist()):
+    if s != d:
+        b.add_edge(s, "friend", d)
+store = b.finalize()
+
+host = Engine(store, device_threshold=10**9)
+meshe = Engine(store, device_threshold=0, mesh=make_mesh())
+for q in (
+    '{ q(func: eq(name, "p7")) { name friend { name friend { name } } } }',
+    '{ q(func: uid(0x1)) @recurse(depth: 3, loop: false) { uid friend } }',
+    '{ q(func: has(friend), first: 5) { name count(friend) } }',
+):
+    a, b_ = host.query(q), meshe.query(q)
+    assert a == b_, (q, a, b_)
+print(f"PASS process={pid}", flush=True)
+"""
+
+
+def test_two_process_distributed_mesh_query(tmp_path):
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.getcwd() + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [subprocess.Popen(
+        [sys.executable, str(script), str(i), str(port)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        cwd=os.getcwd(), env=env, text=True) for i in range(2)]
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=300)
+        outs.append(out)
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"process {i} failed:\n{out[-3000:]}"
+        assert f"PASS process={i}" in out
