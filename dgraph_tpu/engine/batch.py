@@ -88,15 +88,27 @@ def plan_batch_groups(store, queries_blocks):
     ([(plan, original_indices)], leftover_indices). Groups smaller than
     MIN_BATCH fall back to per-query execution with the leftovers —
     one incompatible query no longer disables the kernel for the rest
-    (reference: the per-goroutine mix, served batch-wise here)."""
+    (reference: the per-goroutine mix, served batch-wise here).
+
+    Two kernel families: unfiltered single-block @recurse takes the
+    dedicated recurse path (`_BatchPlan`, no permutation translation);
+    everything else — filtered recurse, nested level trees, multi-block
+    var chains — tries the level-tree planner (engine/treebatch.py)."""
+    from dgraph_tpu.engine.treebatch import TreePlan, plan_tree
+
     groups: dict = {}
+    tree_groups: dict = {}
     leftover: list[int] = []
     for i, blocks in enumerate(queries_blocks):
         er = _eligible(store, blocks)
-        if er is None:
-            leftover.append(i)
-        else:
+        if er is not None:
             groups.setdefault(er[0], []).append((i, er[1]))
+            continue
+        tp = plan_tree(store, blocks)
+        if tp is not None:
+            tree_groups.setdefault(tp[0], []).append((i, blocks, tp[1]))
+            continue
+        leftover.append(i)
     plans = []
     for sig, items in groups.items():
         if len(items) < MIN_BATCH:
@@ -105,14 +117,27 @@ def plan_batch_groups(store, queries_blocks):
             plans.append((_BatchPlan([sg for _, sg in items],
                                      sig[0], sig[1], sig[2]),
                           [i for i, _ in items]))
+    for sig, items in tree_groups.items():
+        if len(items) < MIN_BATCH:
+            leftover.extend(i for i, _b, _p in items)
+        else:
+            plan: TreePlan = items[0][2]
+            plan.queries = [b for _i, b, _p in items]
+            plans.append((plan, [i for i, _b, _p in items]))
     leftover.sort()
     return plans, leftover
 
 
-def run_batch(store, plan: _BatchPlan, device_threshold: int) -> list:
-    """Execute the batch as one ell_recurse launch and render each query
-    with the standard renderer (full leaf/value support)."""
+def run_batch(store, plan, device_threshold: int) -> list:
+    """Execute the batch as one lane-kernel launch and render each query
+    with the standard renderer (full leaf/value support). Dispatches on
+    plan family: recurse lane plan here, level-tree plan in treebatch."""
     import jax
+
+    from dgraph_tpu.engine.treebatch import TreePlan, run_tree_batch
+
+    if isinstance(plan, TreePlan):
+        return run_tree_batch(store, plan, device_threshold)
 
     from dgraph_tpu.ops.bfs import pack_seed_masks
 

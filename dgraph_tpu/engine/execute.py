@@ -57,6 +57,23 @@ def _bucket(n: int, lo: int = 64) -> int:
     return b
 
 
+def csr_rows(rel, frontier: np.ndarray):
+    """Host CSR row gather for a frontier → (neighbors, seg, edge_pos).
+    The one shared implementation of the per-uid posting walk (reference:
+    posting.List.Uids per uid; here one vectorized gather) — used by the
+    small-frontier expand path and the lane-batch mask rebuild."""
+    starts = rel.indptr[frontier]
+    deg = rel.indptr[frontier + 1] - starts
+    total = int(deg.sum())
+    if total == 0:
+        return EMPTY, EMPTY, EMPTY64
+    seg = np.repeat(np.arange(len(frontier), dtype=np.int32), deg)
+    base = np.repeat(np.cumsum(deg) - deg, deg)
+    pos = np.repeat(starts.astype(np.int64), deg) + \
+        (np.arange(total, dtype=np.int64) - base)
+    return rel.indices[pos], seg, pos
+
+
 class Executor:
     """Executes SubGraph trees against a Store snapshot.
 
@@ -101,16 +118,7 @@ class Executor:
             if self.mesh is not None:
                 return self._expand_mesh(pred, reverse, frontier)
             return self._expand_device(pred, reverse, frontier)
-        starts = rel.indptr[frontier]
-        deg = rel.indptr[frontier + 1] - starts
-        total = int(deg.sum())
-        if total == 0:
-            return EMPTY, EMPTY, EMPTY64
-        seg = np.repeat(np.arange(len(frontier), dtype=np.int32), deg)
-        base = np.repeat(np.cumsum(deg) - deg, deg)
-        pos = np.repeat(starts.astype(np.int64), deg) + \
-            (np.arange(total, dtype=np.int64) - base)
-        return rel.indices[pos], seg, pos
+        return csr_rows(rel, frontier)
 
     def facet_positions(self, sg: SubGraph, pos: np.ndarray) -> np.ndarray:
         """Edge positions in the forward-CSR space facet columns key on
@@ -471,15 +479,7 @@ class Executor:
             if sg.var_name:
                 self.uid_vars[sg.var_name] = data.nodes
             return node
-        ranks = self.root_ranks(sg)
-        ranks = self.apply_filter(sg.filters, ranks)
-        display = self._mesh_order_topk(sg, ranks)
-        if display is None:
-            order_idx = (self.order_ranks(ranks, sg.orders)
-                         if sg.orders else np.arange(len(ranks)))
-            display = ranks[order_idx]
-        page = self.paginate(len(display), sg, display)
-        display = display[page]
+        display = self.root_display(sg)
         nodes = np.unique(display).astype(np.int32)
         node = LevelNode(sg=sg, nodes=nodes, display=display.astype(np.int32))
         if sg.var_name:
@@ -490,6 +490,20 @@ class Executor:
             return node
         self._descend(node)
         return node
+
+    def root_display(self, sg: SubGraph) -> np.ndarray:
+        """Root evaluation through ordering + pagination → the block's
+        ordered display list (run_block's root half; also the seed set
+        the lane-batch planner packs into kernel lanes)."""
+        ranks = self.root_ranks(sg)
+        ranks = self.apply_filter(sg.filters, ranks)
+        display = self._mesh_order_topk(sg, ranks)
+        if display is None:
+            order_idx = (self.order_ranks(ranks, sg.orders)
+                         if sg.orders else np.arange(len(ranks)))
+            display = ranks[order_idx]
+        page = self.paginate(len(display), sg, display)
+        return display[page].astype(np.int32)
 
     def _descend(self, parent: LevelNode) -> None:
         from dgraph_tpu.engine.recurse import expand_recurse
@@ -505,16 +519,31 @@ class Executor:
 
     def run_child(self, sg: SubGraph, frontier: np.ndarray) -> LevelNode:
         """Expand one uid-predicate child level below `frontier`."""
+        nbrs, seg, pos, processed = self._level_edges(sg, frontier)
+        return self._finish_child(sg, nbrs, seg, pos, processed)
+
+    def _level_edges(self, sg: SubGraph, frontier: np.ndarray):
+        """One child level's filtered edge list → (nbrs, seg, pos,
+        processed). `processed` means ordering/pagination were already
+        applied (the fused device path, which is only eligible when no
+        ordering exists). The lane-batch executor overrides this with
+        mask-constrained CSR intersection (engine/treebatch.py)."""
         fused = self._fused_level(sg, frontier)
         if fused is not None:
-            nbrs, seg, pos = fused
-        else:
-            nbrs, seg, pos = self.expand(
-                sg.attr, sg.is_reverse, frontier,
-                allow_remote=not _needs_facets(sg))
-            nbrs, seg, pos = self.filter_edges(sg.filters, nbrs, seg, pos)
-            nbrs, seg, pos = self.facet_filter_edges(sg, sg.attr, nbrs,
-                                                     seg, pos)
+            return (*fused, True)
+        nbrs, seg, pos = self.expand(
+            sg.attr, sg.is_reverse, frontier,
+            allow_remote=not _needs_facets(sg))
+        nbrs, seg, pos = self.filter_edges(sg.filters, nbrs, seg, pos)
+        nbrs, seg, pos = self.facet_filter_edges(sg, sg.attr, nbrs,
+                                                 seg, pos)
+        return nbrs, seg, pos, False
+
+    def _finish_child(self, sg: SubGraph, nbrs, seg, pos,
+                      processed: bool) -> LevelNode:
+        """Ordering, per-row pagination, node building, var binding and
+        descent below one expanded level (run_child's second half)."""
+        if not processed:
             # row-internal ordering (default: uid order from the CSR)
             if sg.orders or sg.facet_orders:
                 if sg.facet_orders:

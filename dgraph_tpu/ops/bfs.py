@@ -36,7 +36,7 @@ from jax import lax
 
 __all__ = ["ranks_to_bitmap", "bitmap_to_ranks", "bitmap_hop",
            "bitmap_recurse", "EllGraph", "build_ell", "ell_recurse",
-           "pack_seed_masks", "unpack_masks"]
+           "make_ell_tree", "pack_seed_masks", "unpack_masks"]
 
 
 def ranks_to_bitmap(rank_lists, n_nodes: int) -> jnp.ndarray:
@@ -315,6 +315,80 @@ def make_ell_recurse(ells, outdeg, n: int, W: int, count_edges: bool = True):
         return last, seen, edges
 
     return recurse
+
+
+def make_ell_tree(stages, n: int, W: int):
+    """Compile a level-TREE pipeline over lane-packed masks: the batched
+    form of a whole nested query (engine/treebatch.py), one fused XLA
+    program for B = 32·W concurrent queries.
+
+    Reference parity: query/query.go ProcessGraph descends a SubGraph
+    tree level by level, one task per child per goroutine; here every
+    level of every lane is one stage of this program, and filters are
+    bitmask ANDs instead of per-uid IntersectSorted calls.
+
+    All masks live in the STORE's global rank space, shape [n+1, W]
+    uint32 (row n = sentinel, always zero). Each stage's EllGraph has its
+    own degree-bucket permutation, so a stage translates its parent mask
+    into its own permuted space (one row gather), does the ELL pull-hop,
+    and translates back (one row gather) — both translations stream
+    sequentially and are noise next to the edge gather.
+
+    `stages` is a list of dicts (static structure, device arrays):
+      kind      "hop" | "recurse"
+      prepared  _prepare_buckets output for the stage's EllGraph
+      perm_in   [n+1] int32 device: permuted row r ← global perm_in[r]
+      out_idx   [n+1] int32 device: global row v ← permuted out_idx[v]
+      parent    ("seed", slot) | ("stage", idx earlier in the list)
+      filt      filter-mask slot index | None  (global space, ANDed in)
+      depth     recurse only: hop count (static)
+      keep_hops recurse only: also return per-hop first-visit masks
+
+    Returns fn(seeds: tuple, filts: tuple) → tuple with one entry per
+    stage: hop → mask [n+1, W]; recurse → seen [n+1, W] (reachable set
+    incl. seeds) or (seen, hops [depth, n+1, W]) when keep_hops.
+    """
+
+    @jax.jit
+    def run(seeds, filts):
+        outs = []
+        results = []
+        for s in stages:
+            kind, par = s["kind"], s["parent"]
+            parent = (seeds[par[1]] if par[0] == "seed"
+                      else outs[par[1]])
+            filt = filts[s["filt"]] if s["filt"] is not None else None
+            pm = parent[s["perm_in"]]            # global → permuted
+            if kind == "hop":
+                out = _ell_hop(s["prepared"], pm, W)[s["out_idx"]]
+                if filt is not None:
+                    out = out & filt
+                outs.append(out)
+                results.append(out)
+                continue
+            # recurse: iterate in permuted space (no per-hop translation)
+            filt_p = filt[s["perm_in"]] if filt is not None else None
+
+            def hop(carry, _, _prep=s["prepared"], _filt_p=filt_p):
+                frontier, seen = carry
+                nxt = _ell_hop(_prep, frontier, W)
+                fresh = nxt & ~seen
+                if _filt_p is not None:
+                    fresh = fresh & _filt_p
+                seen = seen | fresh
+                return (fresh, seen), (fresh if s["keep_hops"] else None)
+
+            (_last, seen_p), hops_p = lax.scan(
+                hop, (pm, pm), None, length=s["depth"])
+            seen = seen_p[s["out_idx"]]
+            outs.append(seen)
+            if s["keep_hops"]:
+                results.append((seen, hops_p[:, s["out_idx"]]))
+            else:
+                results.append(seen)
+        return tuple(results)
+
+    return run
 
 
 def ell_recurse(g: EllGraph, mask0, depth: int, count_edges: bool = True):

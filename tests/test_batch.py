@@ -73,10 +73,15 @@ def test_plan_rejects_incompatible(alpha):
     mixed = base[:5] + ['{ q(func: eq(name, "p1")) @recurse(depth: 9) '
                         '{ name follows } }']
     assert plan_batch(store, [parse(q) for q in mixed]) is None
-    # filters on the edge
+    # filters on the edge: no longer a rejection — they take the
+    # level-tree kernel (engine/treebatch.py) and must match the engine
     filt = ['{ q(func: eq(name, "p1")) @recurse(depth: 3) '
             '{ name follows @filter(ge(score, 5)) } }'] * 6
-    assert plan_batch(store, [parse(q) for q in filt]) is None
+    from dgraph_tpu.engine.treebatch import TreePlan
+    fplan = plan_batch(store, [parse(q) for q in filt])
+    assert isinstance(fplan, TreePlan)
+    eng = Engine(store, device_threshold=10**9)
+    assert run_batch(store, fplan, 10**9) == [eng.query(q) for q in filt]
     # below MIN_BATCH
     assert plan_batch(store, [parse(q) for q in base[:2]]) is None
     # client-controlled depth beyond the kernel cap falls back to the
