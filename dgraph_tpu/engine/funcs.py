@@ -164,13 +164,19 @@ def _geo_func(store: Store, f: FuncNode, name: str) -> np.ndarray:
 
 def _ring_probes(ring):
     """Vertices plus edge midpoints of a polygon ring — the containment
-    probe set within() tests against the query area."""
+    probe set within() tests against the query area. Midpoints follow
+    each edge's SHORTER longitudinal arc (store.geo per-edge rule), so
+    an antimeridian-crossing edge probes near ±180, not near 0."""
+    from dgraph_tpu.store.geo import unwrap_lons
+
+    xs = unwrap_lons([x for x, _ in ring])
     n = len(ring)
     for i in range(n):
-        x1, y1 = ring[i]
-        yield x1, y1
-        x2, y2 = ring[(i + 1) % n]
-        yield (x1 + x2) / 2.0, (y1 + y2) / 2.0
+        x1, y1 = xs[i], ring[i][1]
+        yield ring[i][0], y1
+        x2, y2 = xs[(i + 1) % n], ring[(i + 1) % n][1]
+        mx = (x1 + x2) / 2.0
+        yield ((mx + 180.0) % 360.0) - 180.0, (y1 + y2) / 2.0
 
 
 def _schema_kind(store: Store, attr: str) -> Kind:

@@ -80,17 +80,29 @@ def parse_geo(value) -> GeoVal:
         obj = value
     else:
         raise GeoError(f"cannot convert {type(value).__name__} to geo")
+    def _finite(x) -> bool:
+        return isinstance(x, (int, float)) and math.isfinite(x)
+
     t = obj.get("type")
     if t == "Point":
         c = obj.get("coordinates")
         if (not isinstance(c, (list, tuple)) or len(c) < 2
-                or not all(isinstance(x, (int, float)) for x in c[:2])):
-            raise GeoError("Point needs [lon, lat] coordinates")
+                or not all(_finite(x) for x in c[:2])):
+            raise GeoError("Point needs finite [lon, lat] coordinates")
     elif t == "Polygon":
         rings = obj.get("coordinates")
         if not isinstance(rings, (list, tuple)) or not rings or any(
                 len(r) < 4 for r in rings):
             raise GeoError("Polygon needs rings of >= 4 positions")
+        # json.loads admits Infinity/NaN literals (and 1e400 → inf);
+        # a non-finite longitude would spin unwrap_lons forever, so
+        # coordinates are validated finite at the boundary
+        for r in rings:
+            for p in r:
+                if (not isinstance(p, (list, tuple)) or len(p) < 2
+                        or not all(_finite(x) for x in p[:2])):
+                    raise GeoError(
+                        "Polygon positions need finite [lon, lat]")
     else:
         raise GeoError(f"unsupported GeoJSON type {t!r}")
     return GeoVal(json.dumps(obj, separators=(",", ":"), sort_keys=True))
@@ -196,16 +208,48 @@ def tokens_for_geo(g: GeoVal) -> list[str]:
     return []
 
 
+def unwrap_lons(xs: list[float]) -> list[float]:
+    """Consecutive ring longitudes made CONTINUOUS: every edge follows
+    its shorter longitudinal arc (≤180°), so an antimeridian-crossing
+    ring extends past ±180 instead of jumping across the axis. Identity
+    for rings whose edges all stay under 180° of longitude."""
+    if not xs:
+        return []
+    out = [xs[0]]
+    for x in xs[1:]:
+        px = out[-1]
+        while x - px > 180.0:
+            x -= 360.0
+        while x - px < -180.0:
+            x += 360.0
+        out.append(x)
+    return out
+
+
+def ring_crosses(ring) -> bool:
+    """Whether any edge's shorter arc wraps ±180 — the PER-EDGE crossing
+    rule shared by indexing (lon_spans) and the exact verifiers
+    (point_in_polygon, dist_to_polygon_m), so they can never disagree."""
+    return any(abs(x2 - x1) > 180.0
+               for (x1, _y1), (x2, _y2) in zip(ring, ring[1:]))
+
+
 def lon_spans(xs: list[float]) -> list[tuple[float, float]]:
-    """Longitude interval(s) of a ring: one (min, max) span normally;
-    split at ±180 when the naive span exceeds 180° (antimeridian
-    crossing — the ring's lons live at both ends of the axis)."""
-    lo, hi = min(xs), max(xs)
-    if hi - lo <= 180.0:
+    """Longitude interval(s) of a ring, deciding antimeridian crossing
+    PER EDGE (shorter arc): consecutive lons are unwrapped so each step
+    takes the arc under 180°. A planar ring that merely spans a wide
+    bbox (no single wrapping edge, e.g. lons -100, 0, 100) keeps its
+    full (min, max) span; a crossing ring splits into covers at ±180 so
+    lookups from either side find it."""
+    ux = unwrap_lons(xs)
+    lo, hi = min(ux), max(ux)
+    if hi - lo >= 360.0:       # wraps the whole axis
+        return [(-180.0, 180.0)]
+    if lo >= -180.0 and hi <= 180.0:
         return [(lo, hi)]
-    east = [x for x in xs if x >= 0.0]
-    west = [x for x in xs if x < 0.0]
-    return [(min(east), 180.0), (-180.0, max(west))]
+    if hi > 180.0:
+        return [(lo, 180.0), (-180.0, hi - 360.0)]
+    return [(lo + 360.0, 180.0), (-180.0, hi)]
 
 
 def _bbox_cells(min_lon, min_lat, max_lon, max_lat, precision,
@@ -270,17 +314,27 @@ def dist_to_polygon_m(lon: float, lat: float,
     kx = M_PER_DEG_LAT * max(math.cos(math.radians(lat)), 0.05)
     ky = M_PER_DEG_LAT
     best = math.inf
-    # ALL rings: a point inside a hole is closest to the hole's edge
+    # ALL rings: a point inside a hole is closest to the hole's edge.
+    # Rings measure in unwrapped longitudes with the query point tried
+    # at ALL ±360 shifts — the nearest representation wins whether the
+    # RING crosses or the QUERY POINT sits across ±180 from a
+    # non-crossing ring (near() wraps its candidate cover, so both
+    # shapes reach this verifier).
     for ring in rings:
-        for (x1, y1), (x2, y2) in zip(ring, ring[1:]):
-            ax, ay = (x1 - lon) * kx, (y1 - lat) * ky
-            bx, by = (x2 - lon) * kx, (y2 - lat) * ky
-            dx, dy = bx - ax, by - ay
-            L2 = dx * dx + dy * dy
-            t = 0.0 if L2 == 0 else max(
-                0.0, min(1.0, -(ax * dx + ay * dy) / L2))
-            px, py = ax + t * dx, ay + t * dy
-            best = min(best, math.hypot(px, py))
+        xs = unwrap_lons([x for x, _ in ring])
+        ys = [y for _, y in ring]
+        for k in (-360.0, 0.0, 360.0):
+            L = lon + k
+            for i in range(len(ring) - 1):
+                x1, y1, x2, y2 = xs[i], ys[i], xs[i + 1], ys[i + 1]
+                ax, ay = (x1 - L) * kx, (y1 - lat) * ky
+                bx, by = (x2 - L) * kx, (y2 - lat) * ky
+                dx, dy = bx - ax, by - ay
+                L2 = dx * dx + dy * dy
+                t = 0.0 if L2 == 0 else max(
+                    0.0, min(1.0, -(ax * dx + ay * dy) / L2))
+                px, py = ax + t * dx, ay + t * dy
+                best = min(best, math.hypot(px, py))
     return best
 
 
@@ -310,18 +364,31 @@ def cover_bbox(min_lon, min_lat, max_lon, max_lat):
 
 def point_in_polygon(lon: float, lat: float,
                      rings: list[list[tuple[float, float]]]) -> bool:
-    """Ray casting; ring 0 is the outer boundary, the rest are holes."""
+    """Ray casting; ring 0 is the outer boundary, the rest are holes.
+    Edges follow their SHORTER longitudinal arc (the same per-edge
+    antimeridian rule lon_spans indexes by): rings are unwrapped to
+    continuous longitudes and the point is tested at lon and lon±360,
+    so crossing polygons verify exactly where their index tokens say."""
     def in_ring(ring):
-        inside = False
-        j = len(ring) - 1
-        for i in range(len(ring)):
-            xi, yi = ring[i]
-            xj, yj = ring[j]
-            if ((yi > lat) != (yj > lat)) and \
-                    lon < (xj - xi) * (lat - yi) / (yj - yi) + xi:
-                inside = not inside
-            j = i
-        return inside
+        xs = unwrap_lons([x for x, _ in ring])
+        lo, hi = min(xs), max(xs)
+        ys = [y for _, y in ring]
+        for k in (-360.0, 0.0, 360.0):
+            L = lon + k
+            if not lo <= L <= hi:
+                continue
+            inside = False
+            j = len(ring) - 1
+            for i in range(len(ring)):
+                xi, yi = xs[i], ys[i]
+                xj, yj = xs[j], ys[j]
+                if ((yi > lat) != (yj > lat)) and \
+                        L < (xj - xi) * (lat - yi) / (yj - yi) + xi:
+                    inside = not inside
+                j = i
+            if inside:
+                return True
+        return False
 
     if not rings or not in_ring(rings[0]):
         return False

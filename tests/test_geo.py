@@ -262,6 +262,84 @@ def test_antimeridian_bbox_forces_scan_and_split_tokens():
     assert east and west
 
 
+def test_antimeridian_contains_end_to_end():
+    """Index and exact verifier must AGREE on antimeridian semantics
+    (advisor finding): a crossing polygon answers contains() on both
+    sides of ±180, and a planar-wide ring (no wrapping edge) still
+    answers contains() in its interior — through the real query path,
+    not just token inspection."""
+    a = Alpha(device_threshold=10**9)
+    a.alter(SCHEMA)
+    crossing = {"type": "Polygon", "coordinates": [[
+        [179.0, -1.0], [-179.0, -1.0], [-179.0, 1.0],
+        [179.0, 1.0], [179.0, -1.0]]]}
+    # planar-wide: spans 200 deg of longitude but every edge stays
+    # under 180 deg, so per-edge semantics keep it on the 0 side
+    planar = {"type": "Polygon", "coordinates": [[
+        [-100.0, -5.0], [0.0, -5.0], [100.0, -5.0], [100.0, 5.0],
+        [0.0, 5.0], [-100.0, 5.0], [-100.0, -5.0]]]}
+    a.mutate(set_nquads=(
+        f'_:c <name> "crossing" .\n'
+        f"_:c <loc> {json.dumps(json.dumps(crossing))} .\n"
+        f'_:p <name> "planar" .\n'
+        f"_:p <loc> {json.dumps(json.dumps(planar))} .\n"))
+
+    def contains(lon, lat):
+        out = a.query('{ q(func: contains(loc, [%s, %s]), '
+                      'orderasc: name) { name } }' % (lon, lat))
+        return [r["name"] for r in out["q"]]
+
+    # both sides of the line hit the crossing polygon end-to-end
+    assert contains(179.5, 0.0) == ["crossing"]
+    assert contains(-179.5, 0.0) == ["crossing"]
+    # interior of the planar-wide ring (the pre-fix regression: its
+    # index tokens covered only the ±180 slivers, so this missed)
+    assert contains(0.0, 0.0) == ["planar"]
+    assert contains(-99.0, 0.0) == ["planar"]
+    # the crossing polygon does NOT contain the 0 side and vice versa
+    assert contains(0.5, 0.5) == ["planar"]
+    assert contains(179.5, 0.4) == ["crossing"]
+    # exact verifier agrees with the index decisions directly
+    assert G.point_in_polygon(180.0, 0.0, crossing["coordinates"])
+    assert not G.point_in_polygon(0.0, 0.0, crossing["coordinates"])
+    assert G.point_in_polygon(0.0, 0.0, planar["coordinates"])
+    assert not G.point_in_polygon(180.0, 0.0, planar["coordinates"])
+    # dist_to_polygon_m measures to the crossing polygon across ±180
+    d = G.dist_to_polygon_m(-178.0, 0.0, crossing["coordinates"])
+    assert 0 < d < 130_000          # ~1 deg of longitude at the equator
+    # the per-edge crossing rule itself
+    assert G.ring_crosses(crossing["coordinates"][0])
+    assert not G.ring_crosses(planar["coordinates"][0])
+
+
+def test_near_across_antimeridian_to_noncrossing_polygon():
+    """near() from the far side of ±180 to a polygon that does NOT cross
+    (code-review finding): the distance must wrap, not span the globe."""
+    ring = [[175.0, -1.0], [180.0, -1.0], [180.0, 1.0], [175.0, 1.0],
+            [175.0, -1.0]]
+    d = G.dist_to_polygon_m(-179.5, 0.0, [ring])
+    assert 0 < d < 100_000          # ~0.5 deg at the equator, not ~39Mm
+    a = Alpha(device_threshold=10**9)
+    a.alter(SCHEMA)
+    poly = {"type": "Polygon", "coordinates": [ring]}
+    a.mutate(set_nquads=(f'_:e <name> "edge" .\n'
+                         f"_:e <loc> {json.dumps(json.dumps(poly))} .\n"))
+    out = a.query('{ q(func: near(loc, [-179.5, 0.0], 100000)) '
+                  '{ name } }')
+    assert [r["name"] for r in out["q"]] == ["edge"]
+
+
+def test_non_finite_coordinates_rejected():
+    """json admits Infinity/1e400 → inf; such coordinates must be
+    rejected at parse (code-review finding: unwrap_lons would spin)."""
+    for bad in ('{"type": "Point", "coordinates": [1e400, 0.0]}',
+                '{"type": "Point", "coordinates": [NaN, 0.0]}',
+                '{"type": "Polygon", "coordinates": '
+                '[[[1e400, 0.0], [1.0, 0.0], [1.0, 1.0], [1e400, 0.0]]]}'):
+        with pytest.raises(G.GeoError):
+            G.parse_geo(bad)
+
+
 def test_within_concave_polygon_rejects_bulging_edge():
     """A stored polygon whose VERTICES all sit inside a concave (U-shaped)
     query area but whose edge crosses the notch must NOT match within()
