@@ -1,20 +1,28 @@
 
 def start_cluster_alpha(zero_target: str, base=None, group: int = 0,
-                        device_threshold: int = 512, addr: str = "127.0.0.1:0"):
+                        device_threshold: int = 512,
+                        addr: str = "127.0.0.1:0", wal_dir: str | None = None):
     """Boot one cluster-mode Alpha: grpc server + Zero connect + Groups.
 
     Returns (alpha, grpc_server, bound_addr). Reference: alpha run() —
     serve pb.Worker, Connect to Zero for node id + group assignment, then
-    keep membership fresh (SURVEY §3.4).
-    """
+    keep membership fresh (SURVEY §3.4). `wal_dir` arms the fsync'd WAL —
+    required for commit-quorum staging to be durable (reference: the
+    raft WAL under every Alpha)."""
     from dgraph_tpu.cluster.groups import Groups
     from dgraph_tpu.cluster.zero import RemoteOracle, ZeroClient
     from dgraph_tpu.server.api import Alpha
     from dgraph_tpu.server.task import make_server
 
+    wal = None
+    if wal_dir is not None:
+        import os
+
+        from dgraph_tpu.store.wal import WAL
+        wal = WAL(os.path.join(wal_dir, "wal.log"))
     zero = ZeroClient(zero_target)
     alpha = Alpha(base=base, device_threshold=device_threshold,
-                  oracle=RemoteOracle(zero))
+                  oracle=RemoteOracle(zero), wal=wal)
     server, port = make_server(alpha, addr)
     server.start()
     bound = f"127.0.0.1:{port}"

@@ -1,0 +1,100 @@
+"""Message-level fault injection for cluster tests.
+
+Reference parity: the reference has no in-repo fault-injection framework
+(Jepsen is external — SURVEY §5); deterministic partition tests need one
+here. `FaultyGroups` wraps a node's `Groups` so individual DIRECTED links
+(this node → peer) can be dropped or delayed — asymmetric partitions
+(A hears B while B cannot reach A) become one-line test setup, which
+server stops can never simulate.
+
+Injection point: `pool(addr)` — every outbound RPC of the wrapped node
+goes through it (broadcasts, decisions, FetchLog catch-up, ServeTask
+routing, read failover), so a blocked link fails exactly like an
+unreachable peer (grpc UNAVAILABLE), and a delayed link stalls like a
+congested one."""
+
+from __future__ import annotations
+
+import time
+
+import grpc
+
+
+class LinkDown(grpc.RpcError):
+    """UNAVAILABLE-shaped error for a dropped directed link."""
+
+    def __init__(self, src: str, dst: str):
+        super().__init__(f"link {src} -> {dst} is partitioned (injected)")
+        self._msg = f"link {src} -> {dst} is partitioned (injected)"
+
+    def code(self):
+        return grpc.StatusCode.UNAVAILABLE
+
+    def details(self):
+        return self._msg
+
+
+class _FaultyClient:
+    """Per-call guard in front of a pooled worker client."""
+
+    def __init__(self, inner, groups: "FaultyGroups", addr: str):
+        self._inner = inner
+        self._groups = groups
+        self._addr = addr
+
+    def __getattr__(self, name):
+        attr = getattr(self._inner, name)
+        if not callable(attr):
+            return attr
+
+        def guarded(*a, **kw):
+            self._groups.check_link(self._addr)
+            return attr(*a, **kw)
+
+        return guarded
+
+
+class FaultyGroups:
+    """Transparent `Groups` wrapper with per-directed-link drop/delay.
+
+    Wraps an EXISTING Groups (attribute delegation keeps membership,
+    node id, tablet routing intact); only `pool()` is intercepted.
+    """
+
+    def __init__(self, inner):
+        self._inner = inner
+        self._dropped: set[str] = set()       # peer addrs this node can't reach
+        self._delay_s: dict[str, float] = {}  # peer addr → injected latency
+
+    # -- fault control -------------------------------------------------------
+    def drop_link(self, addr: str) -> None:
+        """Partition the DIRECTED link this-node → addr."""
+        self._dropped.add(addr)
+
+    def heal_link(self, addr: str) -> None:
+        self._dropped.discard(addr)
+        # the real pool may hold a channel poisoned by earlier failures
+        self._inner.invalidate(addr)
+
+    def heal_all(self) -> None:
+        for a in list(self._dropped):
+            self.heal_link(a)
+        self._delay_s.clear()
+
+    def delay_link(self, addr: str, seconds: float) -> None:
+        self._delay_s[addr] = seconds
+
+    def check_link(self, addr: str) -> None:
+        if addr in self._dropped:
+            raise LinkDown(self._inner.my_addr, addr)
+        d = self._delay_s.get(addr)
+        if d:
+            time.sleep(d)
+
+    # -- Groups surface ------------------------------------------------------
+    def pool(self, addr: str):
+        self.check_link(addr)  # fail fast even before the first call
+        return _FaultyClient(self._inner.pool(addr), self, addr)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)

@@ -535,6 +535,15 @@ class ZeroService:
         return pb.Payload(data=b"ok")
 
     def JournalTail(self, req: pb.JournalTailRequest, ctx) -> pb.JournalDocs:
+        if req.peek:
+            # election probe: report applied seq WITHOUT the replication
+            # ACK side effect (journal_tail treats `since` as an ack and
+            # would pin the lease floor / freshen standby liveness)
+            with self.state._lock:
+                nxt = self.state._doc_base + len(self.state.doc_log)
+            return pb.JournalDocs(docs_json=[], next=nxt,
+                                  standby=self.state.standby,
+                                  log_id=self.state.log_id)
         docs, nxt = self.state.journal_tail(int(req.since))
         return pb.JournalDocs(docs_json=docs, next=nxt,
                               standby=self.state.standby,
@@ -642,12 +651,41 @@ def rebalance_once(state: ZeroState) -> bool:
     return move_tablet(state, pred, dst)
 
 
+def elect_better(state: ZeroState, my_addr: str, peers) -> str | None:
+    """Highest-acked-index election among standbys (reference: raft's
+    up-to-date-log vote rule, collapsed to a deterministic comparison):
+    returns the address of a peer strictly ahead of this standby under
+    (applied journal seq, addr) ordering — that peer should promote
+    instead — or None when THIS standby wins. A reachable peer that
+    already promoted wins outright. Unreachable peers don't vote: the
+    election trades a vote quorum for reachability (a standby cut off
+    from every other standby still promotes; log-identity divergence
+    stays operator-visible via log_id)."""
+    my_seq = state._doc_base + len(state.doc_log)
+    best = None
+    for addr in peers:
+        try:
+            docs_, nxt, standby, _lid = ZeroClient(addr).journal_tail_full(
+                0, peek=True)
+        except grpc.RpcError:
+            continue
+        if not standby:
+            return addr               # someone already took over
+        if (nxt, addr) > (my_seq, my_addr) and \
+                (best is None or (nxt, addr) > best):
+            best = (nxt, addr)
+    return best[1] if best else None
+
+
 def run_standby(state: ZeroState, primary_addr: str, poll_s: float = 1.0,
-                promote_after_s: float = 5.0, stop_event=None) -> bool:
+                promote_after_s: float = 5.0, stop_event=None,
+                peers=(), my_addr: str = "") -> bool:
     """Standby loop: tail the primary's state-machine journal into
     `state`; when the primary stays unreachable past `promote_after_s`,
-    promote and take over (reference: group-0 raft follower election,
-    collapsed to a single designated successor). Returns True when
+    run the highest-acked-index election over `peers` (other standby
+    addresses) — the most caught-up standby promotes, the rest re-target
+    it (reference: group-0 raft follower election; with no peers this
+    collapses to the designated-successor behavior). Returns True when
     promoted, False when stopped externally.
 
     A restarted standby resumes from its own replayed log length; a
@@ -676,8 +714,17 @@ def run_standby(state: ZeroState, primary_addr: str, poll_s: float = 1.0,
             last_ok = _time.monotonic()
         except grpc.RpcError:
             if _time.monotonic() - last_ok > promote_after_s:
-                state.promote()
-                return True
+                winner = elect_better(state, my_addr, peers)
+                if winner is None:
+                    state.promote()
+                    return True
+                # a more caught-up standby exists: it promotes, this one
+                # keeps tailing FROM it (same journal lineage, log_id
+                # unchanged through promotion)
+                primary_addr = winner
+                client = ZeroClient(winner)
+                since = state._doc_base + len(state.doc_log)
+                last_ok = _time.monotonic()
         except Exception:  # noqa: BLE001 — a malformed doc must not kill
             # the standby thread silently (failover would be lost with no
             # log line); resync the replica from zero and keep tailing.
@@ -836,9 +883,10 @@ class ZeroClient:
         docs, nxt, standby, _ = self.journal_tail_full(since)
         return docs, nxt, standby
 
-    def journal_tail_full(self, since: int) \
+    def journal_tail_full(self, since: int, peek: bool = False) \
             -> tuple[list[str], int, bool, str]:
-        r = self._call("JournalTail", pb.JournalTailRequest(since=since),
+        r = self._call("JournalTail",
+                       pb.JournalTailRequest(since=since, peek=peek),
                        pb.JournalDocs)
         return (list(r.docs_json), int(r.next), bool(r.standby),
                 str(r.log_id))

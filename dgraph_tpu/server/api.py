@@ -30,7 +30,14 @@ from dgraph_tpu.store.schema import parse_schema
 from dgraph_tpu.store.store import Store
 from dgraph_tpu.store.types import Kind, hash_password
 
-__all__ = ["Alpha", "Txn", "TxnAborted"]
+__all__ = ["Alpha", "Txn", "TxnAborted", "NoQuorum"]
+
+
+class NoQuorum(Exception):
+    """Commit refused: a majority of the replica group did not durably
+    log the record (reference: a raft proposal that cannot commit on the
+    minority side of a partition). The write was NOT applied locally and
+    the client must not treat it as acknowledged."""
 
 GC_EVERY = 256  # timestamps between oracle/store gc sweeps
 
@@ -62,6 +69,9 @@ class Alpha:
         self._last_from: dict[int, int] = {}
         self._last_sent_ts = 0
         self._suspect_peers: dict[str, int] = {}
+        # commit-quorum staging: ts → (Mutation, origin node id) durably
+        # logged but undecided (raft "log entry below commit index")
+        self._pending: dict[int, tuple[Mutation, int]] = {}
         # oldest ts the local WAL still covers (records at or below were
         # absorbed by a checkpoint); FetchLog answers "complete" only above
         self._wal_floor = base_ts
@@ -94,7 +104,22 @@ class Alpha:
         alpha = cls(base=base, device_threshold=device_threshold,
                     base_ts=base_ts, mesh=mesh)
         max_ts, max_uid = base_ts, 0
+        # ONE decode pass: resolve pend/dec staging inline (pend applies
+        # at its dec:1 position — the commit-index analog) and remember
+        # unresolved pends for re-arming below
+        pends: dict[int, Mutation] = {}
+        resolved = []
         for ts, kind, obj in replay(wal_path):
+            if kind == "pend":
+                pends[ts] = obj
+                continue
+            if kind == "dec":
+                mut = pends.pop(ts, None)
+                if obj and mut is not None:
+                    resolved.append((ts, "mut", mut))
+                continue
+            resolved.append((ts, kind, obj))
+        for ts, kind, obj in resolved:
             if ts <= base_ts:
                 continue  # checkpoint already absorbed it
             if kind == "schema":
@@ -124,6 +149,12 @@ class Alpha:
         # would miss the gap check); a too-HIGH prev only triggers a
         # harmless spurious catch-up on peers
         alpha._last_sent_ts = max_ts
+        # re-arm undecided staged records (still durable, still
+        # invisible): a peer's decision marker or catch-up resolves them
+        # post-restart; origin 0 = unknown after restart
+        for ts, mut in pends.items():
+            if not alpha.mvcc.has_applied(ts):
+                alpha._pending[ts] = (mut, 0)
         alpha.wal = WAL(wal_path, sync=sync)
         return alpha
 
@@ -556,6 +587,16 @@ class Alpha:
     # -- commit path (worker/draft.go applyMutations analog) ----------------
     def _commit(self, txn: "Txn") -> int:
         with self._apply_lock:
+            if self.groups is not None:
+                # pre-flight BEFORE the oracle assigns a commit_ts: a
+                # minority-side coordinator refuses up front instead of
+                # burning a timestamp + conflict window on a commit the
+                # group cannot accept. (A link that dies between this
+                # probe and the stage still burns the ts — readers never
+                # see it, but its conflict keys can spuriously abort
+                # concurrent txns until retention expires; the window is
+                # one RPC round.)
+                self._preflight_quorum()
             commit_ts = self.oracle.commit(
                 txn.start_ts, txn.mutation.conflict_keys(self.mvcc.schema))
             if self.groups is not None:
@@ -571,34 +612,135 @@ class Alpha:
 
     # -- cluster write/read plumbing (worker/draft.go + task.go analogs) -----
     def _apply_and_broadcast(self, mut: Mutation, commit_ts: int) -> None:
-        """Synchronous log shipping: apply the owned subset locally, then
-        send the full mutation to every other node — each applies its own
-        group's tablets plus the vocab touches, so replicas of a group
-        converge and the dense rank space stays cluster-wide identical
-        (reference: MutateOverNetwork fan-out + raft replication within
-        each group, collapsed into one broadcast).
+        """Replicated commit with MAJORITY acknowledgment (reference:
+        worker/draft.go proposeAndWait over etcd raft, collapsed to a
+        two-phase chained broadcast):
 
-        Each broadcast chains to the sender's previous one (origin +
+        Phase 1 — STAGE: the record is durably logged as pending on this
+        node and shipped with `stage=true` to every replica of this
+        group; each replica durably logs it (no apply) and acks. Phase 2
+        — DECIDE: when ≥ majority of the group (counting this node)
+        logged it, the decision marker is written, the record applies
+        locally, replicas get DecisionMsg (best-effort: a replica that
+        misses it resolves through FetchLog, whose resolved stream serves
+        the decision durably), and non-group nodes get the normal full
+        broadcast. Under majority loss the decision is ABORT: nothing was
+        applied anywhere, the client gets NoQuorum, and the staged pend
+        resolves to an abort marker — the minority side of a partition
+        refuses writes instead of diverging.
+
+        Each message chains to the sender's previous one (origin +
         prev_ts): a receiver that missed a record detects the gap on the
         next chained message and pulls the tail via FetchLog BEFORE
         applying/acking. A peer that misses a broadcast is marked suspect
         (skipped by read failover); a later successful chained broadcast
-        clears it, because the ack implies the peer converged first."""
+        clears it, because the ack implies the peer converged first.
+        Single-replica groups skip staging (majority of one is self)."""
         from dgraph_tpu.store.wal import mut_to_bytes
-        self.apply_committed(mut, commit_ts)
+        gid = self.groups.gid
+        replicas = [a for a in self.groups.group_addrs(gid)
+                    if a != self.groups.my_addr]
+        if replicas:
+            majority = (len(replicas) + 1) // 2 + 1
+            if self.wal is not None:
+                self.wal.append_pend(mut, commit_ts)
+            with self._state_lock:
+                self._pending[commit_ts] = (mut, self.groups.node_id)
+            blob = mut_to_bytes(mut)
+            acks = 1 + self._broadcast_chained(
+                commit_ts,
+                lambda c, origin, prev: c.apply_mutation(
+                    blob, commit_ts, origin=origin, prev_ts=prev,
+                    stage=True),
+                addrs=replicas)
+            if acks < majority:
+                if self.wal is not None:
+                    self.wal.append_decision(commit_ts, False)
+                with self._state_lock:
+                    self._pending.pop(commit_ts, None)
+                self._send_decisions(replicas, commit_ts, False)
+                raise NoQuorum(
+                    f"commit {commit_ts}: {acks}/{len(replicas) + 1} "
+                    f"replicas durably logged it; majority "
+                    f"{majority} required")
+            if self.wal is not None:
+                self.wal.append_decision(commit_ts, True)
+            with self._state_lock:
+                self._pending.pop(commit_ts, None)
+            self.apply_committed(mut, commit_ts, log_wal=False)
+            self._send_decisions(replicas, commit_ts, True)
+        else:
+            self.apply_committed(mut, commit_ts)
+        others = [a for a in self.groups.other_addrs()
+                  if a not in replicas]
+        # the chain advances exactly once per ts: on the stage leg when
+        # replicas exist, else on this cross-group leg (a single-replica
+        # group that never advanced would pin prev_ts and kill gap
+        # detection on every peer)
         self._broadcast_chained(
             commit_ts, lambda c, origin, prev: c.apply_mutation(
-                mut_to_bytes(mut), commit_ts, origin=origin, prev_ts=prev))
+                mut_to_bytes(mut), commit_ts, origin=origin,
+                prev_ts=prev),
+            addrs=others, advance=not replicas)
 
-    def _broadcast_chained(self, ts: int, send) -> None:
-        """Send one chained record to every peer; track suspects. Callers
-        hold _apply_lock, which serializes the prev/_last_sent_ts chain."""
+    def _preflight_quorum(self) -> None:
+        """Cheap reachability probe of the replica group before taking a
+        commit timestamp (raft leaders know liveness from heartbeats;
+        an any-coordinator design must ask)."""
         import grpc as _grpc
-        prev = self._last_sent_ts
-        self._last_sent_ts = ts
-        for addr in self.groups.other_addrs():
+        gid = self.groups.gid
+        replicas = [a for a in self.groups.group_addrs(gid)
+                    if a != self.groups.my_addr]
+        if not replicas:
+            return
+        majority = (len(replicas) + 1) // 2 + 1
+        alive = 1
+        for addr in replicas:
+            if alive >= majority:
+                return
+            try:
+                self.groups.pool(addr).ping()
+                alive += 1
+            except _grpc.RpcError:
+                continue
+        if alive < majority:
+            raise NoQuorum(
+                f"only {alive}/{len(replicas) + 1} group replicas "
+                f"reachable; majority {majority} required")
+
+    def _send_decisions(self, replicas, commit_ts: int,
+                        commit: bool) -> None:
+        """Phase-2 fan-out; failures leave the replica to resolve via
+        FetchLog (its pend is durable, our decision marker is durable)."""
+        import grpc as _grpc
+        for addr in replicas:
+            try:
+                self.groups.pool(addr).apply_decision(
+                    commit_ts, commit, origin=self.groups.node_id)
+            except _grpc.RpcError:
+                with self._state_lock:
+                    self._suspect_peers.setdefault(addr, commit_ts)
+                self.groups.invalidate(addr)
+
+    def _broadcast_chained(self, ts: int, send, addrs=None,
+                           advance: bool = True) -> int:
+        """Send one chained record to `addrs` (default: every peer);
+        track suspects; return the number of successful sends. Callers
+        hold _apply_lock, which serializes the prev/_last_sent_ts chain.
+        `advance=False` reuses the previous chain position — the second
+        leg of a two-leg send for the same ts (stage to the replica
+        group, then the full record to other groups)."""
+        import grpc as _grpc
+        if advance:
+            self._prev_sent_ts = self._last_sent_ts
+            self._last_sent_ts = ts
+        prev = getattr(self, "_prev_sent_ts", 0)
+        ok = 0
+        for addr in (self.groups.other_addrs() if addrs is None
+                     else addrs):
             try:
                 send(self.groups.pool(addr), self.groups.node_id, prev)
+                ok += 1
                 with self._state_lock:
                     self._suspect_peers.pop(addr, None)
             except _grpc.RpcError as e:
@@ -615,6 +757,85 @@ class Alpha:
                     "suspect until it catches up",
                     ts, addr, e.code() if hasattr(e, "code") else e)
                 continue
+        return ok
+
+    def receive_stage(self, mut: Mutation, ts: int, origin: int,
+                      prev_ts: int) -> None:
+        """Commit-quorum phase-1 receive: chain gap-check, then durably
+        log the record as PENDING — no apply. The ack this produces is
+        the durability certificate the coordinator counts toward
+        majority (reference: raft AppendEntries success)."""
+        if origin:
+            last = self._last_from.get(origin, 0)
+            if prev_ts > last:
+                addr = self.groups.addr_of_node(origin)
+                if addr is not None:
+                    self.catch_up(addr, since_ts=last)
+            self._last_from[origin] = max(
+                self._last_from.get(origin, 0), ts)
+            self._resolve_stale_pendings(origin, ts)
+        with self._apply_lock:
+            if self.mvcc.has_applied(ts):
+                return  # already resolved via catch-up
+            if self.wal is not None:
+                self.wal.append_pend(mut, ts)
+            elif not getattr(self, "_warned_volatile_stage", False):
+                # dev/test mode: the ack the coordinator counts toward
+                # its durability majority is memory-only here. Real
+                # deployments (Alpha.open / cli) always arm the WAL.
+                self._warned_volatile_stage = True
+                from dgraph_tpu.utils import logging as xlog
+                xlog.get("alpha").warning(
+                    "commit-quorum stage accepted WITHOUT a WAL: acks "
+                    "from this node are not crash-durable")
+            with self._state_lock:
+                self._pending[ts] = (mut, origin)
+
+    def _resolve_stale_pendings(self, origin: int, before_ts: int) -> None:
+        """A record from `origin` at `before_ts` proves every EARLIER ts
+        it staged here is decided in its durable log (the chain only
+        advances after the decision marker is written) — a lost
+        DecisionMsg is recovered by pulling the origin's resolved log.
+        The chain position alone can't catch this: staging advanced
+        _last_from, so there is no prev_ts gap to detect.
+
+        A stale ts the fetch does NOT resolve is an ORPHAN: the origin
+        crashed between stage and decision and restarted (its own replay
+        discards undecided pends — the client was never acked). It is
+        resolved as ABORT here; should the origin somehow have committed
+        it after all, the committed record is in its resolved log and
+        ordinary gap catch-up re-applies it (apply is idempotent)."""
+        with self._state_lock:
+            stale = [t for t, (_m, org) in self._pending.items()
+                     if org == origin and t < before_ts]
+        if not stale:
+            return
+        addr = self.groups.addr_of_node(origin)
+        if addr is not None:
+            self.catch_up(addr, since_ts=min(stale) - 1)
+        with self._state_lock:
+            orphans = [t for t in stale if t in self._pending]
+            for t in orphans:
+                del self._pending[t]
+        if self.wal is not None:
+            for t in orphans:
+                self.wal.append_decision(t, False)
+
+    def receive_decision(self, ts: int, commit: bool,
+                         origin: int) -> None:
+        """Commit-quorum phase-2 receive: resolve a pending record. A
+        decision for an unknown ts is ignored — catch-up already
+        resolved it (the origin's WAL serves decisions durably)."""
+        with self._apply_lock:
+            with self._state_lock:
+                entry = self._pending.pop(ts, None)
+            if entry is None:
+                return
+            mut, _origin = entry
+            if self.wal is not None:
+                self.wal.append_decision(ts, commit)
+            if commit and not self.mvcc.has_applied(ts):
+                self.apply_committed(mut, ts, log_wal=False)
 
     def receive_broadcast(self, kind: str, obj, ts: int,
                           origin: int, prev_ts: int) -> None:
@@ -632,6 +853,7 @@ class Alpha:
                     self.catch_up(addr, since_ts=last)
             self._last_from[origin] = max(
                 self._last_from.get(origin, 0), ts)
+            self._resolve_stale_pendings(origin, ts)
         if kind == "schema":
             self.apply_schema_broadcast(obj, ts=ts)
         elif kind == "drop":
@@ -666,9 +888,27 @@ class Alpha:
             if kind == "drop_attr":
                 self.apply_drop_attr_broadcast(obj, ts=ts)
                 continue
+            if kind == "abort":
+                # the origin decided ABORT for a staged ts: drop our
+                # pending copy and record the decision durably so OUR
+                # resolved log propagates it too
+                with self._state_lock:
+                    entry = self._pending.pop(ts, None)
+                if entry is not None and self.wal is not None:
+                    self.wal.append_decision(ts, False)
+                continue
             if self.mvcc.has_applied(ts):
                 continue
-            self.apply_committed(obj, ts)
+            with self._state_lock:
+                was_pending = self._pending.pop(ts, None) is not None
+            if was_pending and self.wal is not None:
+                # our pend is durable; the fetched record proves the
+                # origin committed it — resolve with a marker instead of
+                # double-logging the payload
+                self.wal.append_decision(ts, True)
+                self.apply_committed(obj, ts, log_wal=False)
+            else:
+                self.apply_committed(obj, ts)
             applied += 1
         if applied:
             log.info("caught up %d records > ts %d from %s",
@@ -743,18 +983,25 @@ class Alpha:
         since = self.mvcc.base_ts
         for addr in addrs:
             try:
-                self.catch_up(addr, since_ts=since)
-                break
+                # a peer without a covering WAL (complete=False, e.g. no
+                # WAL armed or truncated past `since`) is not a source —
+                # keep trying; any COMPLETE tail ends the search
+                if self.catch_up(addr, since_ts=since):
+                    break
             except Exception:  # noqa: BLE001 — any live peer will do
                 continue
         self.mark_all_stale()
 
-    def apply_committed(self, mut: Mutation, commit_ts: int) -> None:
+    def apply_committed(self, mut: Mutation, commit_ts: int,
+                        log_wal: bool = True) -> None:
         """Install a committed mutation on THIS node: the subset of
         predicates this group serves plus the vocabulary touches. Also the
-        receive path of the broadcast (WorkerService.ApplyMutation)."""
+        receive path of the broadcast (WorkerService.ApplyMutation).
+        `log_wal=False` when the record is already durable as a resolved
+        pend+decision pair (the quorum path) — a second full copy would
+        double it in FetchLog's resolved stream."""
         if self.groups is None:
-            if self.wal is not None:
+            if self.wal is not None and log_wal:
                 self.wal.append(mut, commit_ts)
             self.mvcc.apply(mut, commit_ts)
             return
@@ -771,7 +1018,7 @@ class Alpha:
         # the WAL stores the FULL record (not the owned subset): it doubles
         # as the replication log FetchLog serves to lagging peers, who need
         # every predicate to extract their own subset
-        if self.wal is not None:
+        if self.wal is not None and log_wal:
             self.wal.append(mut, commit_ts)
         try:
             self.mvcc.apply(sub, commit_ts)

@@ -21,7 +21,7 @@ import numpy as np
 
 from dgraph_tpu.engine.execute import Executor
 from dgraph_tpu.protos import task_pb2 as pb
-from dgraph_tpu.server.api import Alpha, TxnAborted
+from dgraph_tpu.server.api import Alpha, NoQuorum, TxnAborted
 
 SERVICE_DGRAPH = "dgraph_tpu.Dgraph"
 SERVICE_WORKER = "dgraph_tpu.Worker"
@@ -71,6 +71,10 @@ class DgraphService:
                 acl_user=acl_user)
         except TxnAborted as e:
             ctx.abort(grpc.StatusCode.ABORTED, str(e))
+        except NoQuorum as e:
+            # UNAVAILABLE, not ABORTED: the txn did not lose a conflict —
+            # the replica group cannot commit right now (minority side)
+            ctx.abort(grpc.StatusCode.UNAVAILABLE, str(e))
         except PermissionError as e:
             ctx.abort(grpc.StatusCode.PERMISSION_DENIED, str(e))
         return pb.MutationResp(
@@ -84,6 +88,8 @@ class DgraphService:
                                              abort=req.aborted)
         except TxnAborted as e:
             ctx.abort(grpc.StatusCode.ABORTED, str(e))
+        except NoQuorum as e:
+            ctx.abort(grpc.StatusCode.UNAVAILABLE, str(e))
         return pb.TxnContext(start_ts=req.start_ts, commit_ts=cts,
                              aborted=req.aborted)
 
@@ -165,12 +171,24 @@ class WorkerService:
             edges_traversed=int(len(nbrs)))
 
     # -- cluster seams (worker/draft.go apply + snapshot shipping) ----------
+    def Ping(self, req: pb.Empty, ctx) -> pb.Payload:
+        """Liveness probe for commit-quorum pre-flight (raft heartbeat
+        analog, pull-shaped)."""
+        return pb.Payload(data=b"ok")
+
     def ApplyMutation(self, req: pb.MutationMsg, ctx) -> pb.Payload:
         """Receive a broadcast (log shipping) — mutation, Alter, or
         DropAll, all riding one chain. Chained origin/prev_ts trigger gap
         catch-up BEFORE applying (the ack then certifies the receiver
         converged through this record's ts)."""
         from dgraph_tpu.store.wal import mut_from_bytes
+        if req.stage:
+            # commit-quorum phase 1: durably log as pending, no apply;
+            # the ack is the durability certificate (raft AppendEntries)
+            self.alpha.receive_stage(
+                mut_from_bytes(req.mut_json), int(req.commit_ts),
+                int(req.origin), int(req.prev_ts))
+            return pb.Payload(data=b"ok")
         if req.drop_all:
             kind, obj = "drop", None
         elif req.drop_attr:
@@ -183,23 +201,35 @@ class WorkerService:
                                      int(req.origin), int(req.prev_ts))
         return pb.Payload(data=b"ok")
 
+    def ApplyDecision(self, req: pb.DecisionMsg, ctx) -> pb.Payload:
+        """Commit-quorum phase 2: resolve a staged ts (apply on commit,
+        drop on abort). Idempotent; unknown ts already resolved by
+        catch-up."""
+        self.alpha.receive_decision(int(req.commit_ts), bool(req.commit),
+                                    int(req.origin))
+        return pb.Payload(data=b"ok")
+
     def FetchLog(self, req: pb.FetchLogRequest, ctx) -> pb.LogRecords:
         """Serve the local WAL tail above since_ts (reference: raft log
         replay to a lagging follower / Badger Stream). Records are FULL
         mutations (apply_committed logs them unrestricted), so any peer
         can extract its own subset."""
-        from dgraph_tpu.store.wal import mut_to_bytes, replay
+        from dgraph_tpu.store.wal import mut_to_bytes, resolved_replay
         since = int(req.since_ts)
         out = pb.LogRecords(complete=since >= self.alpha._wal_floor)
         if self.alpha.wal is None:
             out.complete = False
             return out
-        for ts, kind, obj in replay(self.alpha.wal.path):
+        # resolved stream: pend+dec pairs surface as committed muts or
+        # abort markers; unresolved pends never leave this node
+        for ts, kind, obj in resolved_replay(self.alpha.wal.path):
             if ts <= since:
                 continue
             if kind == "mut":
                 out.records.append(pb.LogRecord(
                     ts=ts, mut_json=mut_to_bytes(obj)))
+            elif kind == "abort":
+                out.records.append(pb.LogRecord(ts=ts, abort=True))
             elif kind == "schema":
                 out.records.append(pb.LogRecord(ts=ts, schema=obj))
             elif kind == "drop_attr":
@@ -265,7 +295,9 @@ def make_server(alpha: Alpha, addr: str = "127.0.0.1:0",
         }),
         grpc.method_handlers_generic_handler(SERVICE_WORKER, {
             "ServeTask": _unary(w.ServeTask, pb.TaskQuery),
+            "Ping": _unary(w.Ping, pb.Empty),
             "ApplyMutation": _unary(w.ApplyMutation, pb.MutationMsg),
+            "ApplyDecision": _unary(w.ApplyDecision, pb.DecisionMsg),
             "FetchLog": _unary(w.FetchLog, pb.FetchLogRequest),
             "PullTablet": _unary(w.PullTablet, pb.PullTabletRequest),
             "TabletSnapshot": _unary(w.TabletSnapshot,
@@ -318,10 +350,22 @@ class Client:
                           pb.TaskQuery(**kw), pb.TaskResult)
 
     def apply_mutation(self, mut_json: bytes, commit_ts: int,
-                       origin: int = 0, prev_ts: int = 0) -> None:
+                       origin: int = 0, prev_ts: int = 0,
+                       stage: bool = False) -> None:
         self._call(SERVICE_WORKER, "ApplyMutation",
                    pb.MutationMsg(mut_json=mut_json, commit_ts=commit_ts,
-                                  origin=origin, prev_ts=prev_ts),
+                                  origin=origin, prev_ts=prev_ts,
+                                  stage=stage),
+                   pb.Payload)
+
+    def ping(self) -> None:
+        self._call(SERVICE_WORKER, "Ping", pb.Empty(), pb.Payload)
+
+    def apply_decision(self, commit_ts: int, commit: bool,
+                       origin: int = 0) -> None:
+        self._call(SERVICE_WORKER, "ApplyDecision",
+                   pb.DecisionMsg(commit_ts=commit_ts, commit=commit,
+                                  origin=origin),
                    pb.Payload)
 
     def fetch_log(self, since_ts: int):
@@ -331,7 +375,9 @@ class Client:
                        pb.FetchLogRequest(since_ts=since_ts), pb.LogRecords)
         out = []
         for rec in r.records:
-            if rec.drop:
+            if rec.abort:
+                out.append((int(rec.ts), "abort", None))
+            elif rec.drop:
                 out.append((int(rec.ts), "drop", None))
             elif rec.drop_attr:
                 out.append((int(rec.ts), "drop_attr", rec.drop_attr))

@@ -220,15 +220,42 @@ class WAL(Journal):
         """Durably record a DropAttr (replay re-drops the predicate)."""
         super().append({"ts": ts, "drop_attr": pred})
 
+    def append_pend(self, mut: Mutation, commit_ts: int) -> None:
+        """Durably log a STAGED mutation (commit-quorum phase 1,
+        reference: raft log append before commit). Not applied until a
+        matching decision marker commits it; an unresolved pend is
+        invisible to readers and was never acked to any client."""
+        super().append({"ts": commit_ts, "pend": _mut_doc(mut)})
+
+    def append_decision(self, commit_ts: int, commit: bool) -> None:
+        """Durably record the coordinator's commit/abort decision for a
+        staged ts (commit-quorum phase 2; the raft commit-index analog)."""
+        super().append({"ts": commit_ts, "dec": 1 if commit else 0})
+
     def truncate(self, upto_ts: int) -> None:
         """Drop records with commit_ts ≤ upto_ts (checkpoint just absorbed
-        them); the tail survives atomically."""
+        them); the tail survives atomically. Unresolved pends survive
+        regardless of ts — they were never applied, so no checkpoint
+        absorbed them. One decode pass: records buffer in memory (the
+        rewrite rebuilds the whole file anyway)."""
+        def doc_of(ts, kind, obj):
+            if kind == "mut":
+                return {"ts": ts, "m": _mut_doc(obj)}
+            if kind == "pend":
+                return {"ts": ts, "pend": _mut_doc(obj)}
+            if kind == "dec":
+                return {"ts": ts, "dec": obj}
+            if kind == "drop":
+                return {"ts": ts, "drop": 1}
+            if kind == "drop_attr":
+                return {"ts": ts, "drop_attr": obj}
+            return {"ts": ts, "schema": obj}
+
+        records = list(replay(self.path))
+        decided = {ts for ts, kind, _obj in records if kind == "dec"}
         self.rewrite(
-            ({"ts": ts, "m": _mut_doc(obj)} if kind == "mut"
-             else {"ts": ts, "drop": 1} if kind == "drop"
-             else {"ts": ts, "drop_attr": obj} if kind == "drop_attr"
-             else {"ts": ts, "schema": obj})
-            for ts, kind, obj in replay(self.path) if ts > upto_ts)
+            doc_of(ts, kind, obj) for ts, kind, obj in records
+            if ts > upto_ts or (kind == "pend" and ts not in decided))
 
 
 def _scan(data: bytes) -> Iterator[tuple[int, bytes, bool]]:
@@ -306,5 +333,30 @@ def replay(path: str) -> Iterator[tuple[int, str, object]]:
             yield int(doc["ts"]), "drop", None
         elif "drop_attr" in doc:
             yield int(doc["ts"]), "drop_attr", doc["drop_attr"]
+        elif "pend" in doc:
+            yield int(doc["ts"]), "pend", _doc_mut(doc["pend"])
+        elif "dec" in doc:
+            yield int(doc["ts"]), "dec", int(doc["dec"])
         else:
             yield int(doc["ts"]), "mut", _doc_mut(doc["m"])
+
+
+def resolved_replay(path: str) -> Iterator[tuple[int, str, object]]:
+    """Replay with commit-quorum staging RESOLVED: a pend followed by its
+    dec:1 yields kind "mut" at the decision point (the commit-index
+    analog — ordering against schema/drop records is the decision's,
+    not the stage's); dec:0 yields kind "abort" (peers drop their
+    matching pending entry); an unresolved trailing pend is skipped —
+    it was never applied or acked anywhere."""
+    pend: dict[int, object] = {}
+    for ts, kind, obj in replay(path):
+        if kind == "pend":
+            pend[ts] = obj
+        elif kind == "dec":
+            mut = pend.pop(ts, None)
+            if obj and mut is not None:
+                yield ts, "mut", mut
+            elif not obj:
+                yield ts, "abort", None
+        else:
+            yield ts, kind, obj

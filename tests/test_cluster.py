@@ -281,12 +281,15 @@ def test_replica_catchup_after_missed_broadcasts():
     node) converges via the chained-broadcast gap pull (FetchLog) on the
     next message it receives — no operator action (VERDICT r2 item 3)."""
     from dgraph_tpu.cluster.zero import ZeroState
-    zserver, zport, state = make_zero_server(ZeroState(replicas=2))
+    # THREE replicas: commit quorum (majority=2) must hold while r2 is
+    # down — a 2-replica group correctly refuses writes with one dead
+    zserver, zport, state = make_zero_server(ZeroState(replicas=3))
     zserver.start()
     ztarget = f"127.0.0.1:{zport}"
     r1, sr1, addr1 = start_cluster_alpha(ztarget, device_threshold=10**9)
     r2, sr2, addr2 = start_cluster_alpha(ztarget, device_threshold=10**9)
-    assert r1.groups.gid == r2.groups.gid
+    r3, sr3, addr3 = start_cluster_alpha(ztarget, device_threshold=10**9)
+    assert r1.groups.gid == r2.groups.gid == r3.groups.gid
     # the coordinator logs full records (the FetchLog source); every real
     # deployment has this via Alpha.open
     import tempfile, os
@@ -321,7 +324,7 @@ def test_replica_catchup_after_missed_broadcasts():
     # r2's own store really has the records (not a routed read)
     local = r2.mvcc.read_view(r2.oracle.read_only_ts())
     assert local.preds["name"].vals[""].subj.shape[0] == 6
-    for s in (sr1, sr2b, zserver):
+    for s in (sr1, sr2b, sr3, zserver):
         s.stop(None)
 
 
@@ -329,11 +332,12 @@ def test_rejoin_resync_pulls_missed_tail():
     """resync_on_join: a node that was down while commits happened pulls
     the peer's WAL tail on rejoin (the cli --zero rejoin path)."""
     from dgraph_tpu.cluster.zero import ZeroState
-    zserver, zport, state = make_zero_server(ZeroState(replicas=2))
+    zserver, zport, state = make_zero_server(ZeroState(replicas=3))
     zserver.start()
     ztarget = f"127.0.0.1:{zport}"
     r1, sr1, addr1 = start_cluster_alpha(ztarget, device_threshold=10**9)
     r2, sr2, addr2 = start_cluster_alpha(ztarget, device_threshold=10**9)
+    r3, sr3, addr3 = start_cluster_alpha(ztarget, device_threshold=10**9)
     zc = ZeroClient(ztarget)
     zc.should_serve("name", r1.groups.gid)
     r1.alter(SCHEMA)
@@ -354,7 +358,7 @@ def test_rejoin_resync_pulls_missed_tail():
     r2.resync_on_join()
     out = r2.query('{ q(func: has(name)) { name } }')
     assert sorted(r["name"] for r in out["q"]) == ["p0", "p1", "p2"]
-    for s in (sr1, sr2b, zserver):
+    for s in (sr1, sr2b, sr3, zserver):
         s.stop(None)
 
 
@@ -386,11 +390,12 @@ def test_missed_alter_recovered_via_chain():
     from dgraph_tpu.store.wal import WAL
     import os, tempfile
 
-    zserver, zport, state = make_zero_server(ZeroState(replicas=2))
+    zserver, zport, state = make_zero_server(ZeroState(replicas=3))
     zserver.start()
     ztarget = f"127.0.0.1:{zport}"
     r1, sr1, addr1 = start_cluster_alpha(ztarget, device_threshold=10**9)
     r2, sr2, addr2 = start_cluster_alpha(ztarget, device_threshold=10**9)
+    r3, sr3, addr3 = start_cluster_alpha(ztarget, device_threshold=10**9)
     r1.wal = WAL(os.path.join(tempfile.mkdtemp(), "wal.log"), sync=False)
     zc = ZeroClient(ztarget)
     zc.should_serve("name", r1.groups.gid)
@@ -408,7 +413,7 @@ def test_missed_alter_recovered_via_chain():
     assert r2.mvcc.schema.peek("city") is not None
     out = r2.query('{ q(func: eq(city, "basel")) { name city } }')
     assert out == {"q": [{"name": "bob", "city": "basel"}]}
-    for s in (sr1, sr2b, zserver):
+    for s in (sr1, sr2b, sr3, zserver):
         s.stop(None)
 
 
