@@ -70,7 +70,9 @@ def cmd_alpha(args) -> int:
     # checkpoint + WAL replay boot: every commit that reached disk before
     # a crash is recovered (reference: badger open + raft WAL restore)
     alpha = Alpha.open(cfg.p_dir, device_threshold=cfg.device_threshold,
-                       mesh=mesh)
+                       mesh=mesh,
+                       memory_budget=(cfg.memory_budget_mb << 20)
+                       if cfg.memory_budget_mb else None)
     if args.acl_secret_file:
         # ACL enforcement (reference: ee/acl --acl_secret_file): groot
         # bootstrap + token-gated endpoints
@@ -340,6 +342,10 @@ def main(argv=None) -> int:
                    help="seconds between zero liveness heartbeats")
     p.add_argument("--group", type=int, default=0,
                    help="raft-group analog to join (0 = zero picks)")
+    p.add_argument("--memory_budget_mb", type=int, default=0,
+                   help="out-of-core mode: fault predicate tablets from "
+                        "the checkpoint on demand, LRU-evict above this "
+                        "many MB resident (0 = fully resident)")
     p.add_argument("--log_level", default="info")
     p.set_defaults(fn=cmd_alpha)
 

@@ -14,20 +14,25 @@ def start_cluster_alpha(zero_target: str, base=None, group: int = 0,
     from dgraph_tpu.server.api import Alpha
     from dgraph_tpu.server.task import make_server
 
-    wal = None
+    zero = ZeroClient(zero_target)
+    alpha = Alpha(base=base, device_threshold=device_threshold,
+                  oracle=RemoteOracle(zero))
+    max_ts, max_uid = alpha.mvcc.base_ts, 0
     if wal_dir is not None:
         import os
 
-        from dgraph_tpu.store.wal import WAL
-        wal = WAL(os.path.join(wal_dir, "wal.log"))
-    zero = ZeroClient(zero_target)
-    alpha = Alpha(base=base, device_threshold=device_threshold,
-                  oracle=RemoteOracle(zero), wal=wal)
+        # replay + re-arm before serving: a restarted replica's stage
+        # acks certified durable records that MUST be visible again
+        # (Alpha.open's boot leg, shared via attach_wal)
+        wal_ts, wal_uid = alpha.attach_wal(
+            os.path.join(wal_dir, "wal.log"))
+        max_ts = max(max_ts, wal_ts)
+        max_uid = max(max_uid, wal_uid)
     server, port = make_server(alpha, addr)
     server.start()
     bound = f"127.0.0.1:{port}"
-    alpha.groups = Groups(
-        zero, bound, group=group, max_ts=alpha.mvcc.base_ts,
-        max_uid=int(base.uids[-1]) if base is not None and base.n_nodes
-        else 0)
+    if base is not None and base.n_nodes:
+        max_uid = max(max_uid, int(base.uids[-1]))
+    alpha.groups = Groups(zero, bound, group=group, max_ts=max_ts,
+                          max_uid=max_uid)
     return alpha, server, bound

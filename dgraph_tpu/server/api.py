@@ -87,26 +87,59 @@ class Alpha:
 
     @classmethod
     def open(cls, p_dir: str, device_threshold: int = 512,
-             sync: bool = True, mesh=None) -> "Alpha":
+             sync: bool = True, mesh=None,
+             memory_budget: int | None = None) -> "Alpha":
         """Boot from a persistence dir: newest checkpoint + WAL replay
         (reference: Badger open + raft WAL restore on alpha start). Every
-        commit that reached the WAL before a crash is recovered."""
+        commit that reached the WAL before a crash is recovered.
+
+        `memory_budget` (bytes) opens the checkpoint OUT-OF-CORE:
+        predicate tablets fault in from disk on first touch and evict
+        LRU under the budget (reference: Badger's LSM — data exceeds
+        RAM; SURVEY §5 "HBM is a cache, never the source of truth").
+        Read-mostly scope: a fold materialization (mutations), rollup,
+        or checkpoint save rebuilds the full store and faults every
+        tablet (see store/outofcore.py)."""
         import os
 
         from dgraph_tpu.store import checkpoint
-        from dgraph_tpu.store.schema import parse_schema
-        from dgraph_tpu.store.wal import WAL, replay
 
         base, base_ts = None, 0
         if checkpoint.exists(p_dir):
-            base, base_ts = checkpoint.load(p_dir)
+            if memory_budget is not None:
+                from dgraph_tpu.store.outofcore import open_out_of_core
+                base, base_ts = open_out_of_core(p_dir, memory_budget)
+            else:
+                base, base_ts = checkpoint.load(p_dir)
         wal_path = os.path.join(p_dir, "wal.log")
         alpha = cls(base=base, device_threshold=device_threshold,
                     base_ts=base_ts, mesh=mesh)
+        max_ts, max_uid = alpha.attach_wal(wal_path, sync=sync)
+        alpha.oracle.bump_ts(max_ts)
+        if max_uid:
+            alpha.oracle.bump_uid(max_uid)
+        return alpha
+
+    def attach_wal(self, wal_path: str, sync: bool = True) -> tuple[int, int]:
+        """Replay + arm an existing WAL on this Alpha — the boot leg
+        shared by Alpha.open and cluster-mode start (a node whose stage
+        acks certified durability MUST recover its log on restart).
+        Resolves pend/dec staging inline (a pend applies at its dec:1
+        position — the commit-index analog), re-arms undecided pends,
+        seeds the broadcast chain, then opens the WAL for appends.
+        Returns (max_ts, max_uid) seen, for oracle / Zero watermark
+        seeding by the caller."""
+        from dgraph_tpu.store.schema import parse_schema
+        from dgraph_tpu.store.wal import WAL, replay
+
+        base_ts = self.mvcc.base_ts
         max_ts, max_uid = base_ts, 0
-        # ONE decode pass: resolve pend/dec staging inline (pend applies
-        # at its dec:1 position — the commit-index analog) and remember
-        # unresolved pends for re-arming below
+        # one decode pass: resolve pend/dec staging inline and remember
+        # unresolved pends for re-arming below. Records resolved FROM a
+        # pend are flagged: a pend that survived a checkpoint truncate
+        # was undecided then, so the checkpoint does NOT contain it —
+        # it must apply even when its ts is at or below base_ts
+        # (straggler absorption), where a plain record would be skipped.
         pends: dict[int, Mutation] = {}
         resolved = []
         for ts, kind, obj in replay(wal_path):
@@ -116,47 +149,49 @@ class Alpha:
             if kind == "dec":
                 mut = pends.pop(ts, None)
                 if obj and mut is not None:
-                    resolved.append((ts, "mut", mut))
+                    resolved.append((ts, "mut", mut, True))
                 continue
-            resolved.append((ts, kind, obj))
-        for ts, kind, obj in resolved:
-            if ts <= base_ts:
+            resolved.append((ts, kind, obj, False))
+        for ts, kind, obj, from_pend in resolved:
+            if ts <= base_ts and not from_pend:
                 continue  # checkpoint already absorbed it
             if kind == "schema":
-                merged = alpha.mvcc.schema.clone()
+                merged = self.mvcc.schema.clone()
                 merged.update(parse_schema(obj))
-                alpha.mvcc.rebuild_base(schema=merged)
+                self.mvcc.rebuild_base(schema=merged)
             elif kind == "drop":
-                alpha.mvcc = MVCCStore()
-                alpha.xidmap = XidMap(alpha.oracle)
+                self.mvcc = MVCCStore()
+                self.xidmap = XidMap(self.oracle)
             elif kind == "drop_attr":
-                alpha.mvcc.drop_predicate(obj, ts)
-            elif alpha.mvcc.has_applied(ts):
+                self.mvcc.drop_predicate(obj, ts)
+            elif self.mvcc.has_applied(ts):
                 continue  # duplicate record (catch-up raced a broadcast)
             else:
-                alpha.mvcc.apply(obj, ts)
+                try:
+                    self.mvcc.apply(obj, ts)
+                except ValueError:
+                    # quorum-committed below the checkpoint fold (staged
+                    # before the checkpoint, decided after): fold it in
+                    self.mvcc.absorb_straggler(obj, ts)
                 for s, _p, o, *_ in obj.edge_sets:
                     max_uid = max(max_uid, s, o)
                 for s, _p, *_ in (obj.edge_dels + obj.val_sets
                                   + obj.val_dels):
                     max_uid = max(max_uid, s)
             max_ts = max(max_ts, ts)
-        alpha.oracle.bump_ts(max_ts)
-        if max_uid:
-            alpha.oracle.bump_uid(max_uid)
         # seed the broadcast chain at the replayed horizon: prev_ts on our
         # first post-restart broadcast must not regress to 0 (a receiver
         # would miss the gap check); a too-HIGH prev only triggers a
         # harmless spurious catch-up on peers
-        alpha._last_sent_ts = max_ts
+        self._last_sent_ts = max_ts
         # re-arm undecided staged records (still durable, still
         # invisible): a peer's decision marker or catch-up resolves them
         # post-restart; origin 0 = unknown after restart
         for ts, mut in pends.items():
-            if not alpha.mvcc.has_applied(ts):
-                alpha._pending[ts] = (mut, 0)
-        alpha.wal = WAL(wal_path, sync=sync)
-        return alpha
+            if not self.mvcc.has_applied(ts):
+                self._pending[ts] = (mut, 0)
+        self.wal = WAL(wal_path, sync=sync)
+        return max_ts, max_uid
 
     def checkpoint_to(self, p_dir: str) -> int:
         """Fold all committed state into an on-disk checkpoint and drop the
@@ -1214,6 +1249,14 @@ class Alpha:
         heartbeat feeding zero/tablet.go's rebalance loop)."""
         store = self.mvcc.read_view(self.oracle.read_only_ts())
         sizes: dict[str, int] = {}
+        hints = getattr(store.preds, "size_hints", None)
+        if hints is not None:
+            # out-of-core base: manifest byte sizes, no faulting — the
+            # heartbeat must never page the whole store in
+            sizes = {p: nb for p, nb in hints().items()
+                     if self.groups.serves(p)}
+            self.groups.zero.report_tablets(self.groups.gid, sizes)
+            return sizes
         for pred, pd in store.preds.items():
             if not self.groups.serves(pred):
                 continue

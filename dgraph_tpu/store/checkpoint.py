@@ -61,7 +61,15 @@ def save(store: Store, dirname: str, base_ts: int = 0,
     preds_meta = {}
     for pred, pd in store.preds.items():
         slug = _slug(pred)
-        meta = {"slug": slug, "langs": sorted(pd.vals)}
+        nbytes = sum(r.indptr.nbytes + r.indices.nbytes
+                     for r in (pd.fwd, pd.rev) if r is not None)
+        nbytes += sum(c.subj.nbytes
+                      + (c.vals.nbytes if c.vals.dtype != object
+                         else len(c.vals) * 64)
+                      for c in pd.vals.values())
+        # nbytes: size hint for out-of-core eviction accounting and the
+        # tablet-size heartbeat (neither may fault the tablet in)
+        meta = {"slug": slug, "langs": sorted(pd.vals), "nbytes": nbytes}
         for side, rel in (("fwd", pd.fwd), ("rev", pd.rev)):
             if rel is not None:
                 vault.save_np(
@@ -158,9 +166,8 @@ def save_versioned(store: Store, dirname: str, base_ts: int = 0) -> None:
             shutil.rmtree(os.path.join(dirname, name), ignore_errors=True)
 
 
-def load(dirname: str) -> tuple[Store, int]:
-    """Load (store, base_ts). Reference: restore / bulk-load handoff.
-    Accepts both plain snapshot dirs and versioned (CURRENT) layouts."""
+def read_manifest(dirname: str) -> tuple[dict, str]:
+    """(manifest, resolved dir) with the format gate applied."""
     dirname = resolve(dirname)
     manifest = json.loads(
         vault.read_bytes(os.path.join(dirname, "manifest.json")))
@@ -169,54 +176,72 @@ def load(dirname: str) -> tuple[Store, int]:
         raise ValueError(
             f"checkpoint format {manifest['format_version']} not in "
             f"[{MIN_FORMAT_VERSION}, {FORMAT_VERSION}]")
+    return manifest, dirname
+
+
+def load_uids(dirname: str, manifest: dict) -> np.ndarray:
     if manifest.get("uids_codec"):
         from dgraph_tpu import native
-        uids = native.codec_decode(
+        return native.codec_decode(
             vault.read_bytes(os.path.join(dirname, "uids.duc")),
             manifest["n_nodes"])
-    else:
-        uids = vault.load_np(os.path.join(dirname, "uids.npy"))
+    return vault.load_np(os.path.join(dirname, "uids.npy"))
+
+
+def load_predicate(dirname: str, pred: str, meta: dict,
+                   schema) -> PredicateData:
+    """Load ONE predicate's tablet from a snapshot dir — the unit the
+    out-of-core store faults in on first touch (store/outofcore.py) and
+    the loop body of a full load()."""
+    slug = meta["slug"]
+    pd = PredicateData(schema=schema.get(pred))
+    for side in ("fwd", "rev"):
+        if meta.get(side):
+            indptr = vault.load_np(
+                os.path.join(dirname, f"{slug}.{side}.indptr.npy"))
+            indices = vault.load_np(
+                os.path.join(dirname, f"{slug}.{side}.indices.npy"))
+            setattr(pd, side, EdgeRel(indptr=indptr, indices=indices))
+    for lang in meta["langs"]:
+        lslug = lang or "_"
+        vals = vault.load_np(
+            os.path.join(dirname, f"{slug}.val.{lslug}.vals.npy"),
+            allow_pickle=False)
+        if vals.dtype.kind == "U":  # restore string columns to object
+            vals = vals.astype(object)
+        ps = schema.get(pred)
+        if ps is not None and ps.kind == Kind.GEO and len(vals):
+            # geo columns persist as GeoJSON strings; re-wrap
+            from dgraph_tpu.store.geo import parse_geo
+            out = np.empty(len(vals), dtype=object)
+            out[:] = [parse_geo(v) for v in vals]
+            vals = out
+        pd.vals[lang] = ValueColumn(
+            subj=vault.load_np(
+                os.path.join(dirname, f"{slug}.val.{lslug}.subj.npy")),
+            vals=vals)
+    if meta.get("facets"):
+        fdoc = json.loads(vault.read_bytes(
+            os.path.join(dirname, f"{slug}.facets.json")))
+        for k, col in fdoc.get("efacets", {}).items():
+            vals = np.empty(len(col["vals"]), dtype=object)
+            vals[:] = [dec_scalar(v) for v in col["vals"]]
+            pd.efacets[k] = FacetCol(
+                pos=np.array(col["pos"], np.int64), vals=vals)
+        for k, m in fdoc.get("vfacets", {}).items():
+            pd.vfacets[k] = {int(r): dec_scalar(v)
+                             for r, v in m.items()}
+    return pd
+
+
+def load(dirname: str) -> tuple[Store, int]:
+    """Load (store, base_ts). Reference: restore / bulk-load handoff.
+    Accepts both plain snapshot dirs and versioned (CURRENT) layouts."""
+    manifest, dirname = read_manifest(dirname)
+    uids = load_uids(dirname, manifest)
     schema = parse_schema(manifest["schema"])
     preds: dict[str, PredicateData] = {}
     for pred, meta in manifest["predicates"].items():
-        slug = meta["slug"]
-        pd = PredicateData(schema=schema.get(pred))
-        for side in ("fwd", "rev"):
-            if meta.get(side):
-                indptr = vault.load_np(
-                    os.path.join(dirname, f"{slug}.{side}.indptr.npy"))
-                indices = vault.load_np(
-                    os.path.join(dirname, f"{slug}.{side}.indices.npy"))
-                setattr(pd, side, EdgeRel(indptr=indptr, indices=indices))
-        for lang in meta["langs"]:
-            lslug = lang or "_"
-            vals = vault.load_np(
-                os.path.join(dirname, f"{slug}.val.{lslug}.vals.npy"),
-                allow_pickle=False)
-            if vals.dtype.kind == "U":  # restore string columns to object
-                vals = vals.astype(object)
-            ps = schema.get(pred)
-            if ps is not None and ps.kind == Kind.GEO and len(vals):
-                # geo columns persist as GeoJSON strings; re-wrap
-                from dgraph_tpu.store.geo import parse_geo
-                out = np.empty(len(vals), dtype=object)
-                out[:] = [parse_geo(v) for v in vals]
-                vals = out
-            pd.vals[lang] = ValueColumn(
-                subj=vault.load_np(
-                    os.path.join(dirname, f"{slug}.val.{lslug}.subj.npy")),
-                vals=vals)
-        if meta.get("facets"):
-            fdoc = json.loads(vault.read_bytes(
-                os.path.join(dirname, f"{slug}.facets.json")))
-            for k, col in fdoc.get("efacets", {}).items():
-                vals = np.empty(len(col["vals"]), dtype=object)
-                vals[:] = [dec_scalar(v) for v in col["vals"]]
-                pd.efacets[k] = FacetCol(
-                    pos=np.array(col["pos"], np.int64), vals=vals)
-            for k, m in fdoc.get("vfacets", {}).items():
-                pd.vfacets[k] = {int(r): dec_scalar(v)
-                                 for r, v in m.items()}
-        preds[pred] = pd
+        preds[pred] = load_predicate(dirname, pred, meta, schema)
     build_indexes(preds)
     return Store(uids=uids, schema=schema, preds=preds), manifest["base_ts"]

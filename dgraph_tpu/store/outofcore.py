@@ -1,0 +1,192 @@
+"""Out-of-core store: fault predicate tablets in on first touch, evict LRU.
+
+Reference parity: Badger is an LSM — the reference's data set is NEVER
+required to fit in RAM; posting lists page in from disk through the block
+cache (SURVEY §2.1), and SURVEY §5 pins the build-side contract: "CSR
+block store on host disk …; HBM is a cache, never the source of truth".
+This module is the host-RAM leg of that contract: a Store whose
+per-predicate tablets live in a versioned checkpoint (store/checkpoint.py)
+and materialize on first access, with least-recently-used eviction
+holding resident bytes under a budget.
+
+Granularity is the PREDICATE TABLET — the same unit the reference
+shards, moves, and snapshots (zero/tablet.go). The uid vocabulary and
+schema stay resident (they are the rank dictionary every lookup needs;
+their size is O(nodes), not O(edges)).
+
+The returned Store is immutable, like every snapshot: mutations go
+through MVCC layers on top, and eviction is invisible to readers —
+a re-fault reloads bit-identical arrays from the checkpoint.
+
+SCOPE (documented limitation): the budget governs the READ path. A
+mutation-bearing read (MVCC fold materialization), a rollup, or a
+checkpoint save rebuilds the whole store and therefore faults every
+tablet in — out-of-core mode fits read-mostly serving nodes (restore
+targets, analytics replicas), matching the reference's deployment shape
+where bulk-loaded read replicas dwarf their write volume. The
+tablet-size heartbeat reads manifest size hints and never faults.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+
+import numpy as np
+
+from dgraph_tpu.store import checkpoint
+from dgraph_tpu.store.schema import parse_schema
+from dgraph_tpu.store.store import PredicateData, Store, build_indexes
+
+
+def _pd_nbytes(pd: PredicateData) -> int:
+    """Resident-byte estimate for a faulted tablet (arrays dominate;
+    python-object columns are counted at pointer width plus a flat
+    per-value estimate)."""
+    total = 0
+    for rel in (pd.fwd, pd.rev):
+        if rel is not None:
+            total += rel.indptr.nbytes + rel.indices.nbytes
+    if pd.rev_pos is not None:
+        total += pd.rev_pos.nbytes
+    for col in pd.vals.values():
+        total += col.subj.nbytes
+        total += (col.vals.nbytes if col.vals.dtype != object
+                  else len(col.vals) * 64)
+    for fcol in pd.efacets.values():
+        total += fcol.pos.nbytes + len(fcol.vals) * 64
+    for tok_map in pd.index.values():
+        for arr in tok_map.values():
+            total += arr.nbytes
+    return total
+
+
+class LazyPreds:
+    """Mapping of predicate → PredicateData backed by a checkpoint dir.
+
+    First access faults the tablet in (checkpoint.load_predicate + its
+    inverted indexes); every access touches LRU order; loads past the
+    byte budget evict the least-recently-used tablets (never the one
+    being returned). Thread-safe — the serving path reads from many
+    request threads."""
+
+    def __init__(self, dirname: str, manifest: dict, schema,
+                 budget_bytes: int):
+        self._dir = dirname
+        self._meta = manifest["predicates"]
+        self._schema = schema
+        self.budget_bytes = budget_bytes
+        self._resident: OrderedDict[str, PredicateData] = OrderedDict()
+        self._sizes: dict[str, int] = {}
+        self._lock = threading.RLock()
+        self._inflight: dict[str, threading.Event] = {}
+        self.resident_bytes = 0
+        self.faults = 0       # tablets loaded from disk
+        self.evictions = 0    # tablets dropped under budget pressure
+
+    def size_hints(self) -> dict[str, int]:
+        """Per-tablet byte sizes from the manifest, WITHOUT faulting —
+        the tablet-size heartbeat (Zero rebalancing input) must not page
+        the whole store in. Old checkpoints without recorded sizes
+        report resident tablets only."""
+        out = {}
+        for pred, meta in self._meta.items():
+            nb = meta.get("nbytes")
+            if nb is not None:
+                out[pred] = int(nb)
+            elif pred in self._sizes:
+                out[pred] = self._sizes[pred]
+        return out
+
+    # -- mapping surface the engine uses -------------------------------------
+    def get(self, pred, default=None):
+        pd = self._fault(pred)
+        return pd if pd is not None else default
+
+    def __getitem__(self, pred):
+        pd = self._fault(pred)
+        if pd is None:
+            raise KeyError(pred)
+        return pd
+
+    def __contains__(self, pred) -> bool:
+        return pred in self._meta
+
+    def __iter__(self):
+        return iter(self._meta)
+
+    def __len__(self) -> int:
+        return len(self._meta)
+
+    def keys(self):
+        return self._meta.keys()
+
+    def items(self):
+        """Faults EVERYTHING in (export/debug paths); serving code uses
+        get()/[] which fault one tablet at a time."""
+        return [(p, self[p]) for p in self._meta]
+
+    def values(self):
+        return [self[p] for p in self._meta]
+
+    # -- fault/evict ---------------------------------------------------------
+    def _fault(self, pred: str):
+        """Resident hit: one cheap lock hop. Cold fault: the disk load +
+        index build runs OUTSIDE the lock (a seconds-long cold load must
+        not freeze readers of already-resident tablets); concurrent
+        requests for the same cold tablet wait on a per-predicate
+        in-flight event instead of loading twice."""
+        while True:
+            with self._lock:
+                pd = self._resident.get(pred)
+                if pd is not None:
+                    self._resident.move_to_end(pred)
+                    return pd
+                meta = self._meta.get(pred)
+                if meta is None:
+                    return None
+                ev = self._inflight.get(pred)
+                if ev is None:
+                    ev = self._inflight[pred] = threading.Event()
+                    break            # this thread loads
+            ev.wait()                # another thread is loading it
+            # loop: usually resident now; retry covers an eviction race
+
+        try:
+            pd = checkpoint.load_predicate(self._dir, pred, meta,
+                                           self._schema)
+            build_indexes({pred: pd})
+            size = _pd_nbytes(pd)
+            with self._lock:
+                self.faults += 1
+                self._resident[pred] = pd
+                self._sizes[pred] = size
+                self.resident_bytes += size
+                while (self.resident_bytes > self.budget_bytes
+                       and len(self._resident) > 1):
+                    victim, vpd = self._resident.popitem(last=False)
+                    if victim == pred:  # never evict what we're returning
+                        self._resident[victim] = vpd
+                        self._resident.move_to_end(victim, last=False)
+                        break
+                    self.resident_bytes -= self._sizes.pop(victim)
+                    self.evictions += 1
+            return pd
+        finally:
+            with self._lock:
+                self._inflight.pop(pred, None)
+            ev.set()
+
+
+def open_out_of_core(dirname: str,
+                     budget_bytes: int) -> tuple[Store, int]:
+    """Open a checkpoint as an out-of-core Store: tablets fault in on
+    first touch, LRU-evicted under `budget_bytes` of resident tablet
+    data. Returns (store, base_ts) like checkpoint.load."""
+    manifest, resolved = checkpoint.read_manifest(dirname)
+    uids = checkpoint.load_uids(resolved, manifest)
+    schema = parse_schema(manifest["schema"])
+    preds = LazyPreds(resolved, manifest, schema, budget_bytes)
+    store = Store(uids=np.asarray(uids, np.int64), schema=schema,
+                  preds=preds)
+    return store, manifest["base_ts"]

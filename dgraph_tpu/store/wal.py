@@ -236,8 +236,10 @@ class WAL(Journal):
         """Drop records with commit_ts ≤ upto_ts (checkpoint just absorbed
         them); the tail survives atomically. Unresolved pends survive
         regardless of ts — they were never applied, so no checkpoint
-        absorbed them. One decode pass: records buffer in memory (the
-        rewrite rebuilds the whole file anyway)."""
+        absorbed them. Two STREAMING passes (decision index, then the
+        rewrite): truncate runs inside checkpoint_to next to the rollup's
+        materialization, so buffering every decoded record here would
+        stack two whole-store memory spikes."""
         def doc_of(ts, kind, obj):
             if kind == "mut":
                 return {"ts": ts, "m": _mut_doc(obj)}
@@ -251,10 +253,10 @@ class WAL(Journal):
                 return {"ts": ts, "drop_attr": obj}
             return {"ts": ts, "schema": obj}
 
-        records = list(replay(self.path))
-        decided = {ts for ts, kind, _obj in records if kind == "dec"}
+        decided = {ts for ts, kind, _obj in replay(self.path)
+                   if kind == "dec"}
         self.rewrite(
-            doc_of(ts, kind, obj) for ts, kind, obj in records
+            doc_of(ts, kind, obj) for ts, kind, obj in replay(self.path)
             if ts > upto_ts or (kind == "pend" and ts not in decided))
 
 

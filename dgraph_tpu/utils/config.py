@@ -40,6 +40,8 @@ class AlphaConfig:
     device_threshold: int = 512   # frontier size that moves a hop on-device
     mesh_devices: int = 0         # 0 = no mesh; -1 = all devices; N = N
     rollup_every: int = 64        # commits between automatic rollups
+    memory_budget_mb: int = 0     # 0 = fully resident; >0 = out-of-core
+                                  # tablet faulting under this budget
     encryption_key_file: str = ""  # at-rest AES key (reference: ee enc)
     encryption_strict: bool = False  # reject plaintext files once migrated
     log_level: str = "info"

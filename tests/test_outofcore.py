@@ -1,0 +1,154 @@
+"""Out-of-core store: fault-in on first touch, LRU eviction under budget.
+
+Reference parity: Badger is an LSM — the reference's dataset never has
+to fit in RAM (SURVEY §2.1); SURVEY §5 fixes the build-side contract
+("CSR block store on host disk; HBM is a cache, never the source of
+truth"). The acceptance bar from the round-4 verdict: a passing test
+querying a store whose ON-DISK size exceeds the configured budget.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from dgraph_tpu.engine import Engine
+from dgraph_tpu.server.api import Alpha
+from dgraph_tpu.store import checkpoint
+from dgraph_tpu.store.outofcore import open_out_of_core
+
+SCHEMA = """
+name: string @index(exact) .
+score: int @index(int) .
+follows: [uid] @reverse .
+likes: [uid] @reverse .
+rates: [uid] @reverse .
+knows: [uid] @reverse .
+"""
+
+
+@pytest.fixture(scope="module")
+def ckpt_dir(tmp_path_factory):
+    """A checkpoint with several edge tablets big enough that the budget
+    below cannot hold them all."""
+    rng = np.random.default_rng(3)
+    a = Alpha(device_threshold=10**9)
+    a.alter(SCHEMA)
+    n = 500
+    lines = [f'_:p{i} <name> "p{i}" .\n_:p{i} <score> "{i % 31}"^^<xs:int> .'
+             for i in range(n)]
+    for pred, deg in (("follows", 20), ("likes", 20), ("rates", 20),
+                      ("knows", 20)):
+        for i in range(n):
+            for j in rng.choice(n, deg, replace=False):
+                if i != j:
+                    lines.append(f"_:p{i} <{pred}> _:p{j} .")
+    a.mutate(set_nquads="\n".join(lines))
+    d = tmp_path_factory.mktemp("ooc")
+    a.checkpoint_to(str(d))
+    return str(d), a
+
+
+def _disk_bytes(d):
+    d = checkpoint.resolve(d)
+    return sum(os.path.getsize(os.path.join(d, f)) for f in os.listdir(d))
+
+
+def test_query_under_budget_smaller_than_disk(ckpt_dir):
+    d, a = ckpt_dir
+    disk = _disk_bytes(d)
+    budget = disk // 3
+    store, base_ts = open_out_of_core(d, budget)
+    assert base_ts > 0
+    lazy = store.preds
+    assert lazy.resident_bytes == 0 and lazy.faults == 0
+
+    eng = Engine(store, device_threshold=10**9)
+    ref = Engine(a.mvcc.read_view(a.oracle.read_only_ts()),
+                 device_threshold=10**9)
+    queries = [
+        '{ q(func: eq(name, "p7")) { name follows { name } } }',
+        '{ q(func: eq(name, "p9")) { likes { name score } } }',
+        '{ q(func: eq(name, "p11")) { rates { name } } }',
+        '{ q(func: eq(name, "p13")) { knows { ~knows (first: 3) '
+        '{ name } } } }',
+        '{ q(func: eq(score, 5), first: 5, orderasc: name) { name } }',
+    ]
+    for q in queries:
+        assert eng.query(q) == ref.query(q), q
+    # the working set was faulted, the budget held, evictions happened
+    assert lazy.faults >= 5
+    assert lazy.evictions >= 1
+    assert lazy.resident_bytes <= budget or len(lazy._resident) == 1
+    # total on-disk exceeds what was ever resident at once
+    assert disk > budget
+
+    # re-touching an evicted tablet re-faults identical data
+    faults_before = lazy.faults
+    for q in queries:
+        assert eng.query(q) == ref.query(q), q
+    assert lazy.faults > faults_before   # at least one re-fault occurred
+
+
+def test_membership_does_not_fault(ckpt_dir):
+    d, _a = ckpt_dir
+    store, _ = open_out_of_core(d, 1 << 30)
+    lazy = store.preds
+    assert "follows" in lazy and "nope" not in lazy
+    assert set(lazy.keys()) >= {"follows", "likes", "rates", "knows",
+                                "name", "score"}
+    assert lazy.faults == 0              # membership is manifest-only
+
+
+def test_size_hints_do_not_fault(ckpt_dir):
+    """Tablet-size heartbeats read manifest hints, never the tablets."""
+    d, _a = ckpt_dir
+    store, _ = open_out_of_core(d, 1 << 30)
+    lazy = store.preds
+    hints = lazy.size_hints()
+    assert set(hints) >= {"follows", "likes", "rates", "knows"}
+    assert all(nb > 0 for nb in hints.values())
+    assert lazy.faults == 0
+
+
+def test_concurrent_faulting_single_load(ckpt_dir):
+    """Many threads touching the same cold tablet: one disk load, no
+    reader blocked behind an unrelated fault (the lock covers only map
+    bookkeeping)."""
+    import threading
+    d, _a = ckpt_dir
+    store, _ = open_out_of_core(d, 1 << 30)
+    lazy = store.preds
+    out = []
+
+    def touch(pred):
+        out.append(lazy.get(pred).fwd.nnz if lazy.get(pred).fwd
+                   else 0)
+
+    threads = [threading.Thread(target=touch, args=("follows",))
+               for _ in range(8)]
+    threads += [threading.Thread(target=touch, args=("likes",))
+                for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(set(out[:8] + out[8:])) <= 2
+    assert lazy.faults == 2          # one load per predicate, not 16
+
+
+def test_alpha_open_with_memory_budget(ckpt_dir, tmp_path):
+    """The product path: Alpha.open(memory_budget=...) serves queries
+    out-of-core, and mutations still commit through MVCC layers on top
+    of the lazy base."""
+    d, a = ckpt_dir
+    budget = _disk_bytes(d) // 3
+    a2 = Alpha.open(d, device_threshold=10**9, memory_budget=budget)
+    ref = Engine(a.mvcc.read_view(a.oracle.read_only_ts()),
+                 device_threshold=10**9)
+    q = '{ q(func: eq(name, "p7")) { name follows { name } } }'
+    assert a2.query(q) == ref.query(q)
+    a2.mutate(set_nquads='_:new <name> "zz_new" .')
+    out = a2.query('{ q(func: eq(name, "zz_new")) { name } }')
+    assert out == {"q": [{"name": "zz_new"}]}
+    assert a2.mvcc.base.preds.evictions >= 0   # lazy base is live
