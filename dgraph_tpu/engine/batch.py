@@ -271,9 +271,13 @@ def _recurse_for(store, attr: str, reverse: bool, W: int):
     import jax
 
     from dgraph_tpu.ops.bfs import make_ell_recurse
+    from dgraph_tpu.ops.pallas_hop import pallas_enabled
 
     host = _cache_host(store, attr, reverse)
-    key = (attr, reverse, W)
+    # the hop implementation is baked in at prepare time: the flag is
+    # part of the key, so an A/B toggle mid-process can't serve a stale
+    # kernel under the other implementation's name
+    key = (attr, reverse, W, pallas_enabled())
     fns = getattr(host, "_ell_fns", None)
     if fns is not None and key in fns:  # hot path: no lock
         return fns[key]

@@ -401,10 +401,11 @@ def _tree_kernel_for(store, plan: TreePlan, rels, n: int, W: int):
 
     from dgraph_tpu.engine.batch import _cache_host, _cache_lock
     from dgraph_tpu.ops.bfs import _prepare_buckets, make_ell_tree
+    from dgraph_tpu.ops.pallas_hop import pallas_enabled
 
     hosts = {_cache_host(store, a, r) for a, r in rels}
     host = hosts.pop() if len(hosts) == 1 else store
-    key = (plan.sig, W)
+    key = (plan.sig, W, pallas_enabled())
     with _cache_lock:
         fns = getattr(host, "_tree_fns", None)
         if fns is None:
@@ -426,14 +427,18 @@ def _tree_kernel_for(store, plan: TreePlan, rels, n: int, W: int):
                 devs[rkey] = ([jax.device_put(e) for e in g.ells],
                               jax.device_put(perm_in),
                               jax.device_put(out_idx))
-            if (rkey, W) not in prep:
-                # bucket chunking depends on lane width; the underlying
-                # ELL device arrays upload once and are shared across W
-                prep[(rkey, W)] = _prepare_buckets(devs[rkey][0], g.n, W)
+            # XLA chunking depends on lane width; the pallas row padding
+            # does not — one prepped copy serves every W under the flag
+            pkey = ((rkey, "pallas") if pallas_enabled()
+                    else (rkey, W))
+            if pkey not in prep:
+                prep[pkey] = _prepare_buckets(devs[rkey][0], g.n, W)
         stage_descs = []
         for s in plan.stages:
-            _ells, perm_in, out_idx = devs[(s.attr, s.reverse)]
-            prepared = prep[((s.attr, s.reverse), W)]
+            rkey_s = (s.attr, s.reverse)
+            _ells, perm_in, out_idx = devs[rkey_s]
+            prepared = prep[(rkey_s, "pallas") if pallas_enabled()
+                            else (rkey_s, W)]
             stage_descs.append({
                 "kind": s.kind, "prepared": prepared, "perm_in": perm_in,
                 "out_idx": out_idx, "parent": s.parent,

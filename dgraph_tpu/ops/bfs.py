@@ -223,10 +223,33 @@ def _prepare_buckets(ells, n: int, W: int):
     whose nominal gather intermediate fits GATHER_BUDGET stay flat;
     larger ones are padded + reshaped to [nch, ch, K] ONCE, eagerly (one
     device array — the jitted program must not carry both the original
-    and a padded copy as constants)."""
+    and a padded copy as constants). Under DGRAPH_TPU_PALLAS=1, every
+    bucket is instead row-padded for the Pallas DMA-ring hop
+    (ops/pallas_hop.py) — which streams rows through VMEM and has no
+    gather intermediate to budget."""
+    import os
+    use_pallas = os.environ.get("DGRAPH_TPU_PALLAS", "") == "1"
+    if use_pallas:
+        # import only under the flag: the default XLA path must not
+        # couple to the experimental pallas namespace
+        from dgraph_tpu.ops.pallas_hop import BLOCK_ROWS
     prepared = []
     for e in ells:
         n_b, K = e.shape
+        if use_pallas:
+            if n_b == 0:
+                # empty degree bucket: zero rows, zero work (the padded
+                # sentinel block would DMA-loop for nothing every hop)
+                prepared.append(("pallas", None, 0))
+                continue
+            padded = -(-n_b // BLOCK_ROWS) * BLOCK_ROWS
+            if padded == n_b:
+                e_p = jnp.asarray(e, jnp.int32)   # no copy when aligned
+            else:
+                pad = jnp.full((padded - n_b, K), n, jnp.int32)
+                e_p = jnp.concatenate([jnp.asarray(e, jnp.int32), pad])
+            prepared.append(("pallas", e_p, n_b))
+            continue
         row_bytes = max(K * W * 4, 1)
         if n_b * row_bytes <= GATHER_BUDGET:
             prepared.append(("flat", jnp.asarray(e), n_b))
@@ -243,10 +266,18 @@ def _prepare_buckets(ells, n: int, W: int):
 def _ell_hop(prepared, frontier, W):
     """next[v] = OR of frontier[u] over in-neighbors u — gathers only.
     Chunked buckets reduce row-slabs sequentially (lax.map) to bound the
-    intermediate where XLA's gather+reduce fusion gives up (~20G)."""
+    intermediate where XLA's gather+reduce fusion gives up (~20G);
+    "pallas" buckets ride the explicit DMA-ring kernel instead of the
+    XLA gather (ops/pallas_hop.py)."""
     parts = []
     for kind, e, n_b in prepared:
-        if kind == "flat":
+        if kind == "pallas":
+            if n_b == 0:
+                parts.append(jnp.zeros((0, W), jnp.uint32))
+                continue
+            from dgraph_tpu.ops.pallas_hop import bucket_hop_pallas
+            parts.append(bucket_hop_pallas(e, frontier)[:n_b])
+        elif kind == "flat":
             parts.append(lax.reduce(frontier[e], jnp.uint32(0),
                                     lax.bitwise_or, (1,)))
         else:

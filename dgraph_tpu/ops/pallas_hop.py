@@ -1,0 +1,130 @@
+"""Pallas TPU kernel: blocked ELL pull-hop with an explicit DMA prefetch ring.
+
+Reference parity: this is the hot loop of every traversal — the role
+`posting.List.Uids` + `codec` block decoding play per-uid in the
+reference (SURVEY §3.1 🔥 marks), batched over lane-packed queries.
+
+Why a hand-written kernel (BASELINE.md headroom note): the XLA form of
+the hop (`ops/bfs.py _ell_hop`) is a gather + OR-reduce whose measured
+throughput is ~12% of HBM peak — the random 512-byte row reads are
+LATENCY-bound, not bandwidth-bound. XLA's gather bounds its outstanding
+reads; this kernel controls the pipeline explicitly: an N_BUF-deep ring
+of async row DMAs (HBM → VMEM) stays in flight while the VPU ORs the
+rows that already landed, so row latency amortizes across the ring
+depth instead of serializing.
+
+Structure per grid step (one block of output rows):
+  nbr block  [BR, K] int32   streamed to VMEM by the pallas pipeline
+  frontier   [n+1, W] uint32 stays in HBM; rows DMA'd on demand
+  out block  [BR, W] uint32  accumulated in VMEM, written back once
+The flat edge loop issues the DMA for edge t+N_BUF before waiting on
+edge t — the "prefetch pipelining" BASELINE.md names as the remaining
+headroom. K is static per bucket (EllGraph's degree buckets), so each
+bucket compiles its own specialization.
+
+The kernel is correctness-tested on CPU via the pallas interpreter;
+its perf claim is measured on hardware by `bench.py` under
+DGRAPH_TPU_PALLAS=1 (see BASELINE.md).
+
+MOSAIC CAVEAT (why the flag stays off by default): the DMA addresses
+are data-dependent scalar reads from the VMEM nbr block; the canonical
+TPU pattern routes such indices through SMEM scalar prefetch. The first
+real-TPU compile must be smoke-tested before any hardware A/B (the
+chip tunnel was wedged for the whole round this kernel landed in —
+BASELINE.md tracks the pending on-silicon validation).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["bucket_hop_pallas", "pallas_enabled"]
+
+BLOCK_ROWS = 256     # output rows per grid step
+N_BUF = 16           # DMA ring depth (rows in flight)
+
+
+def pallas_enabled() -> bool:
+    """Opt-in flag: the Pallas hop replaces the XLA gather hop when
+    DGRAPH_TPU_PALLAS=1 (kept opt-in until the on-silicon A/B in
+    BASELINE.md says it wins by default)."""
+    import os
+    return os.environ.get("DGRAPH_TPU_PALLAS", "") == "1"
+
+
+def _interpret() -> bool:
+    # CPU/virtual-device runs (tests, dryruns) use the interpreter;
+    # Mosaic compiles only on real TPU backends
+    return jax.default_backend() != "tpu"
+
+
+def _make_kernel(K: int, W: int, n_buf: int):
+    def kernel(nbr_ref, frontier_ref, out_ref, rows, sems):
+        br = nbr_ref.shape[0]
+        total = br * K
+
+        def dma(t, slot):
+            idx = nbr_ref[t // K, t % K]
+            return pltpu.make_async_copy(
+                frontier_ref.at[pl.ds(idx, 1), :],
+                rows.at[slot], sems.at[slot])
+
+        out_ref[:] = jnp.zeros_like(out_ref)
+        # warm the ring (total = BR*K is static, python-level guard)
+        for s in range(min(n_buf, total)):
+            dma(s, s).start()
+
+        def body(t, _):
+            slot = t % n_buf
+            dma(t, slot).wait()
+            i = t // K
+            out_ref[i, :] = out_ref[i, :] | rows[slot, 0, :]
+
+            @pl.when(t + n_buf < total)
+            def _():
+                # reuse the slot just freed: the ring stays n_buf deep
+                dma(t + n_buf, slot).start()
+            return 0
+
+        lax.fori_loop(0, total, body, 0)
+
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnames=("block_rows", "n_buf"))
+def bucket_hop_pallas(nbr: jax.Array, frontier: jax.Array,
+                      block_rows: int = BLOCK_ROWS,
+                      n_buf: int = N_BUF) -> jax.Array:
+    """One ELL bucket's pull-hop: out[i] = OR_k frontier[nbr[i, k]].
+
+    `nbr` is [n_b, K] int32 (rows padded with the sentinel row index —
+    frontier's last, all-zero row); n_b must be a multiple of
+    `block_rows` (ops/bfs.py pads buckets at prepare time). `frontier`
+    is [n+1, W] uint32 and never leaves HBM — only the referenced rows
+    move, through the DMA ring."""
+    n_b, K = nbr.shape
+    W = frontier.shape[1]
+    assert n_b % block_rows == 0, (n_b, block_rows)
+    return pl.pallas_call(
+        _make_kernel(K, W, n_buf),
+        out_shape=jax.ShapeDtypeStruct((n_b, W), jnp.uint32),
+        grid=(n_b // block_rows,),
+        in_specs=[
+            pl.BlockSpec((block_rows, K), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pl.ANY),        # frontier: HBM
+        ],
+        out_specs=pl.BlockSpec((block_rows, W), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((n_buf, 1, W), jnp.uint32),    # landed rows
+            pltpu.SemaphoreType.DMA((n_buf,)),
+        ],
+        interpret=_interpret(),
+    )(nbr, frontier)
