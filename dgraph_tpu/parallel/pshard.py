@@ -102,6 +102,75 @@ def device_put_rel(srel: ShardedRel, mesh: Mesh) -> ShardedRel:
     )
 
 
+def assemble_sharded_rel(mesh: Mesh, n_nodes: int,
+                         local_shards: dict) -> ShardedRel:
+    """Build a GLOBAL ShardedRel from per-process LOCAL tablet slabs —
+    the multi-host deployment shape (reference: each Alpha holds only
+    its group's tablets; SURVEY §2.3 tablet row). Unlike device_put_rel,
+    no process ever materializes the whole relation: process p provides
+    `local_shards[d] = (indptr_local [R+1] int32, indices [nnz_d] int32)`
+    ONLY for the shard ids d whose devices it hosts, and the global
+    array is stitched with jax.make_array_from_single_device_arrays.
+
+    Shard shapes must agree across processes, so the edge capacity (max
+    shard nnz) and the foreign pos_lo values are exchanged with one
+    host-level allgather — the only cross-host metadata traffic; edge
+    data itself never moves."""
+    devices = list(mesh.devices.reshape(-1))
+    D = len(devices)
+    rows = -(-n_nodes // D) if n_nodes else 1
+    local_ids = [d for d, dev in enumerate(devices)
+                 if dev.process_index == jax.process_index()]
+    assert set(local_shards) == set(local_ids), (
+        sorted(local_shards), local_ids)
+
+    # agree on capacity + absolute edge-position bases across processes:
+    # one [D] nnz vector, merged by elementwise max (foreign entries 0).
+    # Gated on FOREIGN SHARDS EXISTING, not process_count(): a fully
+    # local mesh inside a multi-process runtime must not drag unrelated
+    # processes into a collective (host_np's is_fully_addressable rule)
+    nnz = np.zeros(D, np.int64)
+    for d, (_ptr, idx) in local_shards.items():
+        nnz[d] = len(idx)
+    if len(local_ids) < D:
+        from jax.experimental import multihost_utils
+        nnz = np.asarray(multihost_utils.process_allgather(nnz))
+        nnz = nnz.reshape(-1, D).max(axis=0)
+    cap = max(int(nnz.max()), 1)
+    pos_lo = np.concatenate([[0], np.cumsum(nnz[:-1])]).astype(np.int64)
+    row_lo = np.minimum(np.arange(D) * rows, n_nodes).astype(np.int32)
+
+    sh = shard_leading(mesh)
+
+    def stitch(shape, dtype, per_shard):
+        parts = []
+        for d in local_ids:
+            arr = np.zeros((1,) + shape[1:], dtype)
+            per_shard(d, arr)
+            parts.append(jax.device_put(arr, devices[d]))
+        return jax.make_array_from_single_device_arrays(
+            shape, sh, parts)
+
+    def fill_ptr(d, out):
+        out[0, :] = local_shards[d][0]
+
+    def fill_idx(d, out):
+        idx = local_shards[d][1]
+        out[0, :] = SENTINEL32
+        out[0, :len(idx)] = idx
+
+    def fill_lo(d, out):
+        out[0] = row_lo[d]
+
+    return ShardedRel(
+        indptr_s=stitch((D, rows + 1), np.int32, fill_ptr),
+        indices_s=stitch((D, cap), np.int32, fill_idx),
+        row_lo=stitch((D,), np.int32, fill_lo),
+        n_nodes=n_nodes,
+        pos_lo=pos_lo,
+    )
+
+
 def shard_frontier(frontier: np.ndarray, n_shards: int, f_cap: int) -> np.ndarray:
     """Split a frontier into [D, f_cap] sentinel-padded chunks for ring hops.
 
