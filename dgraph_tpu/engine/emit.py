@@ -189,6 +189,12 @@ def _lower_leaf(ex, leaf, dom: np.ndarray, keep: list):
     if ps and ps.kind == Kind.PASSWORD:
         return None  # hashes never render (reference semantics)
     is_list = bool(ps and ps.is_list)
+    name0 = leaf.alias or (
+        f"{leaf.attr}@{leaf.lang}" if leaf.lang else leaf.attr)
+    if not leaf.lang and not is_list:
+        fast = _int_col_frags(store, leaf.attr, dom)
+        if fast is not None:
+            return _frag_leaf(name0, fast, keep)
     vmap = store.values_for_many(leaf.attr, dom, leaf.lang)
     frags = [""] * n
     for i, rk in enumerate(dom.tolist()):
@@ -199,9 +205,36 @@ def _lower_leaf(ex, leaf, dom: np.ndarray, keep: list):
             frags[i] = "[" + ",".join(_enc(_json_val(v)) for v in vs) + "]"
         else:
             frags[i] = _enc(_json_val(vs[0]))
-    name = leaf.alias or (
-        f"{leaf.attr}@{leaf.lang}" if leaf.lang else leaf.attr)
-    return _frag_leaf(name, frags, keep)
+    return _frag_leaf(name0, frags, keep)
+
+
+def _int_col_frags(store, attr: str, dom: np.ndarray):
+    """Vectorized fragment fast path for a single-valued untagged int
+    column (creation_ts, birthday_year — the hot render leaves of the
+    LDBC mix): one searchsorted pair + one numpy int→str conversion
+    replaces the per-node dict build and per-value json.dumps. Returns
+    None when the column shape needs the generic path."""
+    pd = store.preds.get(attr)
+    if pd is None:
+        return [""] * len(dom)
+    if list(pd.vals) != [""]:
+        return None
+    col = pd.vals[""]
+    if col.vals.dtype.kind != "i":
+        return None
+    if not len(dom):
+        return []
+    lo = np.searchsorted(col.subj, dom, "left")
+    hi = np.searchsorted(col.subj, dom, "right")
+    if len(col.subj) and int((hi - lo).max()) > 1:
+        return None  # multi-valued rows despite non-list schema
+    hit = hi > lo
+    frags = [""] * len(dom)
+    if hit.any():
+        strs = col.vals[lo[hit]].astype(np.str_).tolist()
+        for i, s in zip(np.nonzero(hit)[0].tolist(), strs):
+            frags[i] = s
+    return frags
 
 
 def _frag_leaf(name: str, frags: list[str], keep: list):

@@ -26,7 +26,8 @@ from dataclasses import dataclass, field
 import numpy as np
 
 from dgraph_tpu import ops
-from dgraph_tpu.engine.funcs import EMPTY, eval_func
+from dgraph_tpu.engine.funcs import (EMPTY, eval_func,
+                                     eval_func_universe)
 from dgraph_tpu.engine.ir import FilterNode, FuncNode, Order, SubGraph
 from dgraph_tpu.store.store import Store
 from dgraph_tpu.store.types import Kind
@@ -248,10 +249,17 @@ class Executor:
     # -- filters ------------------------------------------------------------
     def apply_filter(self, tree: FilterNode | None, universe: np.ndarray) -> np.ndarray:
         """Evaluate a filter tree restricted to `universe` (sorted ranks).
-        Reference: filter SubGraphs + algo.IntersectSorted/Difference."""
+        Reference: filter SubGraphs + algo.IntersectSorted/Difference.
+        Comparison/has leaves evaluate AGAINST the universe (cost tracks
+        the frontier); other funcs materialize their set and intersect."""
         if tree is None:
             return universe
         if tree.op == "leaf":
+            f = tree.func
+            if f.name != "uid" and not f.is_val_var and not f.is_count:
+                sub = eval_func_universe(self.store, f, universe)
+                if sub is not None:
+                    return sub
             return np.intersect1d(universe, self._leaf_set(tree.func, universe))
         if tree.op == "not":
             return np.setdiff1d(universe, self.apply_filter(tree.children[0], universe))

@@ -204,6 +204,69 @@ def _scan(store: Store, f: FuncNode, predicate_fn) -> np.ndarray:
     return np.unique(np.concatenate(hits)).astype(np.int32)
 
 
+def _scan_universe(store: Store, f: FuncNode, predicate_fn,
+                   universe: np.ndarray) -> np.ndarray:
+    """_scan restricted to a sorted candidate rank set: each column's
+    candidate rows are selected by searchsorted (columns are
+    subject-sorted) BEFORE the predicate runs — O(|universe| log |col|)
+    instead of O(|col|). This is what makes child-level @filter cost
+    track the frontier, not the whole predicate (reference: filter
+    SubGraphs evaluate against the parent's uid list, never the full
+    tablet)."""
+    hits = []
+    for col in _columns(store, f):
+        if not len(col.subj) or not len(universe):
+            continue
+        lo = np.searchsorted(col.subj, universe, "left")
+        hi = np.searchsorted(col.subj, universe, "right")
+        counts = (hi - lo).astype(np.int64)
+        total = int(counts.sum())
+        if not total:
+            continue
+        base = np.repeat(np.cumsum(counts) - counts, counts)
+        rows = (np.repeat(lo.astype(np.int64), counts)
+                + np.arange(total) - base)
+        mask = predicate_fn(col.vals[rows])
+        if mask.any():
+            hits.append(col.subj[rows[np.asarray(mask, bool)]])
+    if not hits:
+        return EMPTY
+    return np.unique(np.concatenate(hits)).astype(np.int32)
+
+
+def eval_func_universe(store: Store, f: FuncNode,
+                       universe: np.ndarray) -> np.ndarray | None:
+    """Evaluate a filter function AGAINST a sorted candidate set where
+    that is cheaper than materializing the full match set: comparisons,
+    non-indexed eq, and has() — the funcs whose full result can dwarf
+    the frontier (le(creation_ts, ...) matches half the messages; the
+    candidates number dozens). Returns the matching subset of
+    `universe` (sorted), or None → caller intersects the full set."""
+    name = f.name
+    if name in ("le", "lt", "ge", "gt", "between") and not f.is_count \
+            and not f.is_val_var:
+        return _scan_universe(store, f, _cmp_pred(store, f, name),
+                              universe)
+    if name == "has" and not f.args:
+        # degree / value-presence test per candidate — O(|universe|)
+        reverse = f.attr.startswith("~")
+        p = store.preds.get(f.attr.lstrip("~"))
+        if p is None:
+            return EMPTY
+        keep = np.zeros(len(universe), bool)
+        rel = p.rev if reverse else p.fwd
+        if rel is not None:
+            keep |= (rel.indptr[universe + 1]
+                     - rel.indptr[universe]) > 0
+        if not reverse:
+            for col in p.vals.values():
+                lo = np.searchsorted(col.subj, universe, "left")
+                hi = np.searchsorted(col.subj, universe, "right")
+                keep |= hi > lo
+        return universe[keep].astype(np.int32)
+    return None
+
+
 def _cmp_arrays(vals: np.ndarray, kind: Kind):
     if kind in (Kind.STRING, Kind.DEFAULT, Kind.PASSWORD):
         return vals.astype(str)
@@ -228,7 +291,10 @@ def _eq(store: Store, f: FuncNode) -> np.ndarray:
                                                 np.array(targets)))
 
 
-def _compare(store: Store, f: FuncNode, op: str) -> np.ndarray:
+def _cmp_pred(store: Store, f: FuncNode, op: str):
+    """The le/lt/ge/gt/between predicate closure — ONE builder shared by
+    the full-column scan and the universe-restricted path, so their
+    comparison semantics can never diverge."""
     kind = _schema_kind(store, f.attr)
     args = [convert(a, kind) for a in f.args]
 
@@ -245,7 +311,11 @@ def _compare(store: Store, f: FuncNode, op: str) -> np.ndarray:
             return v > a0
         return (v >= a0) & (v <= args[1])  # between
 
-    return _scan(store, f, pred)
+    return pred
+
+
+def _compare(store: Store, f: FuncNode, op: str) -> np.ndarray:
+    return _scan(store, f, _cmp_pred(store, f, op))
 
 
 def _count_compare(store: Store, f: FuncNode, op: str) -> np.ndarray:
