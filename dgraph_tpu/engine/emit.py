@@ -63,17 +63,44 @@ def to_json_bytes(ex, roots: list[LevelNode]) -> bytes:
 
 def _eligible(node: LevelNode) -> bool:
     sg = node.sg
-    if (node.groups is not None or node.recurse_data is not None
+    if node.recurse_data is not None:
+        return _recurse_eligible(node)
+    if (node.groups is not None
             or node.path_data is not None or sg.normalize or sg.cascade
             or sg.facet_keys is not None):
         return False
-    for leaf in node.leaf_sgs:
+    if not _leaves_eligible(node.leaf_sgs):
+        return False
+    return all(_eligible(child) for child in node.children)
+
+
+def _leaves_eligible(leaf_sgs) -> bool:
+    for leaf in leaf_sgs:
         if (leaf.is_agg or leaf.math_expr is not None
                 or leaf.checkpwd_val is not None or leaf.lang == "*"
                 or leaf.facet_keys is not None
                 or (leaf.is_count and leaf.is_uid_leaf)):
             return False
-    return all(_eligible(child) for child in node.children)
+    return True
+
+
+def _recurse_eligible(node: LevelNode) -> bool:
+    """loop=false @recurse lowers to a chain of per-depth levels (the
+    first-visit forest IS a level tree — outputnode's loop=false
+    semantics render each rank's global-matrix subtree wherever it
+    appears, and ranks partition by first-visit depth). loop=true and
+    facet/paginated edges keep the dict renderer."""
+    sg = node.sg
+    data = node.recurse_data
+    if (data.loop or sg.normalize or sg.cascade
+            or sg.facet_keys is not None):
+        return False
+    for e in data.edge_sgs:
+        if (e.facet_keys is not None or e.facet_orders
+                or e.facet_filter is not None or e.orders
+                or e.first or e.offset or e.after or e.children):
+            return False
+    return _leaves_eligible(data.leaf_sgs)
 
 
 def _emit_native(ex, node: LevelNode) -> bytes | None:
@@ -105,7 +132,111 @@ def _positions(dom: np.ndarray, ranks: np.ndarray) -> np.ndarray | None:
     return pos.astype(np.int32)
 
 
+def _edges_for(ps: np.ndarray, cs: np.ndarray, dom: np.ndarray):
+    """Edges whose (parent-sorted) parents fall in sorted `dom` →
+    (row_counts per dom position, child ranks grouped by dom position,
+    stored order preserved within each parent)."""
+    lo = np.searchsorted(ps, dom, "left")
+    hi = np.searchsorted(ps, dom, "right")
+    counts = (hi - lo).astype(np.int64)
+    total = int(counts.sum())
+    if not total:
+        return counts, np.zeros(0, cs.dtype)
+    base = np.repeat(np.cumsum(counts) - counts, counts)
+    rows = np.repeat(lo.astype(np.int64), counts) + np.arange(total) - base
+    return counts, cs[rows]
+
+
+def _lower_recurse(ex, node: LevelNode, keep: list, levels: list):
+    """loop=false RecurseData → chained DgLevels, one per first-visit
+    depth. Each rank's children in the global first-visit matrix link
+    only to next-depth ranks (freshness), so the chain reproduces the
+    dict renderer's memoized subtree semantics exactly. Every pred's
+    edge matrix is parent-sorted ONCE; each level then selects its slice
+    by searchsorted ranges (no per-depth full-matrix scans)."""
+    data = node.recurse_data
+    grouped = {}
+    for i in data.edges:
+        parents, childs = data.edges[i]
+        order = np.argsort(parents, kind="stable")  # keeps stored order
+        grouped[i] = (parents[order], childs[order])
+
+    # depth assignment: roots at 0; a fresh child's depth = parent + 1
+    seen: set[int] = {int(r) for r in node.nodes}
+    level_doms = [np.asarray(node.nodes, np.int32)]
+    while True:
+        parts = [_edges_for(ps, cs, level_doms[-1])[1]
+                 for ps, cs in grouped.values()]
+        parts = [p for p in parts if len(p)]
+        if not parts:
+            break
+        nxt = np.unique(np.concatenate(parts))
+        nxt = np.array([c for c in nxt.tolist() if c not in seen],
+                       np.int32)
+        if not len(nxt):
+            break
+        seen.update(nxt.tolist())
+        level_doms.append(nxt)
+
+    # build bottom-up so each level can point at the next
+    next_lvl = None
+    for h in range(len(level_doms) - 1, -1, -1):
+        dom = level_doms[h]
+        leaves = []
+        for leaf in data.leaf_sgs:
+            lowered = _lower_leaf(ex, leaf, dom, keep)
+            if lowered is not None:
+                leaves.append(lowered)
+        children = []
+        if next_lvl is not None:
+            ndom = level_doms[h + 1]
+            for i, esg in enumerate(data.edge_sgs):
+                if i not in grouped:
+                    continue
+                counts, c_h = _edges_for(*grouped[i], dom)
+                if not len(c_h):
+                    continue
+                indptr = np.concatenate(
+                    [[0], np.cumsum(counts)]).astype(np.int64)
+                pos = _positions(ndom, c_h)
+                if pos is None:
+                    return None
+                name = esg.alias or (
+                    f"~{esg.attr}" if esg.is_reverse else esg.attr)
+                key = _key(name, keep)
+                keep += [pos, indptr]
+                children.append(native.DgChild(
+                    key=_bp(key), key_len=len(key),
+                    level=ctypes.pointer(next_lvl),
+                    row_indptr=_vp(indptr), row_child=_vp(pos)))
+        next_lvl = _build_level(len(dom), leaves, children, keep, levels)
+    return next_lvl
+
+
+def _build_level(dom_len: int, leaves: list, children: list, keep: list,
+                 levels: list):
+    """Assemble one DgLevel from lowered leaves/children — the single
+    ctypes layout site shared by the plain and recurse lowerings."""
+    leaf_arr = (native.DgLeaf * len(leaves))(*leaves) if leaves else None
+    child_arr = (native.DgChild * len(children))(*children) if children \
+        else None
+    keep += [leaf_arr, child_arr]
+    lvl = native.DgLevel(
+        n=dom_len,
+        n_leaves=len(leaves),
+        leaves=ctypes.cast(leaf_arr, ctypes.POINTER(native.DgLeaf))
+        if leaf_arr else None,
+        n_children=len(children),
+        children=ctypes.cast(child_arr, ctypes.POINTER(native.DgChild))
+        if child_arr else None,
+        level_id=len(levels))
+    levels.append(lvl)
+    return lvl
+
+
 def _lower_level(ex, node: LevelNode, keep: list, levels: list):
+    if node.recurse_data is not None:
+        return _lower_recurse(ex, node, keep, levels)
     dom = node.nodes
     leaves = []
     for leaf in node.leaf_sgs:
@@ -127,21 +258,7 @@ def _lower_level(ex, node: LevelNode, keep: list, levels: list):
         children.append(native.DgChild(
             key=_bp(key), key_len=len(key), level=ctypes.pointer(clevel),
             row_indptr=_vp(indptr), row_child=_vp(row_child)))
-    leaf_arr = (native.DgLeaf * len(leaves))(*leaves) if leaves else None
-    child_arr = (native.DgChild * len(children))(*children) if children \
-        else None
-    keep += [leaf_arr, child_arr]
-    lvl = native.DgLevel(
-        n=len(dom),
-        n_leaves=len(leaves),
-        leaves=ctypes.cast(leaf_arr, ctypes.POINTER(native.DgLeaf))
-        if leaf_arr else None,
-        n_children=len(children),
-        children=ctypes.cast(child_arr, ctypes.POINTER(native.DgChild))
-        if child_arr else None,
-        level_id=len(levels))
-    levels.append(lvl)
-    return lvl
+    return _build_level(len(dom), leaves, children, keep, levels)
 
 
 def _row_map(child: LevelNode, n_parent: int):
