@@ -167,8 +167,15 @@ def _edge_weights(store, ex, esg, nbrs: np.ndarray, pos: np.ndarray,
     weight 1, per edge, independent of what else is in the batch."""
     if not wkey or not len(pos):
         return np.ones(len(nbrs))
-    fvals = store.edge_facets(esg.attr, ex.facet_positions(esg, pos),
-                              [wkey]).get(wkey)
+    fpos = ex.facet_positions(esg, pos)
+    p = store.preds.get(esg.attr)
+    col = p.efacets.get(wkey) if p is not None else None
+    if col is not None:
+        fast = col.numeric_at(np.asarray(fpos, np.int64))
+        if fast is not None:
+            vals, hit = fast
+            return np.where(hit, vals, 1.0)
+    fvals = store.edge_facets(esg.attr, fpos, [wkey]).get(wkey)
     if fvals is None:
         return np.ones(len(nbrs))
     arr = np.asarray(fvals)

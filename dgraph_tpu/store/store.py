@@ -109,14 +109,38 @@ class FacetCol:
     pos: np.ndarray   # sorted int64 positions into fwd.indices
     vals: np.ndarray  # object array of facet values
 
-    def get(self, positions: np.ndarray) -> list:
-        """Facet values at edge positions; None where absent."""
+    def _locate(self, positions: np.ndarray):
+        """(clamped indexes, hit mask) for edge positions — the one
+        sorted-position lookup both accessors share."""
         idx = np.searchsorted(self.pos, positions)
         idx_c = np.minimum(idx, max(len(self.pos) - 1, 0))
         hit = (len(self.pos) > 0) & (self.pos[idx_c] == positions)
+        return np.atleast_1d(idx_c), np.atleast_1d(hit)
+
+    def get(self, positions: np.ndarray) -> list:
+        """Facet values at edge positions; None where absent."""
+        idx_c, hit = self._locate(positions)
         return [self.vals[i] if h else None
-                for i, h in zip(np.atleast_1d(idx_c).tolist(),
-                                np.atleast_1d(hit).tolist())]
+                for i, h in zip(idx_c.tolist(), hit.tolist())]
+
+    def numeric_at(self, positions: np.ndarray):
+        """(values float64, hit mask) at edge positions — the vectorized
+        form weighted shortest-path relaxation batches over (reference:
+        the weight facet read per relaxed edge). None unless EVERY value
+        is genuinely numeric (bool/int/float — numeric STRINGS must not
+        parse here: the per-value path treats them as weight 1, and the
+        two paths must agree). The float cast computes once."""
+        if not hasattr(self, "_num"):
+            if all(isinstance(v, (bool, int, float, np.integer,
+                                  np.floating, np.bool_))
+                   for v in self.vals):
+                self._num = self.vals.astype(np.float64)
+            else:
+                self._num = None
+        if self._num is None or not len(self.pos):
+            return None
+        idx_c, hit = self._locate(positions)
+        return self._num[idx_c], hit
 
 
 @dataclass
