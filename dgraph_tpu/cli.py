@@ -185,8 +185,12 @@ def cmd_zero(args) -> int:
         from dgraph_tpu.cluster.zero import run_standby
 
         def standby_loop():
+            peers = [a for a in (args.standby_peers or "").split(",")
+                     if a]
             if run_standby(state, args.peer,
-                           promote_after_s=args.promote_after):
+                           promote_after_s=args.promote_after,
+                           peers=peers, my_addr=f"127.0.0.1:{args.port}",
+                           require_quorum=args.election_quorum):
                 log.warning("primary %s unreachable %.1fs — PROMOTED; "
                             "now serving leases", args.peer,
                             args.promote_after)
@@ -366,6 +370,16 @@ def main(argv=None) -> int:
     p.add_argument("--promote_after", type=float, default=5.0,
                    help="standby promotes after the primary is dark "
                         "this long")
+    p.add_argument("--standby_peers", default="",
+                   help="comma-separated OTHER standby addresses: on "
+                        "primary failure the most caught-up standby "
+                        "wins the election (highest applied journal "
+                        "index), the rest re-target it")
+    p.add_argument("--election_quorum", action="store_true",
+                   help="require a majority of the standby electorate "
+                        "reachable before promoting (raft's consistency "
+                        "choice: partitioned standbys defer instead of "
+                        "dual-promoting)")
     p.add_argument("--liveness", type=float, default=10.0,
                    help="mark an alpha dead after this many seconds "
                         "without a heartbeat (0 = off)")

@@ -651,35 +651,53 @@ def rebalance_once(state: ZeroState) -> bool:
     return move_tablet(state, pred, dst)
 
 
-def elect_better(state: ZeroState, my_addr: str, peers) -> str | None:
+# election outcome when require_quorum is set and too few standbys are
+# reachable: the caller must NOT promote (consistency over availability)
+NO_QUORUM = object()
+
+
+def elect_better(state: ZeroState, my_addr: str, peers,
+                 require_quorum: bool = False):
     """Highest-acked-index election among standbys (reference: raft's
     up-to-date-log vote rule, collapsed to a deterministic comparison):
     returns the address of a peer strictly ahead of this standby under
     (applied journal seq, addr) ordering — that peer should promote
-    instead — or None when THIS standby wins. A reachable peer that
-    already promoted wins outright. Unreachable peers don't vote: the
-    election trades a vote quorum for reachability (a standby cut off
-    from every other standby still promotes; log-identity divergence
-    stays operator-visible via log_id)."""
+    instead — None when THIS standby wins, or NO_QUORUM. A reachable
+    peer that already promoted wins outright.
+
+    Default (require_quorum=False): unreachable peers don't vote — a
+    standby cut off from every other standby still promotes, trading
+    raft's vote quorum for availability; log-identity divergence stays
+    operator-visible via log_id. With require_quorum=True the raft
+    trade is made instead: promotion needs a MAJORITY of the standby
+    electorate (self + peers) reachable, so standbys partitioned from
+    each other defer (NO_QUORUM) rather than dual-promote."""
     my_seq = state._doc_base + len(state.doc_log)
     best = None
+    reachable = 1                     # self
     for addr in peers:
         try:
             docs_, nxt, standby, _lid = ZeroClient(addr).journal_tail_full(
                 0, peek=True)
         except grpc.RpcError:
             continue
+        reachable += 1
         if not standby:
             return addr               # someone already took over
         if (nxt, addr) > (my_seq, my_addr) and \
                 (best is None or (nxt, addr) > best):
             best = (nxt, addr)
-    return best[1] if best else None
+    if best:
+        return best[1]
+    if require_quorum and reachable < (len(peers) + 1) // 2 + 1:
+        return NO_QUORUM
+    return None
 
 
 def run_standby(state: ZeroState, primary_addr: str, poll_s: float = 1.0,
                 promote_after_s: float = 5.0, stop_event=None,
-                peers=(), my_addr: str = "") -> bool:
+                peers=(), my_addr: str = "",
+                require_quorum: bool = False) -> bool:
     """Standby loop: tail the primary's state-machine journal into
     `state`; when the primary stays unreachable past `promote_after_s`,
     run the highest-acked-index election over `peers` (other standby
@@ -714,17 +732,25 @@ def run_standby(state: ZeroState, primary_addr: str, poll_s: float = 1.0,
             last_ok = _time.monotonic()
         except grpc.RpcError:
             if _time.monotonic() - last_ok > promote_after_s:
-                winner = elect_better(state, my_addr, peers)
-                if winner is None:
+                winner = elect_better(state, my_addr, peers,
+                                      require_quorum=require_quorum)
+                if winner is NO_QUORUM:
+                    # too few standbys reachable to vote safely: defer
+                    # and retry next poll (raft's consistency choice)
+                    from dgraph_tpu.utils import logging as xlog
+                    xlog.get("zero").warning(
+                        "election deferred: standby quorum unreachable")
+                elif winner is None:
                     state.promote()
                     return True
-                # a more caught-up standby exists: it promotes, this one
-                # keeps tailing FROM it (same journal lineage, log_id
-                # unchanged through promotion)
-                primary_addr = winner
-                client = ZeroClient(winner)
-                since = state._doc_base + len(state.doc_log)
-                last_ok = _time.monotonic()
+                else:
+                    # a more caught-up standby exists: it promotes, this
+                    # one keeps tailing FROM it (same journal lineage,
+                    # log_id unchanged through promotion)
+                    primary_addr = winner
+                    client = ZeroClient(winner)
+                    since = state._doc_base + len(state.doc_log)
+                    last_ok = _time.monotonic()
         except Exception:  # noqa: BLE001 — a malformed doc must not kill
             # the standby thread silently (failover would be lost with no
             # log line); resync the replica from zero and keep tailing.

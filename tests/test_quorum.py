@@ -306,6 +306,61 @@ def test_replica_restart_recovers_wal(tmp_path):
         s.stop(None)
 
 
+def test_election_quorum_defers_when_peers_unreachable():
+    """require_quorum=True: a standby that cannot reach a majority of
+    the standby electorate DEFERS instead of promoting (raft's
+    consistency choice — no dual-promote under a standby partition);
+    promotion resumes once the electorate is reachable again."""
+    pserver, pport, pstate = make_zero_server()
+    pserver.start()
+    ptarget = f"127.0.0.1:{pport}"
+    # journal growth so s1 (fully replicated) outranks s2 by SEQ, not
+    # by address-ordering luck
+    zc = ZeroClient(ptarget)
+    zc.connect("127.0.0.1:7878", 1)
+    zc.should_serve("a", 1)
+
+    s1 = ZeroState()
+    s1server, s1port, _ = make_zero_server(s1)
+    s1.standby = True
+    s1server.start()
+    s1target = f"127.0.0.1:{s1port}"
+    docs, _n = pstate.journal_tail(0)
+    s1.apply_remote(docs)
+    # the peer standby exists but its server is NOT up yet
+    s2 = ZeroState()
+    s2.standby = True
+    with __import__("socket").socket() as sk:
+        sk.bind(("127.0.0.1", 0))
+        s2port = sk.getsockname()[1]
+    s2target = f"127.0.0.1:{s2port}"
+
+    stop = threading.Event()
+    out = {}
+
+    def standby():
+        out["r"] = run_standby(s1, ptarget, poll_s=0.05,
+                               promote_after_s=0.2, stop_event=stop,
+                               peers=[s2target], my_addr=s1target,
+                               require_quorum=True)
+
+    t = threading.Thread(target=standby, daemon=True)
+    t.start()
+    pserver.stop(None)                 # primary dies
+    time.sleep(1.2)                    # several election attempts
+    try:
+        assert s1.standby, "must defer without an electorate majority"
+        # peer standby comes up: electorate reachable, s1 wins by seq
+        s2server, _port2, _ = make_zero_server(s2, addr=s2target)
+        s2server.start()
+        t.join(timeout=15)
+        assert out.get("r") is True and not s1.standby
+        s2server.stop(None)
+    finally:
+        stop.set()
+        s1server.stop(None)
+
+
 def test_delay_injection_slows_but_does_not_fail(trio):
     (a0, _, addr0), (a1, _, addr1), (a2, _, addr2) = trio
     a0.groups.delay_link(addr1, 0.2)
