@@ -184,13 +184,23 @@ def cmd_zero(args) -> int:
         # stays dark (reference: group-0 follower + failover)
         from dgraph_tpu.cluster.zero import run_standby
 
+        # elections are SAFE BY DEFAULT: with standby peers configured,
+        # promotion needs a majority of the electorate reachable
+        # (require_quorum=None → auto-on in run_standby); availability
+        # mode is an explicit opt-out that run_standby logs loudly
+        require_quorum = None
+        if args.election_availability:
+            require_quorum = False
+        elif args.election_quorum:
+            require_quorum = True
+
         def standby_loop():
             peers = [a for a in (args.standby_peers or "").split(",")
                      if a]
             if run_standby(state, args.peer,
                            promote_after_s=args.promote_after,
                            peers=peers, my_addr=f"127.0.0.1:{args.port}",
-                           require_quorum=args.election_quorum):
+                           require_quorum=require_quorum):
                 log.warning("primary %s unreachable %.1fs — PROMOTED; "
                             "now serving leases", args.peer,
                             args.promote_after)
@@ -377,9 +387,14 @@ def main(argv=None) -> int:
                         "index), the rest re-target it")
     p.add_argument("--election_quorum", action="store_true",
                    help="require a majority of the standby electorate "
-                        "reachable before promoting (raft's consistency "
-                        "choice: partitioned standbys defer instead of "
-                        "dual-promoting)")
+                        "reachable before promoting. This is already "
+                        "the DEFAULT whenever --standby_peers is set; "
+                        "the flag remains for explicitness")
+    p.add_argument("--election_availability", action="store_true",
+                   help="OPT OUT of quorum elections: a standby cut "
+                        "off from the whole electorate still promotes "
+                        "(raft's availability trade — a symmetric "
+                        "partition can dual-promote; logged loudly)")
     p.add_argument("--liveness", type=float, default=10.0,
                    help="mark an alpha dead after this many seconds "
                         "without a heartbeat (0 = off)")

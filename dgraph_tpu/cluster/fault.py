@@ -73,6 +73,7 @@ class FaultyGroups:
 
     def heal_link(self, addr: str) -> None:
         self._dropped.discard(addr)
+        self._delay_s.pop(addr, None)  # a healed link runs at full speed
         # the real pool may hold a channel poisoned by earlier failures
         self._inner.invalidate(addr)
 
@@ -98,3 +99,70 @@ class FaultyGroups:
 
     def __getattr__(self, name):
         return getattr(self._inner, name)
+
+
+class FaultSchedule:
+    """Seeded randomized drop/heal/delay events over every DIRECTED link
+    of a replica group — the nemesis of a Jepsen-shaped exploration
+    (SURVEY §5: the reference leans on external Jepsen runs; the
+    fuzzing harness in tests/test_partition_fuzz.py drives this).
+
+    Deterministic per seed: the same seed regenerates the exact fault
+    sequence, so a failing run replays bit-for-bit
+    (DGRAPH_TPU_FUZZ_SEED=<seed>). Events are (op, src, dst, seconds)
+    over node INDICES; `apply_event` maps them onto each node's
+    FaultyGroups wrapper and tracks the current drop set so tests can
+    ask which nodes are minority-isolated."""
+
+    def __init__(self, seed: int, n_nodes: int, steps: int = 8,
+                 max_delay_s: float = 0.03):
+        import random
+        self.seed = seed
+        self.n_nodes = n_nodes
+        self.dropped: set[tuple[int, int]] = set()
+        rng = random.Random(seed)
+        links = [(i, j) for i in range(n_nodes) for j in range(n_nodes)
+                 if i != j]
+        self.events: list[tuple[str, int, int, float]] = []
+        for _ in range(steps):
+            src, dst = rng.choice(links)
+            r = rng.random()
+            if r < 0.40:
+                self.events.append(("drop", src, dst, 0.0))
+            elif r < 0.70:
+                self.events.append(("heal", src, dst, 0.0))
+            else:
+                self.events.append(("delay", src, dst,
+                                    round(rng.uniform(0.002,
+                                                      max_delay_s), 4)))
+
+    def __repr__(self) -> str:
+        return (f"FaultSchedule(seed={self.seed}, "
+                f"n_nodes={self.n_nodes}, events={self.events})")
+
+    def apply_event(self, ev: tuple[str, int, int, float],
+                    faulty_groups, addrs) -> None:
+        """Apply one event; `faulty_groups[i]` is node i's FaultyGroups
+        wrapper, `addrs[i]` its address."""
+        op, src, dst, secs = ev
+        fg = faulty_groups[src]
+        if op == "drop":
+            fg.drop_link(addrs[dst])
+            self.dropped.add((src, dst))
+        elif op == "heal":
+            fg.heal_link(addrs[dst])
+            self.dropped.discard((src, dst))
+        else:
+            fg.delay_link(addrs[dst], secs)
+
+    def heal_all(self, faulty_groups) -> None:
+        for fg in faulty_groups:
+            fg.heal_all()
+        self.dropped.clear()
+
+    def isolated(self, i: int) -> bool:
+        """True when node i currently reaches NO peer: its commits must
+        refuse with NoQuorum and its reads with ReadUnavailable (the
+        minority side of the partition)."""
+        return all((i, j) in self.dropped
+                   for j in range(self.n_nodes) if j != i)

@@ -80,6 +80,16 @@ class Groups:
                     return nodes[node_id]
         return None
 
+    def node_of_addr(self, addr: str) -> int | None:
+        """Node id at an address (the read gate tracks chains per ORIGIN
+        node id; an unreachable peer's id comes from membership)."""
+        with self._lock:
+            for nodes in self._groups.values():
+                for nid, a in nodes.items():
+                    if a == addr:
+                        return nid
+        return None
+
     def other_addrs(self) -> list[str]:
         """Every node in the cluster except this one (broadcast targets).
         Always re-polls membership first: a commit must reach nodes that

@@ -665,13 +665,24 @@ def elect_better(state: ZeroState, my_addr: str, peers,
     instead — None when THIS standby wins, or NO_QUORUM. A reachable
     peer that already promoted wins outright.
 
-    Default (require_quorum=False): unreachable peers don't vote — a
-    standby cut off from every other standby still promotes, trading
-    raft's vote quorum for availability; log-identity divergence stays
-    operator-visible via log_id. With require_quorum=True the raft
-    trade is made instead: promotion needs a MAJORITY of the standby
-    electorate (self + peers) reachable, so standbys partitioned from
-    each other defer (NO_QUORUM) rather than dual-promote."""
+    With require_quorum=False (availability mode): unreachable peers
+    don't vote — a standby cut off from every other standby still
+    promotes, trading raft's vote quorum for availability;
+    log-identity divergence stays operator-visible via log_id. With
+    require_quorum=True (the DEFAULT whenever run_standby has peers
+    configured) the raft trade is made instead: promotion needs a
+    MAJORITY of the standby electorate (self + peers) reachable, so
+    standbys partitioned from each other defer (NO_QUORUM) rather
+    than dual-promote.
+
+    Mixed-version `peek` hazard: the probe uses JournalTail(peek=true).
+    A peer running a build that predates the peek field ignores it and
+    serves journal_tail(0) WITH its side effects — the call refreshes
+    `_standby_seen_at`, so a probed PRIMARY would believe a standby is
+    attached and gate its lease issuance (lease_headroom_ok) until
+    STANDBY_GRACE_S lapses. since=0 never regresses the acked floor
+    (the ack only ratchets up), so safety holds — the cost is spurious
+    RESOURCE_EXHAUSTED retries during a mixed-version rollout."""
     my_seq = state._doc_base + len(state.doc_log)
     best = None
     reachable = 1                     # self
@@ -697,7 +708,7 @@ def elect_better(state: ZeroState, my_addr: str, peers,
 def run_standby(state: ZeroState, primary_addr: str, poll_s: float = 1.0,
                 promote_after_s: float = 5.0, stop_event=None,
                 peers=(), my_addr: str = "",
-                require_quorum: bool = False) -> bool:
+                require_quorum: bool | None = None) -> bool:
     """Standby loop: tail the primary's state-machine journal into
     `state`; when the primary stays unreachable past `promote_after_s`,
     run the highest-acked-index election over `peers` (other standby
@@ -706,10 +717,28 @@ def run_standby(state: ZeroState, primary_addr: str, poll_s: float = 1.0,
     collapses to the designated-successor behavior). Returns True when
     promoted, False when stopped externally.
 
+    require_quorum=None (default) resolves to SAFE-BY-DEFAULT: with an
+    electorate configured (peers non-empty), promotion requires a
+    majority of it reachable — a symmetric standby partition defers
+    instead of dual-promoting (raft's consistency choice). Availability
+    mode (require_quorum=False with peers) is an explicit opt-out and
+    logs loudly. A standby with NO peers keeps the designated-successor
+    behavior — there is no electorate to consult.
+
     A restarted standby resumes from its own replayed log length; a
     log-identity change (the primary restarted with a fresh log) resets
     the replica and resyncs from zero."""
     import time as _time
+    if require_quorum is None:
+        require_quorum = bool(peers)
+    elif peers and not require_quorum:
+        from dgraph_tpu.utils import logging as xlog
+        xlog.get("zero").warning(
+            "election AVAILABILITY mode (quorum opt-out): a symmetric "
+            "partition between standbys can DUAL-PROMOTE — two primaries "
+            "issuing from divergent lease spaces (split-brain). Quorum "
+            "elections are the default; this opt-out trades that safety "
+            "for promotion while the electorate is unreachable.")
     client = ZeroClient(primary_addr)
     since = state._doc_base + len(state.doc_log)
     expect_id = state.log_id or None

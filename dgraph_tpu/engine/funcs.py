@@ -241,12 +241,33 @@ def eval_func_universe(store: Store, f: FuncNode,
     non-indexed eq, and has() — the funcs whose full result can dwarf
     the frontier (le(creation_ts, ...) matches half the messages; the
     candidates number dozens). Returns the matching subset of
-    `universe` (sorted), or None → caller intersects the full set."""
-    name = f.name
-    if name in ("le", "lt", "ge", "gt", "between") and not f.is_count \
-            and not f.is_val_var:
+    `universe` (sorted), or None → caller intersects the full set.
+
+    Names fold case like eval_func does (the parser preserves the
+    query's spelling — an uppercase LE must not silently skip this
+    fast path). Index-answerable eq stays on the full path: the index
+    lookup is O(tokens), already cheaper than a universe scan."""
+    name = f.name.lower()
+    if f.is_count or f.is_val_var:
+        return None
+    if name in ("le", "lt", "ge", "gt", "between"):
         return _scan_universe(store, f, _cmp_pred(store, f, name),
                               universe)
+    if name == "eq":
+        kind = _schema_kind(store, f.attr)
+        ps = store.schema.peek(f.attr)
+        toks = ps.index_tokenizers if ps else ()
+        if not f.lang and kind in (Kind.STRING, Kind.DEFAULT) and \
+                ("exact" in toks or "hash" in toks):
+            return None  # indexed eq: _eq's O(lookup) wins
+        targets = [convert(a, kind) for a in f.args]
+        if kind == Kind.DATETIME:
+            targets = np.array(targets, "datetime64[us]")
+        tgt = np.array(targets)
+        return _scan_universe(
+            store, f,
+            lambda vals: np.isin(_cmp_arrays(vals, kind), tgt),
+            universe)
     if name == "has" and not f.args:
         # degree / value-presence test per candidate — O(|universe|)
         reverse = f.attr.startswith("~")

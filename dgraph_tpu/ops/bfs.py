@@ -263,20 +263,48 @@ def _prepare_buckets(ells, n: int, W: int):
     return prepared
 
 
+# Sticky fail-safe: the first bucket_hop_pallas that fails to trace or
+# compile flips this and every pallas bucket (this one included) falls
+# back to the XLA gather hop — an untested Mosaic compile must degrade
+# a perf experiment, never burn the serving path (or a chip window).
+_pallas_failed = False
+
+
+def _pallas_bucket_part(e, n_b, frontier):
+    """One pallas bucket's hop with XLA-gather fallback. The padded rows
+    index frontier's all-zero sentinel row, so the gather form is exact
+    on the same padded input; the fallback skips the chunked-budget
+    shape (this is a failure path, not the tuned one)."""
+    global _pallas_failed
+    if not _pallas_failed:
+        try:
+            from dgraph_tpu.ops.pallas_hop import bucket_hop_pallas
+            return bucket_hop_pallas(e, frontier)[:n_b]
+        except Exception:  # noqa: BLE001 — any trace/compile failure
+            _pallas_failed = True
+            from dgraph_tpu.utils import logging as xlog
+            xlog.get("ops").warning(
+                "pallas hop failed to trace/compile; falling back to "
+                "the XLA gather hop for every bucket (perf experiment "
+                "degraded, results unaffected)", exc_info=True)
+    return lax.reduce(frontier[e], jnp.uint32(0),
+                      lax.bitwise_or, (1,))[:n_b]
+
+
 def _ell_hop(prepared, frontier, W):
     """next[v] = OR of frontier[u] over in-neighbors u — gathers only.
     Chunked buckets reduce row-slabs sequentially (lax.map) to bound the
     intermediate where XLA's gather+reduce fusion gives up (~20G);
     "pallas" buckets ride the explicit DMA-ring kernel instead of the
-    XLA gather (ops/pallas_hop.py)."""
+    XLA gather (ops/pallas_hop.py), falling back to the gather if the
+    kernel fails to trace/compile (_pallas_bucket_part)."""
     parts = []
     for kind, e, n_b in prepared:
         if kind == "pallas":
             if n_b == 0:
                 parts.append(jnp.zeros((0, W), jnp.uint32))
                 continue
-            from dgraph_tpu.ops.pallas_hop import bucket_hop_pallas
-            parts.append(bucket_hop_pallas(e, frontier)[:n_b])
+            parts.append(_pallas_bucket_part(e, n_b, frontier))
         elif kind == "flat":
             parts.append(lax.reduce(frontier[e], jnp.uint32(0),
                                     lax.bitwise_or, (1,)))

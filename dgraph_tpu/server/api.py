@@ -30,7 +30,8 @@ from dgraph_tpu.store.schema import parse_schema
 from dgraph_tpu.store.store import Store
 from dgraph_tpu.store.types import Kind, hash_password
 
-__all__ = ["Alpha", "Txn", "TxnAborted", "NoQuorum"]
+__all__ = ["Alpha", "Txn", "TxnAborted", "NoQuorum", "ReadUnavailable",
+           "StageRefused"]
 
 
 class NoQuorum(Exception):
@@ -38,6 +39,25 @@ class NoQuorum(Exception):
     log the record (reference: a raft proposal that cannot commit on the
     minority side of a partition). The write was NOT applied locally and
     the client must not treat it as acknowledged."""
+
+
+class ReadUnavailable(Exception):
+    """Read refused, RETRYABLE: this replica cannot verify that its
+    snapshot at the read ts is gap-free (a group peer is unreachable and
+    the reachable side is a minority, or a known replication gap could
+    not be healed). The reference never hits this state — a raft
+    follower only serves what its replicated log proves — so the safe
+    answer is an explicit refusal, never a snapshot that never
+    existed."""
+
+
+class StageRefused(Exception):
+    """Commit-quorum stage refused: this node has no armed WAL, so its
+    ack would certify a durability it cannot provide (the coordinator
+    counts stage acks toward the DURABILITY majority — a memory-only ack
+    is a lie that loses acknowledged writes on crash). Real deployments
+    (Alpha.open / cli) always arm the WAL; tests opt in explicitly via
+    `allow_volatile_stage`."""
 
 GC_EVERY = 256  # timestamps between oracle/store gc sweeps
 
@@ -69,6 +89,21 @@ class Alpha:
         self._last_from: dict[int, int] = {}
         self._last_sent_ts = 0
         self._suspect_peers: dict[str, int] = {}
+        # detected-but-unhealed per-origin chain gaps: origin node id →
+        # since_ts of the oldest record we may be missing from it. Reads
+        # must heal these (FetchLog) or refuse (ReadUnavailable) before
+        # serving — an applied record past a failed catch-up otherwise
+        # hides the hole from prev_ts detection forever.
+        self._origin_gaps: dict[int, int] = {}
+        # read gate state: monotonic time of the last full chain
+        # verification; read_lease_s > 0 lets reads inside the lease skip
+        # re-probing (bounded-staleness trade, raft lease-read analog);
+        # 0 = verify every read (strict default)
+        self._read_verified_at = 0.0
+        self.read_lease_s = 0.0
+        # test-only opt-in: accept commit-quorum stages without an armed
+        # WAL (the ack is then NOT crash-durable — see StageRefused)
+        self.allow_volatile_stage = False
         # commit-quorum staging: ts → (Mutation, origin node id) durably
         # logged but undecided (raft "log entry below commit index")
         self._pending: dict[int, tuple[Mutation, int]] = {}
@@ -269,6 +304,159 @@ class Alpha:
             store = self.acl.readable_view(acl_user, store)
         return store
 
+    def _verify_read_chains(self, ts: int) -> None:
+        """Partition-safe read gate (reference: a raft follower never
+        serves a log state that did not exist). Before a read at `ts` is
+        served, every group peer's broadcast chain must be verifiably
+        gap-free: the peer's chain head (last ts it broadcast) is
+        compared against the last record this node APPLIED from it, and
+        any missed tail is pulled via FetchLog BEFORE the read runs.
+        Recorded gaps (`_origin_gaps` — a receive-time catch-up that
+        failed) must heal the same way.
+
+        Undecided FOREIGN pends are part of the bar, not an exception:
+        a staged record whose DecisionMsg was lost may already be
+        client-acked — the decision is durable in the coordinator's WAL
+        — and serving the pre-commit state would hand a read-modify-
+        write txn a lost update (the seeded partition fuzz catches
+        exactly this: the stale read predates the commit's ts, so
+        conflict detection cannot). The gate resolves such pends
+        through the origin's (or any reachable peer's) resolved log; a
+        pend that stays unresolved with its origin REACHABLE is
+        genuinely undecided — not acked before this read began — and
+        may be invisibly skipped.
+
+        An unreachable peer leaves its chain unverifiable. If the
+        reachable part of the group (counting this node) is still a
+        MAJORITY, the missed tails are pulled from the reachable peers'
+        resolved logs instead — every client-acked commit is resolved
+        in its coordinator's WAL, and majority staging puts it on at
+        least one reachable node. But a pend whose UNREACHABLE origin
+        may hold the only copy of its decision blocks the read
+        (ReadUnavailable) — the alternative is the lost update above.
+        On the minority side nothing can be verified: the read raises
+        ReadUnavailable (retryable) rather than serve a snapshot that
+        never existed.
+
+        `read_lease_s` bounds probe cost: a successful verification
+        stays valid that long (0 = verify every read, strict; a
+        positive lease explicitly trades bounded staleness inside the
+        window for fewer probes)."""
+        if self.groups is None:
+            return
+        replicas = [a for a in self.groups.group_addrs(self.groups.gid)
+                    if a != self.groups.my_addr]
+        if not replicas:
+            return
+        import time as _time
+        with self._state_lock:
+            gaps = dict(self._origin_gaps)
+            fresh = (self.read_lease_s > 0
+                     and _time.monotonic() - self._read_verified_at
+                     <= self.read_lease_s)
+        if fresh and not gaps:
+            return
+        import grpc as _grpc
+        majority = (len(replicas) + 1) // 2 + 1
+        my_node = self.groups.node_id
+        with self._state_lock:
+            pend_origins = {org for _t, (_m, org) in self._pending.items()
+                            if org and org != my_node}
+        unreachable: dict[str, int | None] = {}
+        reachable: list[str] = []
+        for addr in replicas:
+            try:
+                node, head = self.groups.pool(addr).chain_head()
+            except _grpc.RpcError:
+                unreachable[addr] = self.groups.node_of_addr(addr)
+                continue
+            reachable.append(addr)
+            if not node:
+                continue  # peer not in cluster mode: no chain to check
+            last = self._last_from.get(node, 0)
+            if head <= last and node not in gaps \
+                    and node not in pend_origins:
+                continue
+            since = min(last, gaps.get(node, last))
+            if node in pend_origins:
+                # a lost-decision pend resolves from the origin's log;
+                # pull from below the oldest pend so the decision (or
+                # abort marker) is in the stream
+                with self._state_lock:
+                    pts = [t for t, (_m, org) in self._pending.items()
+                           if org == node]
+                if pts:
+                    since = min(since, min(pts) - 1)
+            try:
+                _complete, seen = self.catch_up(addr, since_ts=since)
+            except _grpc.RpcError:
+                unreachable[addr] = node
+                reachable.pop()
+                continue
+            pend_origins.discard(node)  # resolved, or truly undecided
+            with self._state_lock:
+                self._origin_gaps.pop(node, None)
+            gaps.pop(node, None)
+            if seen >= head:
+                # the probed head itself came back resolved: everything
+                # the peer ever broadcast is applied here — advance the
+                # chain so the next read (and the next chained receive)
+                # doesn't re-pull. A head still pending on the peer
+                # (stage leg sent, decision unwritten) must NOT advance:
+                # that would hide the record from gap detection.
+                self._last_from[node] = max(
+                    self._last_from.get(node, 0), head)
+        if unreachable:
+            if 1 + len(reachable) < majority:
+                raise ReadUnavailable(
+                    f"read at ts {ts}: replica(s) "
+                    f"{sorted(unreachable)} unreachable and the "
+                    f"reachable side is a minority of the group — "
+                    f"cannot verify the snapshot is gap-free; retry")
+            # majority fallback: pull the unreachable origins' tails
+            # from the reachable peers' resolved logs
+            floors = [self._last_from.get(n, 0)
+                      for n in unreachable.values() if n is not None]
+            floors += [gaps[n] for n in list(gaps)
+                       if n in set(unreachable.values())]
+            # a pend whose unreachable origin may hold the only copy of
+            # its decision must ALSO pull from below the pend
+            dead_nodes = {n for n in unreachable.values()
+                          if n is not None}
+            with self._state_lock:
+                dead_pts = [t for t, (_m, org) in self._pending.items()
+                            if org in dead_nodes]
+            if dead_pts:
+                floors.append(min(dead_pts) - 1)
+            since = min(floors, default=0)
+            healed = False
+            for addr in reachable:
+                try:
+                    self.catch_up(addr, since_ts=since)
+                    healed = True
+                except _grpc.RpcError:
+                    continue
+            if not healed:
+                raise ReadUnavailable(
+                    f"read at ts {ts}: could not pull the tail of "
+                    f"unreachable replica(s) {sorted(unreachable)} "
+                    f"from any reachable peer; retry")
+            with self._state_lock:
+                still = [t for t, (_m, org) in self._pending.items()
+                         if org in dead_nodes]
+            if still:
+                # the decision for these staged records may exist only
+                # in the unreachable coordinator's WAL: serving without
+                # them risks a lost update (stale read below the
+                # commit's ts — conflict detection cannot catch it)
+                raise ReadUnavailable(
+                    f"read at ts {ts}: staged record(s) {sorted(still)} "
+                    f"from unreachable coordinator(s) are undecided "
+                    f"here; retry")
+        else:
+            with self._state_lock:
+                self._read_verified_at = _time.monotonic()
+
     def query(self, dql: str, variables: dict | None = None,
               read_ts: int | None = None,
               acl_user: str | None = None) -> dict:
@@ -277,6 +465,7 @@ class Alpha:
         unreadable predicates are invisible (reference: query rewriting
         drops unauthorized predicates)."""
         with self._reading(read_ts) as ts:
+            self._verify_read_chains(ts)
             store = self._query_view(ts, acl_user)
             out = Engine(store, device_threshold=self.device_threshold,
                          mesh=self.mesh).query(dql, variables)
@@ -290,6 +479,7 @@ class Alpha:
         (engine/emit.py), never a Python object tree (reference:
         outputnode.go ToJson writes bytes straight into the response)."""
         with self._reading(read_ts) as ts:
+            self._verify_read_chains(ts)
             store = self._query_view(ts, acl_user)
             raw = Engine(store, device_threshold=self.device_threshold,
                          mesh=self.mesh).query_bytes(dql, variables)
@@ -306,6 +496,7 @@ class Alpha:
         from dgraph_tpu.engine.batch import plan_batch_groups, run_batch
 
         with self._reading(read_ts) as ts:
+            self._verify_read_chains(ts)
             store = self._query_view(ts, acl_user)
             from dgraph_tpu.utils import logging as xlog
             results: list = [None] * len(dqls)
@@ -414,6 +605,7 @@ class Alpha:
         if parse_schema_query(query_src) is not None:
             raise ValueError("schema{} queries cannot drive an upsert")
         with self._reading(txn.start_ts) as ts:
+            self._verify_read_chains(ts)
             store = self.mvcc.read_view(ts)
             if self.groups is not None:
                 from dgraph_tpu.cluster.routed import routed_view
@@ -794,18 +986,52 @@ class Alpha:
                 continue
         return ok
 
+    def _chain_catch_up(self, origin: int, since_ts: int) -> None:
+        """Pull the missed (since_ts, …] tail from `origin`. On ANY
+        failure (unknown address, gRPC receive error) the gap is
+        RECORDED instead of propagated: the enclosing stage/broadcast
+        RPC must still succeed — refusing it would make an asymmetric
+        partition cascade — but the read gate then refuses or heals the
+        hole before any snapshot is served (never silently proceed past
+        a known gap)."""
+        addr = self.groups.addr_of_node(origin)
+        try:
+            if addr is None:
+                raise LookupError(f"origin node {origin} has no known "
+                                  f"address")
+            self.catch_up(addr, since_ts=since_ts)
+        except Exception as e:  # noqa: BLE001 — gap recorded, not lost
+            with self._state_lock:
+                known = self._origin_gaps.get(origin)
+                self._origin_gaps[origin] = (since_ts if known is None
+                                             else min(known, since_ts))
+            from dgraph_tpu.utils import logging as xlog
+            xlog.get("alpha").warning(
+                "catch-up from origin %d above ts %d failed (%s); gap "
+                "recorded — reads heal or refuse until it resolves",
+                origin, since_ts, e)
+        else:
+            with self._state_lock:
+                self._origin_gaps.pop(origin, None)
+
     def receive_stage(self, mut: Mutation, ts: int, origin: int,
                       prev_ts: int) -> None:
         """Commit-quorum phase-1 receive: chain gap-check, then durably
         log the record as PENDING — no apply. The ack this produces is
         the durability certificate the coordinator counts toward
-        majority (reference: raft AppendEntries success)."""
+        majority (reference: raft AppendEntries success) — which is why
+        a node with no armed WAL must REFUSE (StageRefused →
+        FailedPrecondition on the wire) instead of acking a durability
+        it cannot provide."""
+        if self.wal is None and not self.allow_volatile_stage:
+            raise StageRefused(
+                f"stage of ts {ts} refused: no WAL armed — this node's "
+                f"ack would count toward the coordinator's durability "
+                f"majority without being crash-durable")
         if origin:
             last = self._last_from.get(origin, 0)
             if prev_ts > last:
-                addr = self.groups.addr_of_node(origin)
-                if addr is not None:
-                    self.catch_up(addr, since_ts=last)
+                self._chain_catch_up(origin, since_ts=last)
             self._last_from[origin] = max(
                 self._last_from.get(origin, 0), ts)
             self._resolve_stale_pendings(origin, ts)
@@ -815,9 +1041,10 @@ class Alpha:
             if self.wal is not None:
                 self.wal.append_pend(mut, ts)
             elif not getattr(self, "_warned_volatile_stage", False):
-                # dev/test mode: the ack the coordinator counts toward
-                # its durability majority is memory-only here. Real
-                # deployments (Alpha.open / cli) always arm the WAL.
+                # explicit test-only opt-in (allow_volatile_stage): the
+                # ack the coordinator counts toward its durability
+                # majority is memory-only here. Real deployments
+                # (Alpha.open / cli) always arm the WAL.
                 self._warned_volatile_stage = True
                 from dgraph_tpu.utils import logging as xlog
                 xlog.get("alpha").warning(
@@ -839,15 +1066,35 @@ class Alpha:
         discards undecided pends — the client was never acked). It is
         resolved as ABORT here; should the origin somehow have committed
         it after all, the committed record is in its resolved log and
-        ordinary gap catch-up re-applies it (apply is idempotent)."""
+        ordinary gap catch-up re-applies it (apply is idempotent).
+
+        The orphan verdict REQUIRES a successful fetch of the origin's
+        resolved log: with its address unknown or the pull failing
+        (gRPC receive error), the pends are RETAINED — aborting a
+        record the origin may have committed would drop an acknowledged
+        write; a later chained message retries the resolution. The
+        failed pull must also never fail the ENCLOSING stage RPC (the
+        coordinator would count this node unreachable over a third
+        party's link)."""
         with self._state_lock:
             stale = [t for t, (_m, org) in self._pending.items()
                      if org == origin and t < before_ts]
         if not stale:
             return
         addr = self.groups.addr_of_node(origin)
+        fetched = False
         if addr is not None:
-            self.catch_up(addr, since_ts=min(stale) - 1)
+            try:
+                self.catch_up(addr, since_ts=min(stale) - 1)
+                fetched = True
+            except Exception as e:  # noqa: BLE001 — retain, retry later
+                from dgraph_tpu.utils import logging as xlog
+                xlog.get("alpha").warning(
+                    "stale-pend resolution fetch from origin %d (%s) "
+                    "failed (%s); retaining %d staged record(s)",
+                    origin, addr, e, len(stale))
+        if not fetched:
+            return  # cannot distinguish orphan from lost decision yet
         with self._state_lock:
             orphans = [t for t in stale if t in self._pending]
             for t in orphans:
@@ -883,9 +1130,7 @@ class Alpha:
             last = self._last_from.get(origin, 0)
             if prev_ts > last:
                 # we missed (last, prev_ts] from this origin
-                addr = self.groups.addr_of_node(origin)
-                if addr is not None:
-                    self.catch_up(addr, since_ts=last)
+                self._chain_catch_up(origin, since_ts=last)
             self._last_from[origin] = max(
                 self._last_from.get(origin, 0), ts)
             self._resolve_stale_pendings(origin, ts)
@@ -898,11 +1143,15 @@ class Alpha:
         elif not self.mvcc.has_applied(ts):
             self.apply_committed(obj, ts)
 
-    def catch_up(self, addr: str, since_ts: int) -> bool:
+    def catch_up(self, addr: str, since_ts: int) -> tuple[bool, int]:
         """Pull and apply the peer's WAL records above since_ts
-        (reference: raft log replay for a lagging follower). Returns False
-        when the peer's WAL no longer covers since_ts — the caller falls
-        back to snapshot resync (mark tablets stale / TabletSnapshot).
+        (reference: raft log replay for a lagging follower). Returns
+        (complete, seen_max): complete=False when the peer's WAL no
+        longer covers since_ts — the caller falls back to snapshot
+        resync (mark tablets stale / TabletSnapshot) — and seen_max is
+        the highest RESOLVED ts in the fetched stream (0 when empty),
+        which the read gate compares against the peer's probed chain
+        head to decide whether the chain may advance.
 
         since_ts is clamped to our own fold floor: records at or below it
         are already inside our snapshots, and re-absorbing them would
@@ -913,7 +1162,10 @@ class Alpha:
         since_ts = max(since_ts, self.mvcc.base_ts)
         records, complete = self.groups.pool(addr).fetch_log(since_ts)
         applied = 0
+        seen_max = self.mvcc.base_ts if since_ts <= self.mvcc.base_ts \
+            else 0
         for ts, kind, obj in records:
+            seen_max = max(seen_max, ts)
             if kind == "schema":
                 self.apply_schema_broadcast(obj, ts=ts)
                 continue
@@ -957,7 +1209,7 @@ class Alpha:
                         "snapshot-level resync", addr, since_ts)
             self.mark_all_stale()
             self.resync_owned_tablets()
-        return complete
+        return complete, seen_max
 
     def mark_all_stale(self) -> None:
         """Force freshness checks: every known foreign predicate must
@@ -1021,7 +1273,7 @@ class Alpha:
                 # a peer without a covering WAL (complete=False, e.g. no
                 # WAL armed or truncated past `since`) is not a source —
                 # keep trying; any COMPLETE tail ends the search
-                if self.catch_up(addr, since_ts=since):
+                if self.catch_up(addr, since_ts=since)[0]:
                     break
             except Exception:  # noqa: BLE001 — any live peer will do
                 continue

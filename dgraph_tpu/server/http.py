@@ -15,7 +15,8 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from dgraph_tpu.dql.upsert import is_upsert as _is_upsert
-from dgraph_tpu.server.api import Alpha, TxnAborted
+from dgraph_tpu.server.api import (Alpha, NoQuorum, ReadUnavailable,
+                                   TxnAborted)
 from dgraph_tpu.utils.metrics import METRICS
 
 
@@ -242,6 +243,13 @@ def make_http_server(alpha: Alpha, addr: str = "127.0.0.1",
             except TxnAborted as e:
                 self._send(409, {"errors": [{"message": str(e),
                                              "code": "Aborted"}]})
+            except (NoQuorum, ReadUnavailable) as e:
+                # RETRYABLE partition refusals, not client errors: the
+                # minority side refuses writes (NoQuorum) and refuses
+                # unverifiable reads (ReadUnavailable) — 503 so clients
+                # and load balancers retry elsewhere
+                self._send(503, {"errors": [{"message": str(e),
+                                             "code": "Unavailable"}]})
             except PermissionError as e:
                 self._send(401, {"errors": [{"message": str(e),
                                              "code": "Unauthorized"}]})

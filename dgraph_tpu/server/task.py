@@ -21,7 +21,8 @@ import numpy as np
 
 from dgraph_tpu.engine.execute import Executor
 from dgraph_tpu.protos import task_pb2 as pb
-from dgraph_tpu.server.api import Alpha, NoQuorum, TxnAborted
+from dgraph_tpu.server.api import (Alpha, NoQuorum, ReadUnavailable,
+                                   StageRefused, TxnAborted)
 
 SERVICE_DGRAPH = "dgraph_tpu.Dgraph"
 SERVICE_WORKER = "dgraph_tpu.Worker"
@@ -51,8 +52,15 @@ class DgraphService:
         t0 = time.perf_counter()
         acl_user = self._acl_user(ctx)
         start_ts = req.start_ts or None
-        raw = self.alpha.query_raw(req.query, dict(req.vars) or None,
-                                   read_ts=start_ts, acl_user=acl_user)
+        try:
+            raw = self.alpha.query_raw(req.query, dict(req.vars) or None,
+                                       read_ts=start_ts,
+                                       acl_user=acl_user)
+        except ReadUnavailable as e:
+            # retryable by contract: the replica cannot verify its
+            # snapshot is gap-free (partitioned) — same code the
+            # reference maps unreachable-quorum reads onto
+            ctx.abort(grpc.StatusCode.UNAVAILABLE, str(e))
         return pb.Response(
             json=raw,
             txn=pb.TxnContext(start_ts=start_ts or 0),
@@ -176,6 +184,17 @@ class WorkerService:
         analog, pull-shaped)."""
         return pb.Payload(data=b"ok")
 
+    def ChainHead(self, req: pb.Empty, ctx) -> pb.AssignedIds:
+        """Chain-head probe for the partition-safe read gate: (node id,
+        last ts this node broadcast). The reader compares the head
+        against what it last APPLIED from this node and pulls any gap
+        via FetchLog before serving (api.Alpha._verify_read_chains).
+        Reuses AssignedIds (start_id=node, end_id=head) — no proto
+        regen needed for two uint64s."""
+        a = self.alpha
+        nid = a.groups.node_id if a.groups is not None else 0
+        return pb.AssignedIds(start_id=nid, end_id=a._last_sent_ts)
+
     def ApplyMutation(self, req: pb.MutationMsg, ctx) -> pb.Payload:
         """Receive a broadcast (log shipping) — mutation, Alter, or
         DropAll, all riding one chain. Chained origin/prev_ts trigger gap
@@ -185,9 +204,14 @@ class WorkerService:
         if req.stage:
             # commit-quorum phase 1: durably log as pending, no apply;
             # the ack is the durability certificate (raft AppendEntries)
-            self.alpha.receive_stage(
-                mut_from_bytes(req.mut_json), int(req.commit_ts),
-                int(req.origin), int(req.prev_ts))
+            try:
+                self.alpha.receive_stage(
+                    mut_from_bytes(req.mut_json), int(req.commit_ts),
+                    int(req.origin), int(req.prev_ts))
+            except StageRefused as e:
+                # no armed WAL: the ack would be a durability lie — the
+                # coordinator must not count this node toward majority
+                ctx.abort(grpc.StatusCode.FAILED_PRECONDITION, str(e))
             return pb.Payload(data=b"ok")
         if req.drop_all:
             kind, obj = "drop", None
@@ -296,6 +320,7 @@ def make_server(alpha: Alpha, addr: str = "127.0.0.1:0",
         grpc.method_handlers_generic_handler(SERVICE_WORKER, {
             "ServeTask": _unary(w.ServeTask, pb.TaskQuery),
             "Ping": _unary(w.Ping, pb.Empty),
+            "ChainHead": _unary(w.ChainHead, pb.Empty),
             "ApplyMutation": _unary(w.ApplyMutation, pb.MutationMsg),
             "ApplyDecision": _unary(w.ApplyDecision, pb.DecisionMsg),
             "FetchLog": _unary(w.FetchLog, pb.FetchLogRequest),
@@ -360,6 +385,12 @@ class Client:
 
     def ping(self) -> None:
         self._call(SERVICE_WORKER, "Ping", pb.Empty(), pb.Payload)
+
+    def chain_head(self) -> tuple[int, int]:
+        """(node_id, last broadcast ts) of the peer — read-gate probe."""
+        r = self._call(SERVICE_WORKER, "ChainHead", pb.Empty(),
+                       pb.AssignedIds)
+        return int(r.start_id), int(r.end_id)
 
     def apply_decision(self, commit_ts: int, commit: bool,
                        origin: int = 0) -> None:

@@ -132,6 +132,10 @@ def test_replica_failover_reads_keep_serving():
     r2, sr2, _ = start_cluster_alpha(ztarget, device_threshold=10**9)
     c, sc, _ = start_cluster_alpha(ztarget, device_threshold=10**9)
     assert r1.groups.gid == r2.groups.gid != c.groups.gid
+    for r in (r1, r2):
+        # no WAL here: explicit test-only opt-in (stages otherwise
+        # refuse rather than ack a non-durable record)
+        r.allow_volatile_stage = True
     zc = ZeroClient(ztarget)
     for pred in ("name", "friend"):
         zc.should_serve(pred, r1.groups.gid)
@@ -290,6 +294,8 @@ def test_replica_catchup_after_missed_broadcasts():
     r2, sr2, addr2 = start_cluster_alpha(ztarget, device_threshold=10**9)
     r3, sr3, addr3 = start_cluster_alpha(ztarget, device_threshold=10**9)
     assert r1.groups.gid == r2.groups.gid == r3.groups.gid
+    for r in (r1, r2, r3):
+        r.allow_volatile_stage = True  # explicit test-only opt-in
     # the coordinator logs full records (the FetchLog source); every real
     # deployment has this via Alpha.open
     import tempfile, os
@@ -338,6 +344,8 @@ def test_rejoin_resync_pulls_missed_tail():
     r1, sr1, addr1 = start_cluster_alpha(ztarget, device_threshold=10**9)
     r2, sr2, addr2 = start_cluster_alpha(ztarget, device_threshold=10**9)
     r3, sr3, addr3 = start_cluster_alpha(ztarget, device_threshold=10**9)
+    for r in (r1, r2, r3):
+        r.allow_volatile_stage = True  # explicit test-only opt-in
     zc = ZeroClient(ztarget)
     zc.should_serve("name", r1.groups.gid)
     r1.alter(SCHEMA)
@@ -396,6 +404,8 @@ def test_missed_alter_recovered_via_chain():
     r1, sr1, addr1 = start_cluster_alpha(ztarget, device_threshold=10**9)
     r2, sr2, addr2 = start_cluster_alpha(ztarget, device_threshold=10**9)
     r3, sr3, addr3 = start_cluster_alpha(ztarget, device_threshold=10**9)
+    for r in (r1, r2, r3):
+        r.allow_volatile_stage = True  # explicit test-only opt-in
     r1.wal = WAL(os.path.join(tempfile.mkdtemp(), "wal.log"), sync=False)
     zc = ZeroClient(ztarget)
     zc.should_serve("name", r1.groups.gid)

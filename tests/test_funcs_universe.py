@@ -1,0 +1,63 @@
+"""eval_func_universe: the universe-restricted filter fast path.
+
+Reference parity: filter SubGraphs evaluate against the parent's uid
+list, never the full tablet (worker/task.go). The fast path must fire
+regardless of the query's case spelling (eval_func folds case; this
+path must too) and must cover non-indexed eq, whose full match set can
+dwarf the frontier exactly like a comparison's.
+"""
+
+import numpy as np
+
+from dgraph_tpu.engine.funcs import eval_func, eval_func_universe
+from dgraph_tpu.engine.ir import FuncNode
+from dgraph_tpu.server.api import Alpha
+
+
+def _store():
+    a = Alpha(device_threshold=10**9)
+    a.alter("name: string @index(exact) .\n"
+            "age: int .\n"
+            "city: string .\n")   # city/age: NOT indexed
+    a.mutate(set_nquads="\n".join(
+        f'_:p{i} <name> "p{i}" .\n'
+        f'_:p{i} <age> "{20 + i}"^^<xs:int> .\n'
+        f'_:p{i} <city> "c{i % 3}" .' for i in range(9)))
+    return a.mvcc.read_view(a.oracle.read_only_ts())
+
+
+def test_uppercase_names_hit_the_universe_path():
+    store = _store()
+    universe = np.arange(4, dtype=np.int32)
+    for spelling in ("le", "LE", "Le"):
+        got = eval_func_universe(store, FuncNode(name=spelling, attr="age",
+                                                 args=[22]), universe)
+        assert got is not None, f"{spelling!r} skipped the fast path"
+        assert got.tolist() == [0, 1, 2]
+    got = eval_func_universe(store, FuncNode(name="HAS", attr="age"),
+                             universe)
+    assert got is not None and got.tolist() == [0, 1, 2, 3]
+
+
+def test_non_indexed_eq_universe_branch():
+    store = _store()
+    universe = np.arange(5, dtype=np.int32)
+    f = FuncNode(name="eq", attr="city", args=["c0"])
+    got = eval_func_universe(store, f, universe)
+    assert got is not None, "non-indexed eq must take the universe path"
+    # identical semantics to the full evaluation intersected after
+    full = eval_func(store, f)
+    want = sorted(set(full.tolist()) & set(universe.tolist()))
+    assert got.tolist() == want == [0, 3]
+    # int eq too (never index-answerable by exact/hash string tokens)
+    got = eval_func_universe(store, FuncNode(name="EQ", attr="age",
+                                             args=[24]), universe)
+    assert got is not None and got.tolist() == [4]
+
+
+def test_indexed_eq_stays_on_the_lookup_path():
+    store = _store()
+    universe = np.arange(5, dtype=np.int32)
+    got = eval_func_universe(store, FuncNode(name="eq", attr="name",
+                                             args=["p1"]), universe)
+    assert got is None, "indexed eq should use the O(lookup) full path"

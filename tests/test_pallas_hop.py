@@ -80,3 +80,32 @@ def test_pallas_flag_off_by_default(monkeypatch):
     kinds = {k for k, _e, _n in _prepare_buckets(
         [jnp.asarray(e) for e in g.ells], g.n, 1)}
     assert kinds == {"pallas"}
+
+
+def test_pallas_trace_failure_falls_back_to_xla(monkeypatch):
+    """An untested Mosaic compile must never take the hop down (or burn
+    a chip window): with the kernel raising at trace time, the hop
+    falls back to the XLA gather form and still answers correctly."""
+    import dgraph_tpu.ops.bfs as bfs
+    import dgraph_tpu.ops.pallas_hop as ph
+
+    rng = np.random.default_rng(5)
+    rel = powerlaw_rel(1 << 9, 5.0, seed=9)
+    g = build_ell(rel.indptr, rel.indices)
+    seeds = [rng.integers(0, 1 << 9, 3) for _ in range(32)]
+    mask0 = pack_seed_masks(g, seeds)
+    want_last, want_seen, want_edges = ell_recurse(g, mask0, 3)
+
+    def boom(*a, **kw):
+        raise RuntimeError("injected Mosaic trace failure")
+
+    monkeypatch.setenv("DGRAPH_TPU_PALLAS", "1")
+    monkeypatch.setattr(ph, "bucket_hop_pallas", boom)
+    monkeypatch.setattr(bfs, "_pallas_failed", False)  # restored after
+    fn = bfs.make_ell_recurse([jnp.asarray(e) for e in g.ells],
+                              jnp.asarray(g.outdeg), g.n, mask0.shape[1])
+    last, seen, edges = fn(jnp.asarray(mask0), 3)
+    assert bfs._pallas_failed, "fallback flag must stick after failure"
+    assert np.array_equal(np.asarray(seen), np.asarray(want_seen))
+    assert np.array_equal(np.asarray(last), np.asarray(want_last))
+    assert np.array_equal(np.asarray(edges), np.asarray(want_edges))

@@ -1,0 +1,168 @@
+"""Seeded randomized partition fuzzing — the Jepsen-shaped backbone.
+
+Reference parity: the reference's distributed correctness story leans on
+external Jepsen runs (SURVEY §5); this harness is the in-repo analog. A
+seeded `FaultSchedule` (cluster/fault.py) drops/heals/delays DIRECTED
+links of a 3-replica group while the bank-transfer workload
+(test_txn.py's invariant) runs from randomly-chosen coordinators. Per
+iteration it asserts:
+
+  * the balance invariant — total money is constant; a commit either
+    applies everywhere (eventually) or nowhere, never partially;
+  * minority refusal — an isolated coordinator answers NoQuorum on
+    writes and ReadUnavailable on reads, NEVER a stale/gap snapshot;
+  * post-heal convergence — after heal_all every replica serves the
+    identical balances.
+
+Every failure message carries the seed; replay one seed exactly with
+DGRAPH_TPU_FUZZ_SEED=<seed>. Tier-1 runs the 10-iteration smoke;
+`-m slow` runs the 100-iteration exploration.
+"""
+
+import os
+import random
+
+import pytest
+
+from dgraph_tpu.cluster import start_cluster_alpha
+from dgraph_tpu.cluster.fault import FaultSchedule, FaultyGroups
+from dgraph_tpu.cluster.oracle import TxnAborted
+from dgraph_tpu.cluster.zero import ZeroClient, ZeroState, make_zero_server
+from dgraph_tpu.server.api import NoQuorum, ReadUnavailable
+
+SCHEMA = "name: string @index(exact) .\nbalance: int .\n"
+N_ACCT = 4
+PER = 100
+
+
+@pytest.fixture()
+def bank_trio(tmp_path):
+    """Zero + one 3-replica group (durable WALs, fault-injectable
+    Groups) with N_ACCT bank accounts of PER each."""
+    zserver, zport, _zs = make_zero_server(ZeroState(replicas=3))
+    zserver.start()
+    ztarget = f"127.0.0.1:{zport}"
+    nodes, addrs = [], []
+    for i in range(3):
+        d = tmp_path / f"n{i}"
+        d.mkdir()
+        a, s, addr = start_cluster_alpha(ztarget, device_threshold=10**9,
+                                         wal_dir=str(d))
+        a.groups = FaultyGroups(a.groups)
+        # STRICT gate (the default): the balance invariant needs every
+        # read to see every acked commit below its ts — a positive
+        # lease would reopen the stale-read → lost-update window the
+        # fuzz exists to catch
+        assert a.read_lease_s == 0.0
+        nodes.append((a, s))
+        addrs.append(addr)
+    (a0, _) = nodes[0]
+    zc = ZeroClient(ztarget)
+    for pred in ("name", "balance"):
+        zc.should_serve(pred, a0.groups.gid)
+    a0.alter(SCHEMA)
+    for a, _s in nodes:
+        a.groups.refresh()
+    uids = []
+    for i in range(N_ACCT):
+        r = a0.mutate(set_nquads=f'_:a <name> "acct{i}" .\n'
+                                 f'_:a <balance> "{PER}"^^<xs:int> .')
+        uids.append(r["uids"]["_:a"])
+    yield nodes, addrs, uids
+    for _a, s in nodes:
+        s.stop(None)
+    zserver.stop(None)
+
+
+def _balances(a, uids):
+    out = a.query('{ q(func: has(balance), orderasc: name) '
+                  '{ name balance } }')
+    return {r["name"]: r["balance"] for r in out["q"]}
+
+
+def _transfer(a, uids, rng):
+    """One read-modify-write transfer. Returns 'committed', 'refused'
+    (NoQuorum/ReadUnavailable — the partition said no), or 'aborted'
+    (txn conflict). Anything else propagates: the harness treats it as
+    a correctness failure."""
+    i, j = rng.sample(range(len(uids)), 2)
+    t = a.new_txn()
+    try:
+        bi = t.query(f'{{ q(func: uid({uids[i]})) {{ balance }} }}'
+                     )["q"][0]["balance"]
+        bj = t.query(f'{{ q(func: uid({uids[j]})) {{ balance }} }}'
+                     )["q"][0]["balance"]
+        amt = rng.randint(1, 10)
+        if bi < amt:
+            t.discard()
+            return "aborted"
+        t.mutate(set_nquads=(
+            f'<{uids[i]}> <balance> "{bi - amt}"^^<xs:int> .\n'
+            f'<{uids[j]}> <balance> "{bj + amt}"^^<xs:int> .'))
+        t.commit()
+        return "committed"
+    except (NoQuorum, ReadUnavailable):
+        t.discard()
+        return "refused"
+    except TxnAborted:
+        return "aborted"
+
+
+def _fuzz_iteration(nodes, addrs, uids, seed):
+    """One seeded schedule: interleave fault events with transfers,
+    assert minority refusal as we go, then heal and assert convergence
+    plus the balance invariant."""
+    sched = FaultSchedule(seed, len(nodes))
+    rng = random.Random(seed ^ 0x9E3779B9)
+    groups = [a.groups for a, _s in nodes]
+    try:
+        for ev in sched.events:
+            sched.apply_event(ev, groups, addrs)
+            for _ in range(2):
+                k = rng.randrange(len(nodes))
+                res = _transfer(nodes[k][0], uids, rng)
+                if sched.isolated(k):
+                    assert res == "refused", (
+                        f"isolated node {k} answered {res!r} — the "
+                        f"minority side must refuse, not serve/commit")
+    finally:
+        sched.heal_all(groups)
+    # convergence nudges: each node's next chained broadcast resolves
+    # its stale pends on peers and carries prev_ts for gap detection
+    for a, _s in nodes:
+        a.mutate(set_nquads=f'_:h <name> "heal-{seed}" .')
+    views = [_balances(a, uids) for a, _s in nodes]
+    for k, v in enumerate(views[1:], 1):
+        assert v == views[0], (
+            f"replica {k} diverged after heal: {v} != {views[0]}")
+    accts = {n: b for n, b in views[0].items() if n.startswith("acct")}
+    assert len(accts) == N_ACCT
+    total = sum(accts.values())
+    assert total == N_ACCT * PER, f"money leaked: {total}"
+
+
+def _run_fuzz(bank_trio, iters, base_seed):
+    nodes, addrs, uids = bank_trio
+    env_seed = os.environ.get("DGRAPH_TPU_FUZZ_SEED")
+    seeds = ([int(env_seed)] if env_seed
+             else [base_seed + i for i in range(iters)])
+    for seed in seeds:
+        try:
+            _fuzz_iteration(nodes, addrs, uids, seed)
+        except Exception as e:
+            sched = FaultSchedule(seed, len(nodes))
+            raise AssertionError(
+                f"partition fuzz FAILED at seed {seed} — replay with "
+                f"DGRAPH_TPU_FUZZ_SEED={seed}; schedule: {sched!r}"
+            ) from e
+
+
+def test_partition_fuzz_smoke(bank_trio):
+    """Tier-1 smoke: 10 seeded iterations."""
+    _run_fuzz(bank_trio, 10, base_seed=1000)
+
+
+@pytest.mark.slow
+def test_partition_fuzz_full(bank_trio):
+    """Exploration tier: 100 seeded iterations (run with -m slow)."""
+    _run_fuzz(bank_trio, 100, base_seed=20000)
