@@ -115,6 +115,32 @@ def _stage(obj) -> None:
     print(json.dumps(obj), flush=True)
 
 
+def _stage_telemetry(stage: str) -> dict:
+    """Per-stage compile/transfer/execute breakdown sourced from the
+    SHARED observability registry (utils/tracing spans — the same
+    objects /debug/traces serves in a server process), so a dead chip
+    window diagnoses from the stage JSON: a missing `compile_us` means
+    the hang predates XLA, a huge one means Mosaic/XLA compile, a huge
+    `transfer_us` means the HBM upload. Execute reports the best rep
+    (what the throughput number is computed from); the rest sum."""
+    from dgraph_tpu.utils import tracing
+    from dgraph_tpu.utils.metrics import METRICS
+    out: dict[str, int] = {}
+    for s in tracing.recent(512):
+        if not s.name.startswith("bench.") or \
+                s.attrs.get("stage") != stage:
+            continue
+        phase = s.name.split(".", 1)[1]
+        k = phase + "_us"
+        if phase == "execute":
+            out[k] = min(out.get(k, s.dur_us), s.dur_us)
+        else:
+            out[k] = out.get(k, 0) + s.dur_us
+        METRICS.observe("bench_stage_us", s.dur_us, stage=stage,
+                        phase=phase)
+    return out
+
+
 def child_main(platform: str, expect_path: str) -> None:
     B = B_DEV if platform == "default" else B_CPU_FALLBACK
     if platform == "cpu":
@@ -133,6 +159,7 @@ def child_main(platform: str, expect_path: str) -> None:
     import jax.numpy as jnp
     from dgraph_tpu.ops.bfs import (build_ell, make_ell_recurse,
                                     pack_seed_masks)
+    from dgraph_tpu.utils import tracing
 
     # -- stage0: backend alive + MXU smoke ----------------------------------
     t0 = time.perf_counter()
@@ -148,26 +175,31 @@ def child_main(platform: str, expect_path: str) -> None:
     g_s = build_ell(rel_s.indptr, rel_s.indices)
     seeds_s = make_seeds(SMALL_N, 256, seed=3)
     mask_s = pack_seed_masks(g_s, seeds_s)
-    ells_d = [jax.device_put(e) for e in g_s.ells]
-    fn_s = make_ell_recurse(ells_d, jax.device_put(g_s.outdeg), g_s.n,
-                            mask_s.shape[1])
+    with tracing.span("bench.transfer", stage="stage1"):
+        ells_d = [jax.device_put(e) for e in g_s.ells]
+        outdeg_d = jax.device_put(g_s.outdeg)
+        jax.block_until_ready(ells_d + [outdeg_d])
+    fn_s = make_ell_recurse(ells_d, outdeg_d, g_s.n, mask_s.shape[1])
     t_c = time.perf_counter()
-    _l, _s, edges_s = fn_s(jax.device_put(mask_s), DEPTH)
-    edges_s = np.asarray(edges_s)
+    with tracing.span("bench.compile", stage="stage1"):
+        _l, _s, edges_s = fn_s(jax.device_put(mask_s), DEPTH)
+        edges_s = np.asarray(edges_s)
     compile_s = time.perf_counter() - t_c
     want = cpu_recurse(rel_s.indptr, rel_s.indices, seeds_s[17], DEPTH)
     assert int(edges_s[17]) == want, (int(edges_s[17]), want)
     ts = []
     for _ in range(3):
         t_r = time.perf_counter()
-        _l, _s, e2 = fn_s(jax.device_put(mask_s), DEPTH)
-        np.asarray(e2)
+        with tracing.span("bench.execute", stage="stage1"):
+            _l, _s, e2 = fn_s(jax.device_put(mask_s), DEPTH)
+            np.asarray(e2)
         ts.append(time.perf_counter() - t_r)
     small_edges = int(edges_s.astype(np.int64).sum())
     _stage({"stage": "stage1", "secs": round(time.perf_counter() - t0, 2),
             "compile_secs": round(compile_s, 2),
             "run_ms": round(min(ts) * 1e3, 1),
-            "edges_per_sec": round(small_edges / min(ts))})
+            "edges_per_sec": round(small_edges / min(ts)),
+            "telemetry": _stage_telemetry("stage1")})
     del ells_d, fn_s
 
     # -- stage2: full workload ----------------------------------------------
@@ -179,16 +211,18 @@ def child_main(platform: str, expect_path: str) -> None:
     build_s = time.perf_counter() - t0
 
     t0 = time.perf_counter()
-    ells_d = [jax.device_put(e) for e in g.ells]
-    outdeg_d = jax.device_put(g.outdeg)
-    mask_d = jax.device_put(mask0)
-    jax.block_until_ready(ells_d + [outdeg_d, mask_d])
+    with tracing.span("bench.transfer", stage="stage2"):
+        ells_d = [jax.device_put(e) for e in g.ells]
+        outdeg_d = jax.device_put(g.outdeg)
+        mask_d = jax.device_put(mask0)
+        jax.block_until_ready(ells_d + [outdeg_d, mask_d])
     put_s = time.perf_counter() - t0
 
     fn = make_ell_recurse(ells_d, outdeg_d, g.n, mask0.shape[1])
     t0 = time.perf_counter()
-    _l, _s, edges = fn(mask_d, DEPTH)
-    edges = np.asarray(edges).astype(np.int64)
+    with tracing.span("bench.compile", stage="stage2"):
+        _l, _s, edges = fn(mask_d, DEPTH)
+        edges = np.asarray(edges).astype(np.int64)
     compile_s = time.perf_counter() - t0
 
     # identical-work check against the parent's numpy walks
@@ -198,8 +232,9 @@ def child_main(platform: str, expect_path: str) -> None:
     ts = []
     for _ in range(DEV_REPS):
         t0 = time.perf_counter()
-        _l, _s, e2 = fn(mask_d, DEPTH)
-        np.asarray(e2)
+        with tracing.span("bench.execute", stage="stage2"):
+            _l, _s, e2 = fn(mask_d, DEPTH)
+            np.asarray(e2)
         ts.append(time.perf_counter() - t0)
     dev_s = min(ts)
     total_edges = int(edges.sum())
@@ -220,7 +255,8 @@ def child_main(platform: str, expect_path: str) -> None:
             "hbm_gbps": round(bytes_per_run / dev_s / 1e9, 1),
             "hbm_frac_of_peak": round(
                 bytes_per_run / dev_s / 1e9 / HBM_PEAK_GBPS, 3),
-            "padded_edges": g.padded_edges})
+            "padded_edges": g.padded_edges,
+            "telemetry": _stage_telemetry("stage2")})
     os._exit(0)
 
 
@@ -364,7 +400,8 @@ def main() -> None:
         out.update(value=round(dev_eps), platform=s2["platform"],
                    vs_baseline=round(dev_eps / base_eps, 2),
                    hbm_gbps=s2["hbm_gbps"],
-                   hbm_frac_of_peak=s2["hbm_frac_of_peak"])
+                   hbm_frac_of_peak=s2["hbm_frac_of_peak"],
+                   telemetry=s2.get("telemetry", {}))
     elif "stage1" in stages:
         s1 = stages["stage1"]
         out.update(value=s1["edges_per_sec"], platform=platform,

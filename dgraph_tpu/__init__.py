@@ -25,6 +25,14 @@ Layer map (see SURVEY.md §1):
   models/   built-in graph workload generators    (benchmarks fixtures)
   utils/    config, metrics, logging, tracing     (x/)
   native/   C++ host runtime (nquad parse, codec) (hot Go loops)
+
+Observability (x/metrics.go + OpenCensus spans in the reference): the
+query path emits spans (utils/tracing — unique span ids, per-request
+trace ids echoed in responses, Chrome trace-event export at
+/debug/events) and labeled Prometheus metrics (utils/metrics, served
+at /debug/prometheus_metrics); /debug/traces resolves a response's
+trace id to its engine/op/RPC spans, and --slow_query_ms logs slow
+queries with their trace id.
 """
 
 __version__ = "0.1.0"

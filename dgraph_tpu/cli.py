@@ -27,7 +27,9 @@ def cmd_alpha(args) -> int:
         "grpc_port": args.grpc_port, "log_level": args.log_level,
         "mesh_devices": args.mesh_devices,
         "encryption_key_file": args.encryption_key_file,
-        "encryption_strict": args.encryption_strict or None}
+        "encryption_strict": args.encryption_strict or None,
+        "slow_query_ms": args.slow_query_ms,
+        "trace_dir": args.trace_dir}
     if args.store:
         # grouped superflag (reference: z.SuperFlag, e.g.
         # --badger "compression=zstd; numgoroutines=8")
@@ -73,6 +75,15 @@ def cmd_alpha(args) -> int:
                        mesh=mesh,
                        memory_budget=(cfg.memory_budget_mb << 20)
                        if cfg.memory_budget_mb else None)
+    alpha.slow_query_ms = cfg.slow_query_ms
+    if cfg.slow_query_ms:
+        log.info("slow-query log armed at %d ms", cfg.slow_query_ms)
+    if cfg.trace_dir:
+        # device-timeline capture: spans marked device=True also write
+        # jax.profiler traces (Perfetto) under this dir
+        from dgraph_tpu.utils import tracing
+        tracing.enable_device_trace(cfg.trace_dir)
+        log.info("device trace capture armed: %s", cfg.trace_dir)
     if args.acl_secret_file:
         # ACL enforcement (reference: ee/acl --acl_secret_file): groot
         # bootstrap + token-gated endpoints
@@ -360,6 +371,13 @@ def main(argv=None) -> int:
                    help="out-of-core mode: fault predicate tablets from "
                         "the checkpoint on demand, LRU-evict above this "
                         "many MB resident (0 = fully resident)")
+    p.add_argument("--slow_query_ms", type=int, default=None,
+                   help="log queries slower than this many ms with "
+                        "their trace id (0 = off); spans stay "
+                        "retrievable at /debug/traces?trace_id=")
+    p.add_argument("--trace_dir", default=None,
+                   help="arm jax.profiler device-trace capture "
+                        "(Perfetto) for device-fenced spans")
     p.add_argument("--log_level", default="info")
     p.set_defaults(fn=cmd_alpha)
 

@@ -326,6 +326,8 @@ class ZeroState:
         last acked pull, so replayed blocks + that margin + 1 clears
         everything it ever handed out; the promote floor then aborts
         txns whose conflict history died with the old process."""
+        from dgraph_tpu.utils.metrics import METRICS
+        METRICS.inc("election_promoted_total")
         margin = (MAX_UNACKED_BLOCKS + 1) * LEASE_BLOCK
         floor = max(self.oracle.max_assigned, self._ts_block)
         self.oracle.bump_ts((floor // LEASE_BLOCK) * LEASE_BLOCK + margin)
@@ -683,6 +685,7 @@ def elect_better(state: ZeroState, my_addr: str, peers,
     STANDBY_GRACE_S lapses. since=0 never regresses the acked floor
     (the ack only ratchets up), so safety holds — the cost is spurious
     RESOURCE_EXHAUSTED retries during a mixed-version rollout."""
+    from dgraph_tpu.utils.metrics import METRICS
     my_seq = state._doc_base + len(state.doc_log)
     best = None
     reachable = 1                     # self
@@ -691,6 +694,7 @@ def elect_better(state: ZeroState, my_addr: str, peers,
             docs_, nxt, standby, _lid = ZeroClient(addr).journal_tail_full(
                 0, peek=True)
         except grpc.RpcError:
+            METRICS.inc("election_peer_unreachable_total")
             continue
         reachable += 1
         if not standby:
@@ -699,8 +703,10 @@ def elect_better(state: ZeroState, my_addr: str, peers,
                 (best is None or (nxt, addr) > best):
             best = (nxt, addr)
     if best:
+        METRICS.inc("election_lost_total")
         return best[1]
     if require_quorum and reachable < (len(peers) + 1) // 2 + 1:
+        METRICS.inc("election_deferred_total")
         return NO_QUORUM
     return None
 
@@ -733,6 +739,8 @@ def run_standby(state: ZeroState, primary_addr: str, poll_s: float = 1.0,
         require_quorum = bool(peers)
     elif peers and not require_quorum:
         from dgraph_tpu.utils import logging as xlog
+        from dgraph_tpu.utils.metrics import METRICS
+        METRICS.set_gauge("election_availability_mode", 1.0)
         xlog.get("zero").warning(
             "election AVAILABILITY mode (quorum opt-out): a symmetric "
             "partition between standbys can DUAL-PROMOTE — two primaries "

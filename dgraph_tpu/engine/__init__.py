@@ -61,12 +61,14 @@ class Engine:
         if sq is not None:
             return self._schema_query(*sq), None
 
+        from dgraph_tpu.utils import tracing
         blocks = parse(q, variables)
         ex = Executor(self.store, device_threshold=self.device_threshold,
                       mesh=self.mesh)
         results: dict[int, LevelNode] = {}
-        for i in execution_order(blocks):
-            results[i] = ex.run_block(blocks[i])
+        with tracing.span("engine.query", blocks=len(blocks)):
+            for i in execution_order(blocks):
+                results[i] = ex.run_block(blocks[i])
         roots = [results[i] for i in range(len(blocks))]  # textual order out
         return roots, ex
 

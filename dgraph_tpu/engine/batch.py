@@ -25,6 +25,9 @@ from dgraph_tpu.engine.execute import Executor, LevelNode
 from dgraph_tpu.engine.ir import SubGraph
 from dgraph_tpu.engine.outputnode import to_json
 from dgraph_tpu.engine.recurse import RecurseData, _bind_recurse_vars
+from dgraph_tpu.utils import tracing
+from dgraph_tpu.utils.jitcache import jit_call
+from dgraph_tpu.utils.metrics import METRICS
 
 MIN_BATCH = 4            # below this the per-query engine is cheaper
 # Depth is a static arg of the jitted kernel: each distinct value is an
@@ -156,10 +159,22 @@ def run_batch(store, plan, device_threshold: int) -> list:
     seed_lists = seeds + [np.zeros(0, np.int32)] * (B - len(seeds))
     mask0 = pack_seed_masks(g, seed_lists)
 
-    fn = _recurse_for(store, plan.attr, plan.reverse, mask0.shape[1])
-    _last, _seen, _edges, hops = fn(jax.device_put(mask0), plan.depth,
-                                    True)
-    hops = np.asarray(hops)          # [depth, n+1, W] fresh masks
+    # kernel-group telemetry: membership, lane-padding waste, compiles
+    METRICS.inc("kernel_group_launches_total", family="recurse")
+    METRICS.inc("kernel_group_queries_total", float(len(plan.blocks)),
+                family="recurse")
+    METRICS.inc("kernel_padded_lanes_total", float(B - len(seeds)),
+                family="recurse")
+    with tracing.span("batch.recurse_kernel", attr=plan.attr,
+                      depth=plan.depth, queries=len(plan.blocks),
+                      lanes=B, padded_lanes=B - len(seeds)):
+        fn = _recurse_for(store, plan.attr, plan.reverse, mask0.shape[1])
+        with jit_call("bfs.ell_recurse",
+                      (plan.attr, plan.reverse, int(mask0.shape[1]),
+                       plan.depth, g.n)):
+            _last, _seen, _edges, hops = fn(jax.device_put(mask0),
+                                            plan.depth, True)
+        hops = np.asarray(hops)      # [depth, n+1, W] fresh masks
     rel = store.rel(plan.attr, plan.reverse)
 
     out = []
@@ -261,7 +276,14 @@ def _ell_for(store, attr: str, reverse: bool):
             if rel.nnz == 0:
                 cache[key] = None
             else:
-                cache[key] = build_ell(rel.indptr, rel.indices)
+                with tracing.span("batch.build_ell", pred=attr,
+                                  reverse=reverse):
+                    g = build_ell(rel.indptr, rel.indices)
+                cache[key] = g
+                # degree-bucket padding waste: padded slots / real edges
+                METRICS.set_gauge("ell_padding_ratio",
+                                  g.padded_edges / max(g.nnz, 1),
+                                  pred=attr, reverse=str(reverse))
         return cache[key]
 
 

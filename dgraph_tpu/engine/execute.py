@@ -31,6 +31,9 @@ from dgraph_tpu.engine.funcs import (EMPTY, eval_func,
 from dgraph_tpu.engine.ir import FilterNode, FuncNode, Order, SubGraph
 from dgraph_tpu.store.store import Store
 from dgraph_tpu.store.types import Kind
+from dgraph_tpu.utils import tracing
+from dgraph_tpu.utils.jitcache import jit_call
+from dgraph_tpu.utils.metrics import METRICS
 
 
 EMPTY64 = np.zeros(0, np.int64)
@@ -106,20 +109,37 @@ class Executor:
         tablet in (reference: ProcessTaskOverNetwork); remote results
         carry no edge positions, so callers needing facets pass
         allow_remote=False."""
+        with tracing.span("ops.expand", pred=pred, reverse=reverse,
+                          frontier=int(len(frontier))) as sp:
+            out, path = self._expand_routed(pred, reverse, frontier,
+                                            allow_remote)
+            sp.attrs["path"] = path
+            sp.attrs["edges"] = int(len(out[0]))
+            if len(out[0]):
+                # the north-star counter, labeled by execution path
+                METRICS.inc("edges_traversed_total", float(len(out[0])),
+                            path=path)
+            return out
+
+    def _expand_routed(self, pred: str, reverse: bool,
+                       frontier: np.ndarray, allow_remote: bool):
+        """expand()'s dispatch body → ((nbrs, seg, pos), path) where
+        `path` names the execution route (telemetry label)."""
         if allow_remote and len(frontier):
             rem = getattr(self.store, "remote_expand", None)
             if rem is not None:
                 out = rem(pred, reverse, frontier)
                 if out is not None:
-                    return out
+                    return out, "remote"
         rel = self.store.rel(pred, reverse)
         if len(frontier) == 0 or rel.nnz == 0:
-            return EMPTY, EMPTY, EMPTY64
+            return (EMPTY, EMPTY, EMPTY64), "empty"
         if len(frontier) >= self.device_threshold:
             if self.mesh is not None:
-                return self._expand_mesh(pred, reverse, frontier)
-            return self._expand_device(pred, reverse, frontier)
-        return csr_rows(rel, frontier)
+                return self._expand_mesh(pred, reverse, frontier), "mesh"
+            return (self._expand_device(pred, reverse, frontier),
+                    "device")
+        return csr_rows(rel, frontier), "numpy"
 
     def facet_positions(self, sg: SubGraph, pos: np.ndarray) -> np.ndarray:
         """Edge positions in the forward-CSR space facet columns key on
@@ -241,7 +261,10 @@ class Executor:
         fr = ops.pad_to(frontier, fcap)
         deg = self.store.rel(pred, reverse).degree(frontier)
         ecap = _bucket(max(int(deg.sum()), 1))
-        nbrs, seg, pos, valid, total = ops.gather_edges(indptr, indices, fr, ecap)
+        from dgraph_tpu.ops.hop import launch_key
+        with jit_call("hop.gather_edges", launch_key(indptr, fr, ecap)):
+            nbrs, seg, pos, valid, total = ops.gather_edges(
+                indptr, indices, fr, ecap)
         valid = np.asarray(valid)
         return (np.asarray(nbrs)[valid], np.asarray(seg)[valid],
                 np.asarray(pos)[valid].astype(np.int64))
@@ -480,6 +503,12 @@ class Executor:
     # -- block execution ----------------------------------------------------
     def run_block(self, sg: SubGraph) -> LevelNode:
         """Execute one root block (reference: Request.ProcessQuery per block)."""
+        with tracing.span("engine.block", block=sg.attr) as sp:
+            node = self._run_block(sg)
+            sp.attrs["nodes"] = int(len(node.nodes))
+            return node
+
+    def _run_block(self, sg: SubGraph) -> LevelNode:
         if sg.shortest is not None:
             from dgraph_tpu.engine.shortest import shortest_path
             data = shortest_path(self, sg)
@@ -536,16 +565,24 @@ class Executor:
         applied (the fused device path, which is only eligible when no
         ordering exists). The lane-batch executor overrides this with
         mask-constrained CSR intersection (engine/treebatch.py)."""
-        fused = self._fused_level(sg, frontier)
-        if fused is not None:
-            return (*fused, True)
-        nbrs, seg, pos = self.expand(
-            sg.attr, sg.is_reverse, frontier,
-            allow_remote=not _needs_facets(sg))
-        nbrs, seg, pos = self.filter_edges(sg.filters, nbrs, seg, pos)
-        nbrs, seg, pos = self.facet_filter_edges(sg, sg.attr, nbrs,
-                                                 seg, pos)
-        return nbrs, seg, pos, False
+        with tracing.span("engine.level", pred=sg.attr,
+                          frontier=int(len(frontier))) as sp:
+            fused = self._fused_level(sg, frontier)
+            if fused is not None:
+                sp.attrs["path"] = "fused"
+                sp.attrs["edges"] = int(len(fused[0]))
+                if len(fused[0]):
+                    METRICS.inc("edges_traversed_total",
+                                float(len(fused[0])), path="fused")
+                return (*fused, True)
+            nbrs, seg, pos = self.expand(
+                sg.attr, sg.is_reverse, frontier,
+                allow_remote=not _needs_facets(sg))
+            nbrs, seg, pos = self.filter_edges(sg.filters, nbrs, seg, pos)
+            nbrs, seg, pos = self.facet_filter_edges(sg, sg.attr, nbrs,
+                                                     seg, pos)
+            sp.attrs["edges"] = int(len(nbrs))
+            return nbrs, seg, pos, False
 
     def _finish_child(self, sg: SubGraph, nbrs, seg, pos,
                       processed: bool) -> LevelNode:
@@ -663,10 +700,13 @@ class Executor:
                                           first, use_allowed)
         indptr, indices = self.store.device_rel(sg.attr, sg.is_reverse)
         ecap = _bucket(max(int(deg.sum()), 1))
-        c_nbrs, c_seg, c_pos, n_kept, _nxt, _nu, total = expand_level(
-            indptr, indices, fr, allowed_d,
-            np.int32(sg.offset), np.int32(first),
-            edge_cap=ecap, out_cap=ecap, use_allowed=use_allowed)
+        with jit_call("level.expand_level",
+                      (int(indptr.shape[0]), int(fr.shape[0]),
+                       int(allowed_d.shape[0]), ecap, use_allowed)):
+            c_nbrs, c_seg, c_pos, n_kept, _nxt, _nu, total = expand_level(
+                indptr, indices, fr, allowed_d,
+                np.int32(sg.offset), np.int32(first),
+                edge_cap=ecap, out_cap=ecap, use_allowed=use_allowed)
         n = int(n_kept)
         assert int(total) <= ecap, (int(total), ecap)
         return (np.asarray(c_nbrs)[:n], np.asarray(c_seg)[:n],

@@ -43,6 +43,17 @@ def shortest_path(ex, sg) -> PathData:
     When an edge block names a facet (`friend @facets(weight)`), edges are
     relaxed by that facet's value instead of uniform cost — reference:
     query/shortest.go facet-weight relaxation."""
+    from dgraph_tpu.utils import tracing
+    a = sg.shortest
+    with tracing.span("engine.shortest", numpaths=a.numpaths,
+                      depth=a.depth) as sp:
+        data = _shortest_path(ex, sg)
+        sp.attrs["paths"] = len(data.paths)
+        sp.attrs["nodes"] = int(len(data.nodes))
+        return data
+
+
+def _shortest_path(ex, sg) -> PathData:
     args = sg.shortest
     store = ex.store
     src = store.rank_of(np.array([args.from_uid], np.int64))[0]

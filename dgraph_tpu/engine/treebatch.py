@@ -346,9 +346,19 @@ def run_tree_batch(store, plan: TreePlan, device_threshold: int) -> list:
     seeds_np = [_pack_global(n, lst, lanes) for lst in seed_lists]
     filts_np = [_pack_global(n, lst, lanes) for lst in filt_lists]
 
+    from dgraph_tpu.utils import tracing
+    from dgraph_tpu.utils.jitcache import jit_call
+    from dgraph_tpu.utils.metrics import METRICS
+    METRICS.inc("kernel_group_launches_total", family="tree")
+    METRICS.inc("kernel_group_queries_total", float(B), family="tree")
+    METRICS.inc("kernel_padded_lanes_total", float(lanes - B),
+                family="tree")
     fn, stage_descs = _tree_kernel_for(store, plan, rels, n, W)
-    outs = fn(tuple(jax.device_put(m) for m in seeds_np),
-              tuple(jax.device_put(m) for m in filts_np))
+    with tracing.span("batch.tree_kernel", stages=len(plan.stages),
+                      queries=B, lanes=lanes, padded_lanes=lanes - B):
+        with jit_call("treebatch.tree_kernel", (plan.sig, W, n)):
+            outs = fn(tuple(jax.device_put(m) for m in seeds_np),
+                      tuple(jax.device_put(m) for m in filts_np))
 
     # one host transfer per stage output; bit tests against these masks
     # rebuild every query's edge rows

@@ -276,17 +276,24 @@ def _pallas_bucket_part(e, n_b, frontier):
     on the same padded input; the fallback skips the chunked-budget
     shape (this is a failure path, not the tuned one)."""
     global _pallas_failed
+    from dgraph_tpu.utils.metrics import METRICS
     if not _pallas_failed:
         try:
             from dgraph_tpu.ops.pallas_hop import bucket_hop_pallas
             return bucket_hop_pallas(e, frontier)[:n_b]
         except Exception:  # noqa: BLE001 — any trace/compile failure
             _pallas_failed = True
+            METRICS.set_gauge("pallas_degraded", 1.0)
             from dgraph_tpu.utils import logging as xlog
             xlog.get("ops").warning(
                 "pallas hop failed to trace/compile; falling back to "
                 "the XLA gather hop for every bucket (perf experiment "
                 "degraded, results unaffected)", exc_info=True)
+    # counted per fallback BUCKET TRACE (this body runs at trace time,
+    # once per compiled program, not per execution): the sticky
+    # degradation stays visible in /debug/prometheus_metrics instead of
+    # one log line scrolling away
+    METRICS.inc("pallas_fallback_total")
     return lax.reduce(frontier[e], jnp.uint32(0),
                       lax.bitwise_or, (1,))[:n_b]
 

@@ -30,6 +30,17 @@ from jax import lax
 from dgraph_tpu.ops.uidalgebra import sentinel, sort_unique_count, valid_mask
 
 
+def launch_key(indptr, frontier, edge_cap: int,
+               out_cap: int | None = None) -> tuple:
+    """The static configuration that forces a distinct XLA program for a
+    hop launch: CSR height (per predicate/direction), frontier bucket,
+    and the edge/out caps. Compile-cache accounting (utils/jitcache)
+    keys on exactly this tuple — anything else re-uses a cached
+    executable."""
+    return (int(indptr.shape[0]), int(frontier.shape[0]),
+            int(edge_cap), out_cap)
+
+
 @jax.jit
 def frontier_degrees(indptr: jax.Array, frontier: jax.Array) -> jax.Array:
     """Out-degree of each frontier rank (0 for padding). Reference: List.ApproxLen/count index."""

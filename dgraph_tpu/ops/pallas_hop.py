@@ -65,6 +65,13 @@ def _interpret() -> bool:
 
 
 def _make_kernel(K: int, W: int, n_buf: int):
+    # runs once per pallas_call CONSTRUCTION (i.e. per trace of
+    # bucket_hop_pallas): counts Mosaic kernel builds per bucket width —
+    # the observable that separates "compiling" from "wedged" when a
+    # chip window goes quiet
+    from dgraph_tpu.utils.metrics import METRICS
+    METRICS.inc("pallas_kernel_builds_total", k=str(K), w=str(W))
+
     def kernel(nbr_ref, frontier_ref, out_ref, rows, sems):
         br = nbr_ref.shape[0]
         total = br * K

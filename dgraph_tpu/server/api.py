@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import contextlib
 import threading
+import time
 from dataclasses import dataclass, field
 
 from dgraph_tpu.cluster.oracle import Oracle, TxnAborted
@@ -29,6 +30,8 @@ from dgraph_tpu.store.mvcc import MVCCStore, Mutation
 from dgraph_tpu.store.schema import parse_schema
 from dgraph_tpu.store.store import Store
 from dgraph_tpu.store.types import Kind, hash_password
+from dgraph_tpu.utils import tracing
+from dgraph_tpu.utils.metrics import METRICS
 
 __all__ = ["Alpha", "Txn", "TxnAborted", "NoQuorum", "ReadUnavailable",
            "StageRefused"]
@@ -111,6 +114,8 @@ class Alpha:
         # absorbed by a checkpoint); FetchLog answers "complete" only above
         self._wal_floor = base_ts
         self.remote_hop_max = 4096  # frontier cap for per-hop routing
+        # slow-query log threshold in ms (0 = off; --slow_query_ms flag)
+        self.slow_query_ms = 0.0
         self.acl = None  # server/acl.AclManager | None (enforcement on)
         self._apply_lock = threading.Lock()
         self._state_lock = threading.Lock()
@@ -365,11 +370,15 @@ class Alpha:
         unreachable: dict[str, int | None] = {}
         reachable: list[str] = []
         for addr in replicas:
+            t0 = _time.perf_counter()
             try:
                 node, head = self.groups.pool(addr).chain_head()
             except _grpc.RpcError:
                 unreachable[addr] = self.groups.node_of_addr(addr)
                 continue
+            METRICS.observe("rpc_latency_us",
+                            (_time.perf_counter() - t0) * 1e6,
+                            rpc="chain_head")
             reachable.append(addr)
             if not node:
                 continue  # peer not in cluster mode: no chain to check
@@ -408,6 +417,7 @@ class Alpha:
                     self._last_from.get(node, 0), head)
         if unreachable:
             if 1 + len(reachable) < majority:
+                METRICS.inc("read_unavailable_total", reason="minority")
                 raise ReadUnavailable(
                     f"read at ts {ts}: replica(s) "
                     f"{sorted(unreachable)} unreachable and the "
@@ -437,6 +447,8 @@ class Alpha:
                 except _grpc.RpcError:
                     continue
             if not healed:
+                METRICS.inc("read_unavailable_total",
+                            reason="heal_failed")
                 raise ReadUnavailable(
                     f"read at ts {ts}: could not pull the tail of "
                     f"unreachable replica(s) {sorted(unreachable)} "
@@ -449,6 +461,8 @@ class Alpha:
                 # in the unreachable coordinator's WAL: serving without
                 # them risks a lost update (stale read below the
                 # commit's ts — conflict detection cannot catch it)
+                METRICS.inc("read_unavailable_total",
+                            reason="undecided_pend")
                 raise ReadUnavailable(
                     f"read at ts {ts}: staged record(s) {sorted(still)} "
                     f"from unreachable coordinator(s) are undecided "
@@ -886,6 +900,7 @@ class Alpha:
                 with self._state_lock:
                     self._pending.pop(commit_ts, None)
                 self._send_decisions(replicas, commit_ts, False)
+                METRICS.inc("noquorum_total", phase="stage")
                 raise NoQuorum(
                     f"commit {commit_ts}: {acks}/{len(replicas) + 1} "
                     f"replicas durably logged it; majority "
@@ -931,6 +946,7 @@ class Alpha:
             except _grpc.RpcError:
                 continue
         if alive < majority:
+            METRICS.inc("noquorum_total", phase="preflight")
             raise NoQuorum(
                 f"only {alive}/{len(replicas) + 1} group replicas "
                 f"reachable; majority {majority} required")
@@ -1160,7 +1176,14 @@ class Alpha:
         from dgraph_tpu.utils import logging as xlog
         log = xlog.get("alpha")
         since_ts = max(since_ts, self.mvcc.base_ts)
-        records, complete = self.groups.pool(addr).fetch_log(since_ts)
+        with tracing.span("rpc.fetch_log", peer=addr,
+                          since_ts=since_ts) as sp:
+            t0 = time.perf_counter()
+            records, complete = self.groups.pool(addr).fetch_log(since_ts)
+            METRICS.observe("rpc_latency_us",
+                            (time.perf_counter() - t0) * 1e6,
+                            rpc="fetch_log")
+            sp.attrs["records"] = len(records)
         applied = 0
         seen_max = self.mvcc.base_ts if since_ts <= self.mvcc.base_ts \
             else 0
@@ -1198,6 +1221,8 @@ class Alpha:
                 self.apply_committed(obj, ts)
             applied += 1
         if applied:
+            METRICS.inc("fetchlog_heals_total")
+            METRICS.inc("fetchlog_records_applied_total", float(applied))
             log.info("caught up %d records > ts %d from %s",
                      applied, since_ts, addr)
         if not complete:
@@ -1405,10 +1430,16 @@ class Alpha:
         if cached is not None:
             return cached
         from dgraph_tpu.cluster.tablet import unpack_tablet
-        from dgraph_tpu.utils.metrics import METRICS
-        blob, got_version = self.groups.call_group(
-            gid, lambda c: c.tablet_snapshot(pred, read_ts),
-            exclude=set(self._suspect_peers))
+        with tracing.span("rpc.tablet_snapshot", pred=pred,
+                          read_ts=read_ts) as sp:
+            t0 = time.perf_counter()
+            blob, got_version = self.groups.call_group(
+                gid, lambda c: c.tablet_snapshot(pred, read_ts),
+                exclude=set(self._suspect_peers))
+            METRICS.observe("rpc_latency_us",
+                            (time.perf_counter() - t0) * 1e6,
+                            rpc="tablet_snapshot")
+            sp.attrs["bytes"] = len(blob) if blob else 0
         if not blob:
             return None
         METRICS.inc("tablet_bytes_fetched", len(blob))
@@ -1453,14 +1484,19 @@ class Alpha:
             # locally present and fresh (e.g. the tablet just moved away
             # from this node): serve from memory, skip the RPC
             return None
-        from dgraph_tpu.utils.metrics import METRICS
         uids = view.uid_of(np.asarray(frontier, np.int32)).astype(
             np.uint64)
-        res = self.groups.call_group(
-            gid, lambda c: c.serve_task(
-                attr=pred, reverse=reverse,
-                frontier={"uids": uids.tolist()}, read_ts=read_ts),
-            exclude=set(self._suspect_peers))
+        with tracing.span("rpc.serve_task", pred=pred,
+                          frontier=int(len(uids))):
+            t0 = time.perf_counter()
+            res = self.groups.call_group(
+                gid, lambda c: c.serve_task(
+                    attr=pred, reverse=reverse,
+                    frontier={"uids": uids.tolist()}, read_ts=read_ts),
+                exclude=set(self._suspect_peers))
+            METRICS.observe("rpc_latency_us",
+                            (time.perf_counter() - t0) * 1e6,
+                            rpc="serve_task")
         nbrs_parts, seg_parts = [], []
         total_uids = 0
         for i, row in enumerate(res.matrix.rows):

@@ -12,11 +12,14 @@ from __future__ import annotations
 import json
 import threading
 import time
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from dgraph_tpu.dql.upsert import is_upsert as _is_upsert
 from dgraph_tpu.server.api import (Alpha, NoQuorum, ReadUnavailable,
                                    TxnAborted)
+from dgraph_tpu.utils import logging as xlog
+from dgraph_tpu.utils import tracing
 from dgraph_tpu.utils.metrics import METRICS
 
 
@@ -101,8 +104,43 @@ def make_http_server(alpha: Alpha, addr: str = "127.0.0.1",
                 self._send(200, st)
             elif self.path == "/debug/prometheus_metrics":
                 self._send(200, METRICS.render(), "text/plain")
+            elif self.path.startswith("/debug/traces"):
+                # span JSON: ?trace_id=… resolves one request's spans
+                # (the id echoed in that response's extensions); bare
+                # GET returns the recent ring buffer
+                spans = self._debug_spans()
+                self._send(200, {"spans": [s.to_dict() for s in spans]})
+            elif self.path.startswith("/debug/events"):
+                # the same spans as Chrome trace-event JSON — load the
+                # body directly in Perfetto / chrome://tracing
+                spans = self._debug_spans()
+                self._send(200, tracing.to_chrome(spans))
             else:
                 self._send(404, {"errors": [{"message": "not found"}]})
+
+        def _debug_spans(self):
+            qs = urllib.parse.parse_qs(
+                urllib.parse.urlsplit(self.path).query)
+            tid = (qs.get("trace_id") or [None])[0]
+            if tid:
+                return tracing.trace_spans(tid)
+            n = int((qs.get("n") or [256])[0])
+            return tracing.recent(n)
+
+        def _slow_query_check(self, us: int, trace_id: str,
+                              q: str) -> None:
+            """Slow-query log (reference: the query log at --v=3 /
+            slow-query tooling): queries past --slow_query_ms log with
+            their trace id so the spans can be pulled from
+            /debug/traces after the fact."""
+            thresh_ms = getattr(alpha, "slow_query_ms", 0) or 0
+            if thresh_ms <= 0 or us < thresh_ms * 1000:
+                return
+            METRICS.inc("slow_queries_total")
+            xlog.get("http").warning(
+                "slow query: %.1f ms (threshold %s ms) trace_id=%s "
+                "query=%.200s", us / 1000.0, thresh_ms, trace_id,
+                " ".join(q.split()))
 
         def _acl_user(self):
             """Resolve the access token when ACL is on (reference: the
@@ -129,11 +167,18 @@ def make_http_server(alpha: Alpha, addr: str = "127.0.0.1",
                 acl_user = self._acl_user()
                 if self.path.startswith("/query/batch"):
                     req = json.loads(self._body().decode())
-                    outs = alpha.query_batch(req["queries"],
-                                             acl_user=acl_user)
-                    METRICS.observe("query_latency_us",
-                                    (time.perf_counter() - t0) * 1e6)
-                    self._send(200, {"data": outs})
+                    with tracing.trace("http.query_batch",
+                                       queries=len(req["queries"])) as tid:
+                        outs = alpha.query_batch(req["queries"],
+                                                 acl_user=acl_user)
+                    us = int((time.perf_counter() - t0) * 1e6)
+                    METRICS.observe("query_latency_us", us,
+                                    endpoint="query_batch")
+                    self._slow_query_check(us, tid,
+                                           f"<batch of "
+                                           f"{len(req['queries'])}>")
+                    self._send(200, {"data": outs,
+                                     "extensions": {"trace_id": tid}})
                 elif self.path.startswith("/query"):
                     body = self._body().decode()
                     if "application/json" in (
@@ -142,14 +187,19 @@ def make_http_server(alpha: Alpha, addr: str = "127.0.0.1",
                         q, variables = req["query"], req.get("variables")
                     else:
                         q, variables = body, None
-                    raw = alpha.query_raw(q, variables, acl_user=acl_user)
+                    with tracing.trace("http.query") as tid:
+                        raw = alpha.query_raw(q, variables,
+                                              acl_user=acl_user)
                     us = int((time.perf_counter() - t0) * 1e6)
-                    METRICS.observe("query_latency_us", us)
+                    METRICS.observe("query_latency_us", us,
+                                    endpoint="query")
+                    self._slow_query_check(us, tid, q)
                     # splice the emitter's bytes into the envelope — the
                     # response body is never re-parsed server-side
                     self._send_bytes(200, b'{"data":' + raw +
                                      b',"extensions":{"server_latency":'
-                                     b'{"total_us":%d}}}' % us)
+                                     b'{"total_us":%d},"trace_id":"%s"}}'
+                                     % (us, tid.encode()))
                 elif self.path.startswith("/mutate"):
                     ctype = self.headers.get("Content-Type") or ""
                     body = self._body().decode()

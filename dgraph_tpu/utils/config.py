@@ -44,6 +44,8 @@ class AlphaConfig:
                                   # tablet faulting under this budget
     encryption_key_file: str = ""  # at-rest AES key (reference: ee enc)
     encryption_strict: bool = False  # reject plaintext files once migrated
+    slow_query_ms: int = 0        # log queries slower than this (0 = off)
+    trace_dir: str = ""           # arm jax.profiler device-trace capture
     log_level: str = "info"
 
 

@@ -1,0 +1,66 @@
+"""Host-side jit compile-cache accounting.
+
+XLA retraces/recompiles whenever a kernel launch's STATIC configuration
+(bucketed shapes, caps, depth) changes; the r5 bench stall showed that a
+wedged chip and a multi-second compile are indistinguishable without
+telemetry. Each kernel call site wraps its launch in `jit_call(kernel,
+key)` where `key` is exactly the static tuple that forces a distinct
+program — first sight of a key counts as a compile (timed: the first
+invocation traces + compiles synchronously before dispatch), repeats
+count as cache hits.
+
+The timing is an upper bound on compile cost (it includes the first
+dispatch), which is the honest observable without reaching into XLA
+internals; steady-state calls are classified exactly.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+
+from dgraph_tpu.utils import tracing
+from dgraph_tpu.utils.metrics import METRICS
+
+_seen: set = set()
+_lock = threading.Lock()
+
+# compile times ladder: 10ms … 100s in µs
+COMPILE_BUCKETS_US = (10_000, 100_000, 500_000, 1_000_000, 5_000_000,
+                      10_000_000, 100_000_000)
+
+
+def seen(kernel: str, key: tuple) -> bool:
+    with _lock:
+        return (kernel, key) in _seen
+
+
+@contextlib.contextmanager
+def jit_call(kernel: str, key: tuple):
+    """Wrap one jitted-kernel launch; classifies it as compile (first
+    time this static key is seen) or cache hit, and feeds the shared
+    metrics/tracing registries. Yields True when a compile is expected."""
+    with _lock:
+        new = (kernel, key) not in _seen
+        if new:
+            _seen.add((kernel, key))
+    if not new:
+        METRICS.inc("jit_cache_hits_total", kernel=kernel)
+        yield False
+        return
+    METRICS.inc("jit_compile_total", kernel=kernel)
+    t0 = time.perf_counter()
+    with tracing.span("jit.compile", kernel=kernel, key=str(key)):
+        try:
+            yield True
+        finally:
+            METRICS.observe("jit_compile_us",
+                            (time.perf_counter() - t0) * 1e6,
+                            buckets=COMPILE_BUCKETS_US, kernel=kernel)
+
+
+def reset() -> None:
+    """Test hook: forget every key (a fresh process compiles anew)."""
+    with _lock:
+        _seen.clear()

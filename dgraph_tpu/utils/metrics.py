@@ -1,43 +1,90 @@
-"""Prometheus-text metrics registry.
+"""Prometheus-text metrics registry with labels.
 
 Reference parity: `x/metrics.go` + the `/debug/prometheus_metrics`
 endpoint — query latency histograms, pending txns, and (our north-star
 first-class counter, per BASELINE.json) edges traversed. No client
 library dependency: counters/gauges/histograms rendered in Prometheus
-text exposition format directly.
+text exposition format directly, including label sets with the escaping
+rules the format mandates (`\\`, `\"`, `\n` in label values).
+
+Every series is keyed (name, sorted label tuple); label-free calls keep
+their historical plain-name identity so existing consumers (snapshot
+readers, the cluster transfer-byte tests) see no change. Histograms use
+the standard µs latency bucket ladder (`BUCKETS_US`) unless the first
+observation for a name registers a custom ladder.
 """
 
 from __future__ import annotations
 
 import threading
-from collections import defaultdict
 
-_BUCKETS = (100, 1_000, 10_000, 100_000, 1_000_000, 10_000_000)
+# standard µs latency ladder: 100µs … 10s, then +Inf
+BUCKETS_US = (100, 1_000, 10_000, 100_000, 1_000_000, 10_000_000)
+_BUCKETS = BUCKETS_US  # back-compat alias
+
+
+def _label_key(labels: dict) -> tuple:
+    # values stringify at the key: one series per rendered identity, and
+    # render()'s sorted() never compares int with str across series
+    return tuple(sorted((k, str(v)) for k, v in labels.items()))
+
+
+def _escape(v) -> str:
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _series(name: str, lk: tuple, extra: str = "") -> str:
+    """`name` or `name{a="b",...}`; `extra` appends e.g. the le label."""
+    parts = [f'{k}="{_escape(v)}"' for k, v in lk]
+    if extra:
+        parts.append(extra)
+    return f"{name}{{{','.join(parts)}}}" if parts else name
 
 
 class Registry:
     def __init__(self):
         self._lock = threading.Lock()
-        self._counters: dict[str, float] = defaultdict(float)
-        self._gauges: dict[str, float] = {}
-        self._hists: dict[str, list] = {}
+        self._counters: dict[tuple[str, tuple], float] = {}
+        self._gauges: dict[tuple[str, tuple], float] = {}
+        self._hists: dict[tuple[str, tuple], list] = {}
+        self._hist_buckets: dict[str, tuple] = {}
+        self._enabled = True
 
-    def inc(self, name: str, value: float = 1.0) -> None:
-        with self._lock:
-            self._counters[name] += value
+    def set_enabled(self, flag: bool) -> None:
+        """Disarm recording (render/snapshot still serve what exists) —
+        the switch the <5% query-path overhead guard flips."""
+        self._enabled = bool(flag)
 
-    def set_gauge(self, name: str, value: float) -> None:
+    def inc(self, name: str, value: float = 1.0, **labels) -> None:
+        if not self._enabled:
+            return
+        k = (name, _label_key(labels))
         with self._lock:
-            self._gauges[name] = value
+            self._counters[k] = self._counters.get(k, 0.0) + value
 
-    def observe(self, name: str, value: float) -> None:
-        """Histogram observation (µs-scale buckets)."""
+    def set_gauge(self, name: str, value: float, **labels) -> None:
+        if not self._enabled:
+            return
         with self._lock:
-            h = self._hists.get(name)
+            self._gauges[(name, _label_key(labels))] = value
+
+    def observe(self, name: str, value: float,
+                buckets: tuple | None = None, **labels) -> None:
+        """Histogram observation. Buckets default to the µs ladder; a
+        custom ladder binds to `name` on first observation (per-name, so
+        every label set of one histogram shares one ladder)."""
+        if not self._enabled:
+            return
+        k = (name, _label_key(labels))
+        with self._lock:
+            bks = self._hist_buckets.setdefault(
+                name, tuple(buckets) if buckets else BUCKETS_US)
+            h = self._hists.get(k)
             if h is None:
-                h = self._hists[name] = [[0] * (len(_BUCKETS) + 1), 0.0, 0]
+                h = self._hists[k] = [[0] * (len(bks) + 1), 0.0, 0]
             counts, _sum, _n = h
-            for i, b in enumerate(_BUCKETS):
+            for i, b in enumerate(bks):
                 if value <= b:
                     counts[i] += 1
                     break
@@ -46,33 +93,53 @@ class Registry:
             h[1] += value
             h[2] += 1
 
+    def get(self, name: str, **labels) -> float:
+        """Current counter value (0.0 when the series doesn't exist)."""
+        with self._lock:
+            return self._counters.get((name, _label_key(labels)), 0.0)
+
     def render(self) -> str:
         """Prometheus text exposition format."""
         out = []
         with self._lock:
-            for k, v in sorted(self._counters.items()):
-                out.append(f"# TYPE dgraph_tpu_{k} counter")
-                out.append(f"dgraph_tpu_{k} {v}")
-            for k, v in sorted(self._gauges.items()):
-                out.append(f"# TYPE dgraph_tpu_{k} gauge")
-                out.append(f"dgraph_tpu_{k} {v}")
-            for k, (counts, s, n) in sorted(self._hists.items()):
-                out.append(f"# TYPE dgraph_tpu_{k} histogram")
+            for kind, table in (("counter", self._counters),
+                                ("gauge", self._gauges)):
+                last_name = None
+                for (name, lk), v in sorted(table.items()):
+                    if name != last_name:
+                        out.append(f"# TYPE dgraph_tpu_{name} {kind}")
+                        last_name = name
+                    out.append(f"dgraph_tpu_{_series(name, lk)} {v}")
+            last_name = None
+            for (name, lk), (counts, s, n) in sorted(self._hists.items()):
+                if name != last_name:
+                    out.append(f"# TYPE dgraph_tpu_{name} histogram")
+                    last_name = name
+                bks = self._hist_buckets[name]
                 acc = 0
-                for b, c in zip(_BUCKETS, counts):
+                for b, c in zip(bks, counts):
                     acc += c
+                    le = f'le="{b}"'
                     out.append(
-                        f'dgraph_tpu_{k}_bucket{{le="{b}"}} {acc}')
+                        f"dgraph_tpu_{_series(name + '_bucket', lk, le)}"
+                        f" {acc}")
+                inf = 'le="+Inf"'
                 out.append(
-                    f'dgraph_tpu_{k}_bucket{{le="+Inf"}} {n}')
-                out.append(f"dgraph_tpu_{k}_sum {s}")
-                out.append(f"dgraph_tpu_{k}_count {n}")
+                    f"dgraph_tpu_{_series(name + '_bucket', lk, inf)} {n}")
+                out.append(f"dgraph_tpu_{_series(name + '_sum', lk)} {s}")
+                out.append(f"dgraph_tpu_{_series(name + '_count', lk)} {n}")
         return "\n".join(out) + "\n"
 
     def snapshot(self) -> dict:
+        """Flat dict view. Label-free series keep their bare name (the
+        historical shape); labeled ones render as `name{k="v",...}`."""
         with self._lock:
-            return {"counters": dict(self._counters),
-                    "gauges": dict(self._gauges)}
+            return {
+                "counters": {_series(n, lk): v
+                             for (n, lk), v in self._counters.items()},
+                "gauges": {_series(n, lk): v
+                           for (n, lk), v in self._gauges.items()},
+            }
 
 
 METRICS = Registry()

@@ -1,33 +1,78 @@
-"""Tracing: per-hop spans + device profiling.
+"""Tracing: per-request trace ids, per-hop spans + device profiling.
 
 Reference parity: OpenCensus spans around each `ProcessTaskOverNetwork`
 leg with Jaeger export (SURVEY §5). TPU equivalent: lightweight in-process
-spans (queryable buffer + log lines) and `jax.profiler` trace capture for
-Perfetto when a trace directory is set. Spans fence device work with
-`block_until_ready` so timings are honest.
+spans (queryable ring buffer + per-trace index, served by
+`/debug/traces` and — as Chrome trace-event JSON, Perfetto-loadable —
+`/debug/events`) and `jax.profiler` trace capture for device timelines
+when a trace directory is set. Spans fence device work with
+`jax.effects_barrier` so timings are honest.
+
+Identity model: every span gets a process-unique integer `span_id`;
+nesting is a thread-local STACK of span ids, so concurrent (or nested)
+spans that share a name can never alias each other — the historical
+name-keyed parent tracking did exactly that. A span belongs to the
+trace id established by the enclosing `trace()` context (one per
+request on the serving path); spans opened outside any trace carry
+trace_id "" and only live in the ring buffer.
+
+`set_enabled(False)` turns span recording into a near-no-op (one flag
+check) — the observability layer must never become the regression
+(tier-1 guards the query-path overhead at <5%).
 """
 
 from __future__ import annotations
 
 import contextlib
+import itertools
 import threading
 import time
-from collections import deque
+import uuid
+from collections import OrderedDict, deque
 from dataclasses import dataclass, field
 
 _TRACE_DIR: str | None = None
 _BUF: deque = deque(maxlen=4096)
+_TRACES: "OrderedDict[str, list]" = OrderedDict()
+_MAX_TRACES = 256          # retained per-trace span lists
+_MAX_TRACE_SPANS = 4096    # spans retained per trace
 _LOCK = threading.Lock()
 _TLS = threading.local()
+_IDS = itertools.count(1)  # CPython: count.__next__ is atomic
+_ENABLED = True
 
 
 @dataclass
 class Span:
     name: str
-    start_us: int
+    span_id: int = 0
+    parent_id: int = 0          # 0 = root of its thread's stack
+    trace_id: str = ""          # "" = outside any trace() context
+    start_us: int = 0           # wall-clock epoch µs (Chrome `ts`)
     dur_us: int = 0
-    parent: str = ""
+    tid: int = 0                # OS thread id (Chrome track)
     attrs: dict = field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        return {"name": self.name, "span_id": self.span_id,
+                "parent_id": self.parent_id, "trace_id": self.trace_id,
+                "start_us": self.start_us, "dur_us": self.dur_us,
+                "tid": self.tid, "attrs": dict(self.attrs)}
+
+
+# reused sink for disabled spans: callers may still write attrs into it
+_NULL_SPAN = Span(name="")
+
+
+def set_enabled(flag: bool) -> None:
+    """Globally arm/disarm span recording (metrics have their own
+    switch). Disabled spans cost one attribute load per enter/exit."""
+    global _ENABLED
+    _ENABLED = bool(flag)
+
+
+def enabled() -> bool:
+    return _ENABLED
 
 
 def enable_device_trace(trace_dir: str) -> None:
@@ -36,15 +81,53 @@ def enable_device_trace(trace_dir: str) -> None:
     _TRACE_DIR = trace_dir
 
 
+def new_trace_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+def current_trace_id() -> str:
+    return getattr(_TLS, "trace_id", "")
+
+
+@contextlib.contextmanager
+def trace(name: str = "request", trace_id: str | None = None, **attrs):
+    """Establish a trace context: every span opened on this thread while
+    inside (the root `name` span included) is indexed under the yielded
+    trace id — the id the serving path echoes to clients and
+    `/debug/traces?trace_id=` resolves."""
+    tid = trace_id or new_trace_id()
+    prev = getattr(_TLS, "trace_id", "")
+    _TLS.trace_id = tid
+    try:
+        with span(name, **attrs):
+            yield tid
+    finally:
+        _TLS.trace_id = prev
+
+
 @contextlib.contextmanager
 def span(name: str, device: bool = False, **attrs):
-    """Time a region; nests via thread-local parent tracking.
+    """Time a region; nests via a thread-local stack of span IDS (names
+    never participate in parent tracking — same-name spans, nested or
+    concurrent, stay distinct). Yields the Span so callers can attach
+    attrs discovered mid-region (edge counts, chosen code path).
 
     `device=True` additionally wraps the region in a jax.profiler trace
     (if armed) and blocks on async dispatch before closing the span.
     """
-    parent = getattr(_TLS, "current", "")
-    _TLS.current = name
+    if not _ENABLED and not device:
+        yield _NULL_SPAN
+        return
+    sid = next(_IDS)
+    stack = getattr(_TLS, "stack", None)
+    if stack is None:
+        stack = _TLS.stack = []
+    s = Span(name=name, span_id=sid,
+             parent_id=stack[-1] if stack else 0,
+             trace_id=getattr(_TLS, "trace_id", ""),
+             start_us=int(time.time() * 1e6),
+             tid=threading.get_ident(), attrs=attrs)
+    stack.append(sid)
     t0 = time.perf_counter()
     prof = None
     if device and _TRACE_DIR is not None:
@@ -52,7 +135,7 @@ def span(name: str, device: bool = False, **attrs):
         prof = jax.profiler.trace(_TRACE_DIR)
         prof.__enter__()
     try:
-        yield
+        yield s
     finally:
         if device:
             import jax
@@ -60,12 +143,18 @@ def span(name: str, device: bool = False, **attrs):
             jax.effects_barrier()
         if prof is not None:
             prof.__exit__(None, None, None)
-        _TLS.current = parent
-        s = Span(name=name, start_us=int(t0 * 1e6),
-                 dur_us=int((time.perf_counter() - t0) * 1e6),
-                 parent=parent, attrs=attrs)
+        stack.pop()
+        s.dur_us = int((time.perf_counter() - t0) * 1e6)
         with _LOCK:
             _BUF.append(s)
+            if s.trace_id:
+                spans = _TRACES.get(s.trace_id)
+                if spans is None:
+                    spans = _TRACES[s.trace_id] = []
+                    while len(_TRACES) > _MAX_TRACES:
+                        _TRACES.popitem(last=False)
+                if len(spans) < _MAX_TRACE_SPANS:
+                    spans.append(s)
 
 
 def recent(n: int = 100) -> list[Span]:
@@ -73,6 +162,37 @@ def recent(n: int = 100) -> list[Span]:
         return list(_BUF)[-n:]
 
 
+def trace_spans(trace_id: str) -> list[Span]:
+    """Completed spans of one trace, in completion order (children close
+    before parents, so the root span is last)."""
+    with _LOCK:
+        return list(_TRACES.get(trace_id, ()))
+
+
+def to_chrome(spans: list[Span]) -> dict:
+    """Chrome trace-event JSON (the `ph:"X"` complete-event form) —
+    loadable in Perfetto / chrome://tracing. Span attrs ride in `args`;
+    ts/dur are µs as the format requires."""
+    return {
+        "displayTimeUnit": "ms",
+        "traceEvents": [
+            {"name": s.name, "cat": "dgraph_tpu", "ph": "X",
+             "ts": s.start_us, "dur": max(s.dur_us, 1),
+             "pid": 1, "tid": s.tid,
+             "args": {**{k: _jsonable(v) for k, v in s.attrs.items()},
+                      "span_id": s.span_id, "parent_id": s.parent_id,
+                      "trace_id": s.trace_id}}
+            for s in spans],
+    }
+
+
+def _jsonable(v):
+    if isinstance(v, (str, int, float, bool)) or v is None:
+        return v
+    return str(v)
+
+
 def clear() -> None:
     with _LOCK:
         _BUF.clear()
+        _TRACES.clear()

@@ -1,0 +1,179 @@
+"""Metrics registry + Prometheus text-exposition strictness.
+
+Reference parity: `x/metrics.go` exposes expvar/Prometheus metrics that
+real scrapers parse; our renderer is hand-rolled, so this file IS the
+scraper — a strict text-format checker asserting bucket monotonicity,
+`_sum`/`_count` consistency, label escaping, and TYPE-line placement
+over the actual `/debug/prometheus_metrics` payload shape.
+"""
+
+import re
+
+import pytest
+
+from dgraph_tpu.utils.metrics import BUCKETS_US, Registry
+
+_LINE = re.compile(
+    r'^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)'
+    r'(\{(?P<labels>.*)\})? (?P<value>[0-9.eE+-]+|\+Inf)$')
+_LABEL = re.compile(r'(?P<k>[a-zA-Z_][a-zA-Z0-9_]*)="(?P<v>(?:\\.|[^"\\])*)"')
+
+
+def _unescape(v: str) -> str:
+    return (v.replace("\\n", "\n").replace('\\"', '"')
+            .replace("\\\\", "\\"))
+
+
+def parse_exposition(text: str):
+    """Strict parse of the Prometheus text format → (types, samples).
+    Raises AssertionError on any malformed line; samples are
+    (name, labels dict, float value)."""
+    types: dict[str, str] = {}
+    samples = []
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("# TYPE "):
+            _, _, name, kind = line.split(" ")
+            assert kind in ("counter", "gauge", "histogram"), line
+            assert name not in types, f"duplicate TYPE for {name}"
+            types[name] = kind
+            continue
+        assert not line.startswith("#"), f"unexpected comment: {line}"
+        m = _LINE.match(line)
+        assert m, f"malformed sample line: {line!r}"
+        labels = {}
+        raw = m.group("labels")
+        if raw:
+            # the label section must be EXACTLY a comma-join of valid
+            # k="escaped" pairs — reject trailing garbage
+            rebuilt = ",".join(f'{x.group("k")}="{x.group("v")}"'
+                               for x in _LABEL.finditer(raw))
+            assert rebuilt == raw, f"malformed labels: {raw!r}"
+            for x in _LABEL.finditer(raw):
+                labels[x.group("k")] = _unescape(x.group("v"))
+        samples.append((m.group("name"), labels,
+                        float(m.group("value"))))
+    return types, samples
+
+
+def check_exposition(text: str):
+    """The full strict checker: every sample's base name has a TYPE
+    line; every histogram has ascending le buckets with nondecreasing
+    cumulative counts, +Inf == _count, and a _sum."""
+    types, samples = parse_exposition(text)
+    hists: dict[tuple, dict] = {}
+    for name, labels, value in samples:
+        base = re.sub(r"_(bucket|sum|count)$", "", name)
+        assert name in types or base in types, f"no TYPE for {name}"
+        if base in types and types[base] == "histogram":
+            lk = tuple(sorted((k, v) for k, v in labels.items()
+                              if k != "le"))
+            h = hists.setdefault((base, lk),
+                                 {"buckets": [], "sum": None,
+                                  "count": None})
+            if name.endswith("_bucket"):
+                assert "le" in labels, f"bucket without le: {labels}"
+                le = (float("inf") if labels["le"] == "+Inf"
+                      else float(labels["le"]))
+                h["buckets"].append((le, value))
+            elif name.endswith("_sum"):
+                h["sum"] = value
+            elif name.endswith("_count"):
+                h["count"] = value
+    assert hists or not any(k == "histogram" for k in types.values())
+    for (base, lk), h in hists.items():
+        assert h["sum"] is not None, f"{base}{lk}: missing _sum"
+        assert h["count"] is not None, f"{base}{lk}: missing _count"
+        les = [le for le, _ in h["buckets"]]
+        assert les == sorted(les), f"{base}{lk}: le not ascending"
+        assert les and les[-1] == float("inf"), f"{base}{lk}: no +Inf"
+        counts = [c for _, c in h["buckets"]]
+        assert counts == sorted(counts), (
+            f"{base}{lk}: cumulative bucket counts decreasing")
+        assert counts[-1] == h["count"], (
+            f"{base}{lk}: +Inf bucket != _count")
+    return types, samples
+
+
+def test_counters_gauges_and_labels_render_strict():
+    r = Registry()
+    r.inc("plain_total")
+    r.inc("plain_total", 2.0)
+    r.inc("labeled_total", rpc="fetch_log")
+    r.inc("labeled_total", rpc="serve_task")
+    r.set_gauge("height", 3.5, shelf="top")
+    types, samples = check_exposition(r.render())
+    vals = {(n, tuple(sorted(l.items()))): v for n, l, v in samples}
+    assert vals[("dgraph_tpu_plain_total", ())] == 3.0
+    assert vals[("dgraph_tpu_labeled_total",
+                 (("rpc", "fetch_log"),))] == 1.0
+    assert types["dgraph_tpu_labeled_total"] == "counter"
+    assert types["dgraph_tpu_height"] == "gauge"
+
+
+def test_label_escaping_round_trips():
+    r = Registry()
+    nasty = 'he said "hi"\\ and\nleft'
+    r.inc("esc_total", q=nasty)
+    types, samples = check_exposition(r.render())
+    (name, labels, value), = [s for s in samples
+                              if s[0] == "dgraph_tpu_esc_total"]
+    assert labels["q"] == nasty  # escaped on the wire, identical parsed
+    assert value == 1.0
+
+
+def test_histogram_buckets_sum_count_consistent():
+    r = Registry()
+    obs = [50, 500, 5_000, 50_000, 500_000, 5_000_000, 50_000_000]
+    for v in obs:
+        r.observe("lat_us", v, rpc="x")
+        r.observe("lat_us", v)  # separate label-free series, same name
+    types, samples = check_exposition(r.render())
+    sums = {tuple(sorted(l.items())): v for n, l, v in samples
+            if n == "dgraph_tpu_lat_us_sum"}
+    assert sums[()] == sum(obs)
+    assert sums[(("rpc", "x"),)] == sum(obs)
+    # one observation per configured bucket plus the overflow
+    counts = {tuple(sorted(l.items())): v for n, l, v in samples
+              if n == "dgraph_tpu_lat_us_count"}
+    assert counts[()] == len(obs) == len(BUCKETS_US) + 1
+
+
+def test_custom_buckets_bind_per_name():
+    r = Registry()
+    r.observe("compile_us", 3.0, buckets=(1, 10))
+    r.observe("compile_us", 5.0)  # ladder already bound to the name
+    types, samples = check_exposition(r.render())
+    les = [l["le"] for n, l, _ in samples
+           if n == "dgraph_tpu_compile_us_bucket"]
+    assert les == ["1", "10", "+Inf"]
+
+
+def test_snapshot_keeps_plain_names_for_unlabeled_series():
+    r = Registry()
+    r.inc("tablet_bytes_fetched", 42)
+    r.inc("rpc_total", rpc="ping")
+    snap = r.snapshot()
+    assert snap["counters"]["tablet_bytes_fetched"] == 42
+    assert snap["counters"]['rpc_total{rpc="ping"}'] == 1.0
+
+
+def test_disabled_registry_records_nothing():
+    r = Registry()
+    r.set_enabled(False)
+    r.inc("x_total")
+    r.observe("y_us", 1.0)
+    r.set_gauge("z", 1.0)
+    assert r.render().strip() == ""
+    r.set_enabled(True)
+    r.inc("x_total")
+    assert r.get("x_total") == 1.0
+
+
+def test_global_registry_exposition_is_strict():
+    """Whatever the process accumulated by this point in the suite (the
+    instrumented query path feeds the GLOBAL registry) must render
+    strictly parseable."""
+    from dgraph_tpu.utils.metrics import METRICS
+    check_exposition(METRICS.render())

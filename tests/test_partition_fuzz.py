@@ -29,6 +29,14 @@ from dgraph_tpu.cluster.fault import FaultSchedule, FaultyGroups
 from dgraph_tpu.cluster.oracle import TxnAborted
 from dgraph_tpu.cluster.zero import ZeroClient, ZeroState, make_zero_server
 from dgraph_tpu.server.api import NoQuorum, ReadUnavailable
+from dgraph_tpu.utils.metrics import METRICS
+
+
+def _counter_sum(prefix: str) -> float:
+    """Sum one counter family across its label sets (e.g. every
+    `reason=` of read_unavailable_total)."""
+    return sum(v for k, v in METRICS.snapshot()["counters"].items()
+               if k == prefix or k.startswith(prefix + "{"))
 
 SCHEMA = "name: string @index(exact) .\nbalance: int .\n"
 N_ACCT = 4
@@ -111,16 +119,19 @@ def _transfer(a, uids, rng):
 def _fuzz_iteration(nodes, addrs, uids, seed):
     """One seeded schedule: interleave fault events with transfers,
     assert minority refusal as we go, then heal and assert convergence
-    plus the balance invariant."""
+    plus the balance invariant. Returns the number of refusals the
+    workload observed (the fault schedule's metric footprint)."""
     sched = FaultSchedule(seed, len(nodes))
     rng = random.Random(seed ^ 0x9E3779B9)
     groups = [a.groups for a, _s in nodes]
+    refused = 0
     try:
         for ev in sched.events:
             sched.apply_event(ev, groups, addrs)
             for _ in range(2):
                 k = rng.randrange(len(nodes))
                 res = _transfer(nodes[k][0], uids, rng)
+                refused += res == "refused"
                 if sched.isolated(k):
                     assert res == "refused", (
                         f"isolated node {k} answered {res!r} — the "
@@ -139,6 +150,7 @@ def _fuzz_iteration(nodes, addrs, uids, seed):
     assert len(accts) == N_ACCT
     total = sum(accts.values())
     assert total == N_ACCT * PER, f"money leaked: {total}"
+    return refused
 
 
 def _run_fuzz(bank_trio, iters, base_seed):
@@ -146,20 +158,63 @@ def _run_fuzz(bank_trio, iters, base_seed):
     env_seed = os.environ.get("DGRAPH_TPU_FUZZ_SEED")
     seeds = ([int(env_seed)] if env_seed
              else [base_seed + i for i in range(iters)])
+    refusal_counters = ("read_unavailable_total", "noquorum_total")
+    before = sum(_counter_sum(c) for c in refusal_counters)
+    heals_before = _counter_sum("fetchlog_heals_total")
+    refused = 0
     for seed in seeds:
         try:
-            _fuzz_iteration(nodes, addrs, uids, seed)
+            refused += _fuzz_iteration(nodes, addrs, uids, seed)
         except Exception as e:
             sched = FaultSchedule(seed, len(nodes))
             raise AssertionError(
                 f"partition fuzz FAILED at seed {seed} — replay with "
                 f"DGRAPH_TPU_FUZZ_SEED={seed}; schedule: {sched!r}"
             ) from e
+    # the fault schedule must be VISIBLE in metrics: every refusal the
+    # workload observed incremented read_unavailable_total or
+    # noquorum_total (gate refusals inside queries the workload retried
+    # can push the counters past the observed count, never under)
+    delta = sum(_counter_sum(c) for c in refusal_counters) - before
+    assert delta >= refused, (
+        f"metrics undercount refusals: {delta} < {refused}")
+    # post-heal convergence runs through FetchLog; any heal that applied
+    # records must have counted itself
+    assert _counter_sum("fetchlog_heals_total") >= heals_before
+    # and the whole story renders as strict exposition text
+    from test_metrics import check_exposition
+    check_exposition(METRICS.render())
 
 
 def test_partition_fuzz_smoke(bank_trio):
     """Tier-1 smoke: 10 seeded iterations."""
     _run_fuzz(bank_trio, 10, base_seed=1000)
+
+
+def test_election_counters_visible():
+    """The election outcomes PR 1 made default-safe are now metered:
+    a quorum-less electorate counts a deferral, a promotion counts a
+    promotion — the failover story reads from /debug/prometheus_metrics
+    instead of log archaeology."""
+    from dgraph_tpu.cluster.zero import NO_QUORUM, elect_better
+
+    st = ZeroState(standby=True)
+    deferred0 = _counter_sum("election_deferred_total")
+    unreachable0 = _counter_sum("election_peer_unreachable_total")
+    # both peers unreachable (nothing listens there): 1 of 3 reachable
+    # is a minority → the standby must defer, and the metric must say so
+    out = elect_better(st, "127.0.0.1:1",
+                       ["127.0.0.1:9", "127.0.0.1:11"],
+                       require_quorum=True)
+    assert out is NO_QUORUM
+    assert _counter_sum("election_deferred_total") == deferred0 + 1
+    assert _counter_sum("election_peer_unreachable_total") \
+        == unreachable0 + 2
+
+    promoted0 = _counter_sum("election_promoted_total")
+    st.promote()
+    assert _counter_sum("election_promoted_total") == promoted0 + 1
+    assert not st.standby
 
 
 @pytest.mark.slow

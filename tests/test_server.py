@@ -105,6 +105,74 @@ def test_http_endpoints(alpha):
     srv.shutdown()
 
 
+def test_trace_id_echo_and_debug_surface(alpha):
+    """Acceptance: a query through the HTTP surface returns a trace id
+    whose spans are retrievable at /debug/traces (engine-level AND
+    op-level spans present) and export as valid Chrome trace-event JSON
+    at /debug/events."""
+    srv = make_http_server(alpha)
+    serve_background(srv)
+    port = srv.server_address[1]
+    base = f"http://127.0.0.1:{port}"
+
+    req = urllib.request.Request(
+        base + "/query",
+        data=b'{ q(func: eq(name, "alice")) { name friend { name } } }',
+        headers={"Content-Type": "application/dql"})
+    with urllib.request.urlopen(req) as r:
+        out = json.loads(r.read())
+    tid = out["extensions"]["trace_id"]
+    assert tid and out["data"]["q"][0]["name"] == "alice"
+
+    with urllib.request.urlopen(
+            base + f"/debug/traces?trace_id={tid}") as r:
+        spans = json.loads(r.read())["spans"]
+    names = {s["name"] for s in spans}
+    assert "http.query" in names           # request root
+    assert "engine.query" in names         # engine level
+    assert "engine.block" in names
+    assert {"engine.level", "ops.expand"} & names  # op level
+    assert all(s["trace_id"] == tid for s in spans)
+    # the friend hop's expansion recorded its route and edge count
+    exp = [s for s in spans if s["name"] == "ops.expand"]
+    assert exp and all("path" in s["attrs"] for s in exp)
+
+    with urllib.request.urlopen(
+            base + f"/debug/events?trace_id={tid}") as r:
+        doc = json.loads(r.read())
+    evs = doc["traceEvents"]
+    assert {e["name"] for e in evs} == names
+    for e in evs:
+        assert e["ph"] == "X" and e["dur"] >= 1
+        assert e["args"]["trace_id"] == tid
+    # bare /debug/traces serves the recent ring buffer
+    with urllib.request.urlopen(base + "/debug/traces") as r:
+        assert json.loads(r.read())["spans"]
+    srv.shutdown()
+
+
+def test_slow_query_log_counts_and_logs(alpha, caplog):
+    import logging as _logging
+
+    from dgraph_tpu.utils.metrics import METRICS
+    srv = make_http_server(alpha)
+    serve_background(srv)
+    port = srv.server_address[1]
+    alpha.slow_query_ms = 0.0001  # everything is slow
+    before = METRICS.get("slow_queries_total")
+    with caplog.at_level(_logging.WARNING, logger="dgraph_tpu.http"):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/query",
+            data=b'{ q(func: eq(name, "alice")) { name } }',
+            headers={"Content-Type": "application/dql"})
+        out = json.loads(urllib.request.urlopen(req).read())
+    assert METRICS.get("slow_queries_total") == before + 1
+    msgs = [r.message for r in caplog.records if "slow query" in r.message]
+    assert msgs and out["extensions"]["trace_id"] in msgs[0]
+    alpha.slow_query_ms = 0
+    srv.shutdown()
+
+
 def test_http_error_paths(alpha):
     srv = make_http_server(alpha)
     serve_background(srv)

@@ -1,0 +1,181 @@
+"""Tracing correctness: span identity, nesting, trace ids, Chrome export,
+and the observability-overhead tier-1 guard.
+
+Reference parity: OpenCensus span semantics (unique span ids, parent
+links) the reference gets from the library; ours is hand-rolled so the
+invariants are pinned here — in particular the historical bug where the
+thread-local parent was tracked by span NAME, aliasing concurrent (and
+nested) spans that share a name.
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from dgraph_tpu.utils import tracing
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    tracing.clear()
+    tracing.set_enabled(True)
+    yield
+    tracing.set_enabled(True)
+    tracing.clear()
+
+
+def _by_id(spans):
+    return {s.span_id: s for s in spans}
+
+
+def test_nested_spans_have_distinct_ids_and_parent_links():
+    with tracing.span("outer") as so:
+        with tracing.span("inner") as si:
+            pass
+    assert so.span_id != si.span_id
+    assert si.parent_id == so.span_id
+    assert so.parent_id == 0
+
+
+def test_nested_same_name_spans_do_not_alias():
+    """The regression the span-id redesign fixes: nested spans sharing a
+    name must keep distinct identities and a correct parent chain (the
+    name-keyed thread-local could not represent this)."""
+    with tracing.span("work") as a:
+        with tracing.span("work") as b:
+            with tracing.span("work") as c:
+                pass
+    assert len({a.span_id, b.span_id, c.span_id}) == 3
+    assert c.parent_id == b.span_id
+    assert b.parent_id == a.span_id
+    assert a.parent_id == 0
+
+
+def test_concurrent_same_name_spans_keep_thread_local_parents():
+    """Two threads running same-named span trees concurrently: every
+    inner span's parent must be ITS thread's outer span, never the
+    other thread's (name-keyed tracking aliased exactly this)."""
+    barrier = threading.Barrier(2)
+    results = {}
+
+    def worker(tag):
+        barrier.wait()
+        with tracing.span("work", tag=tag) as outer:
+            barrier.wait()  # both outers open before any inner opens
+            with tracing.span("work", tag=tag) as inner:
+                barrier.wait()
+        results[tag] = (outer, inner)
+
+    ts = [threading.Thread(target=worker, args=(t,)) for t in ("a", "b")]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    for tag, (outer, inner) in results.items():
+        assert inner.parent_id == outer.span_id, tag
+        assert inner.tid == outer.tid, tag
+    ids = [s.span_id for pair in results.values() for s in pair]
+    assert len(set(ids)) == 4
+
+
+def test_trace_context_groups_spans_and_exports_chrome_json():
+    with tracing.trace("request") as tid:
+        with tracing.span("child", k="v"):
+            pass
+    assert tid and tracing.current_trace_id() == ""
+    spans = tracing.trace_spans(tid)
+    names = [s.name for s in spans]
+    assert names == ["child", "request"]  # children complete first
+    assert all(s.trace_id == tid for s in spans)
+    root = spans[-1]
+    assert spans[0].parent_id == root.span_id
+
+    doc = tracing.to_chrome(spans)
+    # must survive a JSON round trip and carry the complete-event form
+    doc2 = json.loads(json.dumps(doc))
+    assert len(doc2["traceEvents"]) == 2
+    for ev in doc2["traceEvents"]:
+        assert ev["ph"] == "X"
+        assert ev["dur"] >= 1
+        assert isinstance(ev["ts"], int)
+        assert ev["args"]["trace_id"] == tid
+    child = next(e for e in doc2["traceEvents"] if e["name"] == "child")
+    assert child["args"]["k"] == "v"
+
+
+def test_disabled_tracing_records_nothing():
+    tracing.set_enabled(False)
+    with tracing.span("ghost") as sp:
+        sp.attrs["x"] = 1  # the null sink accepts attr writes
+    assert tracing.recent(10) == []
+
+
+def test_ring_buffer_and_trace_index_bounded():
+    for i in range(tracing._MAX_TRACES + 10):
+        with tracing.trace(f"t{i}"):
+            pass
+    with tracing._LOCK:
+        assert len(tracing._TRACES) <= tracing._MAX_TRACES
+
+
+# ---------------------------------------------------------------------------
+# tier-1 guard: observability must never become the regression
+
+def _hot_loop_secs(engine, queries, reps):
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        for q in queries:
+            engine.query(q)
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def test_query_path_overhead_under_5_percent():
+    """The instrumented query path (spans + counters armed, the serving
+    default) must stay within 5% of the same path with observability
+    disarmed, measured over test_query.py's kind of hot loop. min-of-N
+    on both sides damps scheduler noise."""
+    from dgraph_tpu.engine import Engine
+    from dgraph_tpu.store import StoreBuilder, parse_schema
+    from dgraph_tpu.utils.metrics import METRICS
+
+    rng = np.random.default_rng(11)
+    n = 512
+    b = StoreBuilder(parse_schema(
+        "name: string @index(exact) .\n"
+        "score: int @index(int) .\nfriend: [uid] @reverse ."))
+    for i in range(1, n + 1):
+        b.add_value(i, "name", f"p{i}")
+        b.add_value(i, "score", i % 17)
+        for j in rng.integers(1, n + 1, 4):
+            b.add_edge(i, "friend", int(j))
+    store = b.finalize()
+    engine = Engine(store, device_threshold=10**9)
+    queries = [
+        '{ q(func: ge(score, 8)) { name friend { name score } } }',
+        '{ q(func: has(friend), first: 20) { name friend { friend '
+        '{ name } } } }',
+    ]
+    for q in queries:  # warm parse/caches once
+        engine.query(q)
+
+    # interleaved best-of: measure off/on pairs, keep the best ratio —
+    # a single noisy scheduling quantum must not fail tier-1
+    best_ratio = float("inf")
+    for _attempt in range(3):
+        tracing.set_enabled(False)
+        METRICS.set_enabled(False)
+        off = _hot_loop_secs(engine, queries, reps=5)
+        tracing.set_enabled(True)
+        METRICS.set_enabled(True)
+        on = _hot_loop_secs(engine, queries, reps=5)
+        best_ratio = min(best_ratio, on / off)
+        if best_ratio <= 1.05:
+            break
+    assert best_ratio <= 1.05, (
+        f"observability overhead {best_ratio:.3f}x exceeds the 5% "
+        f"budget on the hot query path")
