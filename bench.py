@@ -54,10 +54,12 @@ B_DEV = 4096               # device lanes (128 uint32 words per row)
 B_CPU_FALLBACK = 256       # smaller batch for the XLA-CPU fallback child
 SMALL_N = 1 << 16          # stage1 graph
 DEV_REPS = 4
+MAINT_N = 220              # maintenance-stage store size (host-side)
 
 METRIC = f"edges_traversed_per_sec_{DEPTH}hop_recurse_{B_DEV}q"
 GLOBAL_DEADLINE_S = 780
-STAGE_DEADLINES = {"stage0": 150.0, "stage1": 240.0, "stage2": 330.0}
+STAGE_DEADLINES = {"stage0": 150.0, "stage1": 240.0, "stage2": 330.0,
+                   "maintenance": 60.0}
 HBM_PEAK_GBPS = 819.0      # v5e single chip
 
 _emitted = threading.Event()
@@ -257,7 +259,108 @@ def child_main(platform: str, expect_path: str) -> None:
                 bytes_per_run / dev_s / 1e9 / HBM_PEAK_GBPS, 3),
             "padded_edges": g.padded_edges,
             "telemetry": _stage_telemetry("stage2")})
+
+    # -- maintenance stage: rollup+checkpoint WHILE an IC-style mix runs ----
+    try:
+        _stage(maintenance_stage())
+    except Exception as e:  # noqa: BLE001 — the stage is additive telemetry
+        _stage({"stage": "maintenance", "error": str(e)})
     os._exit(0)
+
+
+def maintenance_stage() -> dict:
+    """Pause-impact telemetry (ISSUE 3): serve a query mix against an
+    out-of-core store while the background scheduler streams rollups +
+    checkpoints, and report the latency penalty maintenance imposes —
+    median and p99 with maintenance idle vs active, plus the scheduler's
+    own job/pause counters out of the shared registry."""
+    import shutil
+    import statistics
+    import tempfile
+
+    from dgraph_tpu.server.api import Alpha
+    from dgraph_tpu.utils.metrics import METRICS
+
+    t0 = time.perf_counter()
+    rng = np.random.default_rng(13)
+    seed_alpha = Alpha(device_threshold=10**9)
+    seed_alpha.alter("name: string @index(exact) .\n"
+                     "follows: [uid] @reverse .\nknows: [uid] @reverse .")
+    lines = [f'_:p{i} <name> "p{i}" .' for i in range(MAINT_N)]
+    for pred in ("follows", "knows"):
+        for i in range(MAINT_N):
+            for j in rng.choice(MAINT_N, 10, replace=False):
+                if i != j:
+                    lines.append(f"_:p{i} <{pred}> _:p{j} .")
+    seed_alpha.mutate(set_nquads="\n".join(lines))
+    workdir = tempfile.mkdtemp(prefix="bench_maint_")
+    p_dir = os.path.join(workdir, "p")
+    seed_alpha.checkpoint_to(p_dir)
+    from dgraph_tpu.store import checkpoint as _ckpt
+    resolved = _ckpt.resolve(p_dir)
+    disk = sum(os.path.getsize(os.path.join(resolved, f))
+               for f in os.listdir(resolved))
+    alpha = Alpha.open(p_dir, device_threshold=10**9, sync=False,
+                       memory_budget=disk // 3)
+
+    mix = ['{ q(func: eq(name, "p7")) { name follows { name } } }',
+           '{ q(func: eq(name, "p11")) { knows { name } } }',
+           '{ q(func: eq(name, "p3")) { follows { ~follows '
+           '(first: 3) { name } } } }']
+
+    def measure(seconds: float) -> list[float]:
+        lats, i, end = [], 0, time.perf_counter() + seconds
+        while time.perf_counter() < end:
+            t = time.perf_counter()
+            alpha.query(mix[i % len(mix)])
+            lats.append((time.perf_counter() - t) * 1e6)
+            i += 1
+        return lats
+
+    idle = measure(3.0)
+    jobs0 = sum(v for k, v in METRICS.snapshot()["counters"].items()
+                if k.startswith("maintenance_jobs_total"))
+    sched = alpha.attach_maintenance(p_dir, rollup_after=2,
+                                     checkpoint_every_s=0.5,
+                                     pacing_ms=1)
+    stop = threading.Event()
+
+    def write_load():
+        i = 0
+        while not stop.is_set():
+            alpha.mutate(set_nquads=f'_:w{i} <name> "w{i}" .')
+            i += 1
+            time.sleep(0.02)
+
+    w = threading.Thread(target=write_load, daemon=True)
+    w.start()
+    during = measure(5.0)
+    stop.set()
+    w.join()
+    sched.stop(drain=True)
+    snap = METRICS.snapshot()["counters"]
+    jobs = sum(v for k, v in snap.items()
+               if k.startswith("maintenance_jobs_total")) - jobs0
+    shutil.rmtree(workdir, ignore_errors=True)
+
+    def pcts(lats):
+        lats = sorted(lats)
+        return {"p50_us": round(statistics.median(lats)),
+                "p99_us": round(lats[min(len(lats) - 1,
+                                         int(len(lats) * 0.99))])}
+
+    i_p, d_p = pcts(idle), pcts(during)
+    return {"stage": "maintenance",
+            "secs": round(time.perf_counter() - t0, 2),
+            "queries_idle": len(idle), "queries_during": len(during),
+            "idle": i_p, "during": d_p,
+            "pause_impact_p50": round(d_p["p50_us"] /
+                                      max(i_p["p50_us"], 1), 3),
+            "pause_impact_p99": round(d_p["p99_us"] /
+                                      max(i_p["p99_us"], 1), 3),
+            "maintenance_jobs": jobs,
+            "pauses": snap.get("maintenance_pauses_total", 0.0),
+            "evictions": snap.get("maintenance_evictions_total", 0.0)}
 
 
 # ---------------------------------------------------------------------------
@@ -281,11 +384,13 @@ def run_child_staged(platform: str, expect_path: str,
     err = None
     t_start = time.perf_counter()
     try:
-        for name in ("stage0", "stage1", "stage2"):
+        for name in ("stage0", "stage1", "stage2", "maintenance"):
             remaining = budget_s - (time.perf_counter() - t_start)
             deadline = min(STAGE_DEADLINES[name], max(remaining, 1.0))
             line = _read_line(proc, deadline)
             if line is None:
+                if name == "maintenance":
+                    break  # additive telemetry: absence is not an error
                 err = (f"{name} produced no output within {deadline:.0f}s "
                        f"(rc={proc.poll()})")
                 errf.flush()
@@ -402,6 +507,14 @@ def main() -> None:
                    hbm_gbps=s2["hbm_gbps"],
                    hbm_frac_of_peak=s2["hbm_frac_of_peak"],
                    telemetry=s2.get("telemetry", {}))
+        sm = stages.get("maintenance")
+        if sm is not None and "error" not in sm:
+            # pause-impact of background rollup+checkpoint on the serving
+            # path (ISSUE 3 maintenance stage)
+            out["maintenance"] = {k: sm[k] for k in
+                                  ("pause_impact_p50", "pause_impact_p99",
+                                   "maintenance_jobs", "pauses")
+                                  if k in sm}
     elif "stage1" in stages:
         s1 = stages["stage1"]
         out.update(value=s1["edges_per_sec"], platform=platform,

@@ -29,7 +29,10 @@ def cmd_alpha(args) -> int:
         "encryption_key_file": args.encryption_key_file,
         "encryption_strict": args.encryption_strict or None,
         "slow_query_ms": args.slow_query_ms,
-        "trace_dir": args.trace_dir}
+        "trace_dir": args.trace_dir,
+        "rollup_after": args.rollup_after,
+        "checkpoint_every_s": args.checkpoint_every_s,
+        "maintenance_pacing_ms": args.maintenance_pacing_ms}
     if args.store:
         # grouped superflag (reference: z.SuperFlag, e.g.
         # --badger "compression=zstd; numgoroutines=8")
@@ -154,6 +157,19 @@ def cmd_alpha(args) -> int:
         import threading
         threading.Thread(target=size_heartbeat, daemon=True).start()
         threading.Thread(target=liveness_heartbeat, daemon=True).start()
+    # background maintenance: rollup-when-deep + periodic checkpoint +
+    # admin-triggered backup/export, paced and budget-bounded
+    # (store/maintenance.py; reference: Badger's background rollups,
+    # snapshot ticker, and ee backup workers run WHILE serving)
+    alpha.attach_maintenance(
+        cfg.p_dir, rollup_after=cfg.rollup_after,
+        checkpoint_every_s=cfg.checkpoint_every_s,
+        pacing_ms=cfg.maintenance_pacing_ms)
+    if cfg.rollup_after or cfg.checkpoint_every_s:
+        log.info("maintenance armed: rollup_after=%d "
+                 "checkpoint_every_s=%.1f pacing_ms=%.1f",
+                 cfg.rollup_after, cfg.checkpoint_every_s,
+                 cfg.maintenance_pacing_ms)
     http_server = make_http_server(alpha, cfg.http_addr, cfg.http_port)
     serve_background(http_server)
     log.info("alpha up: grpc=%d http=%d", grpc_port,
@@ -161,8 +177,11 @@ def cmd_alpha(args) -> int:
     try:
         grpc_server.wait_for_termination()
     except KeyboardInterrupt:
-        log.info("shutting down; checkpointing to %s", cfg.p_dir)
-        alpha.checkpoint_to(cfg.p_dir)
+        # drain the in-flight maintenance job (a half-written triggered
+        # backup must finish), then the final checkpoint
+        log.info("shutting down; draining maintenance + checkpointing "
+                 "to %s", cfg.p_dir)
+        alpha.shutdown(cfg.p_dir)
     return 0
 
 
@@ -273,10 +292,13 @@ def cmd_live(args) -> int:
 
 def cmd_backup(args) -> int:
     """Binary backup: full or incremental-since-last (reference:
-    ee/backup; SURVEY §2.5)."""
+    ee/backup; SURVEY §2.5). --memory_budget_mb opens the source
+    out-of-core so a store larger than RAM backs up streamed."""
     from dgraph_tpu.server.backup import backup
     xlog.setup(args.log_level)
-    m = backup(args.p, args.dest, force_full=args.full)
+    m = backup(args.p, args.dest, force_full=args.full,
+               memory_budget=(args.memory_budget_mb << 20)
+               if args.memory_budget_mb else None)
     print(json.dumps(m))
     return 0
 
@@ -294,7 +316,13 @@ def cmd_restore(args) -> int:
 def cmd_export(args) -> int:
     from dgraph_tpu.server.export import export_json, export_rdf
     from dgraph_tpu.store import checkpoint
-    store, _ = checkpoint.load(args.p)
+    if args.memory_budget_mb:
+        # stream the export: tablets fault in one at a time and release
+        # (store/stream.py) — a snapshot larger than RAM exports fine
+        from dgraph_tpu.store.outofcore import open_out_of_core
+        store, _ = open_out_of_core(args.p, args.memory_budget_mb << 20)
+    else:
+        store, _ = checkpoint.load(args.p)
     with open(args.out, "w") as f:
         n = (export_json if args.format == "json" else export_rdf)(store, f)
     print(json.dumps({"exported": n, "format": args.format}))
@@ -371,6 +399,16 @@ def main(argv=None) -> int:
                    help="out-of-core mode: fault predicate tablets from "
                         "the checkpoint on demand, LRU-evict above this "
                         "many MB resident (0 = fully resident)")
+    p.add_argument("--rollup_after", type=int, default=None,
+                   help="background-fold when this many delta layers "
+                        "are pending (0 = off); out-of-core stores "
+                        "stream the fold tablet-at-a-time")
+    p.add_argument("--checkpoint_every_s", type=float, default=None,
+                   help="periodic background checkpoint + WAL truncate "
+                        "every this many seconds (0 = off)")
+    p.add_argument("--maintenance_pacing_ms", type=float, default=None,
+                   help="sleep between tablets of a maintenance job so "
+                        "serving keeps the disk/CPU (0 = no pacing)")
     p.add_argument("--slow_query_ms", type=int, default=None,
                    help="log queries slower than this many ms with "
                         "their trace id (0 = off); spans stay "
@@ -441,6 +479,10 @@ def main(argv=None) -> int:
     p.add_argument("--dest", required=True, help="backup series dir")
     p.add_argument("--full", action="store_true",
                    help="force a full backup even if the chain extends")
+    p.add_argument("--memory_budget_mb", type=int, default=0,
+                   help="open the source out-of-core and stream the "
+                        "full backup tablet-at-a-time under this "
+                        "budget (0 = fully resident)")
     p.add_argument("--log_level", default="info")
     p.set_defaults(fn=cmd_backup)
 
@@ -454,6 +496,9 @@ def main(argv=None) -> int:
     p.add_argument("--p", default="p")
     p.add_argument("--out", required=True)
     p.add_argument("--format", choices=("rdf", "json"), default="rdf")
+    p.add_argument("--memory_budget_mb", type=int, default=0,
+                   help="stream the export out-of-core under this "
+                        "budget (0 = fully resident)")
     p.set_defaults(fn=cmd_export)
 
     p = sub.add_parser("debug", help="inspect a snapshot dir", parents=[enc])

@@ -112,10 +112,20 @@ class FaultSchedule:
     (DGRAPH_TPU_FUZZ_SEED=<seed>). Events are (op, src, dst, seconds)
     over node INDICES; `apply_event` maps them onto each node's
     FaultyGroups wrapper and tracks the current drop set so tests can
-    ask which nodes are minority-isolated."""
+    ask which nodes are minority-isolated.
+
+    `wal_trunc=True` adds WAL-truncation-race events to the schedule
+    space (ROADMAP: "WAL truncation races"): node `src` crashes with a
+    TORN WAL TAIL — its newest durable record is cut — and restarts.
+    Records it acked into the cluster before the crash survive on its
+    peers; the restarted node must heal the hole via FetchLog before
+    serving, never expose the gap. The event carries no link state;
+    the HARNESS performs the crash-restart through the `wal_trunc_cb`
+    hook (the schedule stays transport-agnostic). Off by default so
+    historical seeds keep their exact schedules."""
 
     def __init__(self, seed: int, n_nodes: int, steps: int = 8,
-                 max_delay_s: float = 0.03):
+                 max_delay_s: float = 0.03, wal_trunc: bool = False):
         import random
         self.seed = seed
         self.n_nodes = n_nodes
@@ -127,7 +137,10 @@ class FaultSchedule:
         for _ in range(steps):
             src, dst = rng.choice(links)
             r = rng.random()
-            if r < 0.40:
+            if wal_trunc and r >= 0.85:
+                # a crash-restart with a torn tail; dst/seconds unused
+                self.events.append(("wal_trunc", src, dst, 0.0))
+            elif r < 0.40:
                 self.events.append(("drop", src, dst, 0.0))
             elif r < 0.70:
                 self.events.append(("heal", src, dst, 0.0))
@@ -141,10 +154,20 @@ class FaultSchedule:
                 f"n_nodes={self.n_nodes}, events={self.events})")
 
     def apply_event(self, ev: tuple[str, int, int, float],
-                    faulty_groups, addrs) -> None:
+                    faulty_groups, addrs, wal_trunc_cb=None) -> None:
         """Apply one event; `faulty_groups[i]` is node i's FaultyGroups
-        wrapper, `addrs[i]` its address."""
+        wrapper, `addrs[i]` its address. `wal_trunc_cb(src)` performs a
+        crash-restart-with-torn-tail of node src (harness-provided; the
+        event is skipped when the harness passes None)."""
         op, src, dst, secs = ev
+        if op == "wal_trunc":
+            if wal_trunc_cb is not None:
+                # the node's links come back clean after a restart
+                faulty_groups[src].heal_all()
+                self.dropped = {(s, d) for s, d in self.dropped
+                                if s != src}
+                wal_trunc_cb(src)
+            return
         fg = faulty_groups[src]
         if op == "drop":
             fg.drop_link(addrs[dst])

@@ -117,6 +117,9 @@ class Alpha:
         # slow-query log threshold in ms (0 = off; --slow_query_ms flag)
         self.slow_query_ms = 0.0
         self.acl = None  # server/acl.AclManager | None (enforcement on)
+        # store/maintenance.MaintenanceScheduler | None: background
+        # rollup/checkpoint/backup/export jobs (attach_maintenance)
+        self.maintenance = None
         self._apply_lock = threading.Lock()
         self._state_lock = threading.Lock()
         self._open_txns: dict[int, Txn] = {}
@@ -137,9 +140,11 @@ class Alpha:
         predicate tablets fault in from disk on first touch and evict
         LRU under the budget (reference: Badger's LSM — data exceeds
         RAM; SURVEY §5 "HBM is a cache, never the source of truth").
-        Read-mostly scope: a fold materialization (mutations), rollup,
-        or checkpoint save rebuilds the full store and faults every
-        tablet (see store/outofcore.py)."""
+        Rollup, checkpoint, backup, and export stream tablet-at-a-time
+        under the same budget (store/stream.py); the remaining
+        full-materialization path is a READ above the newest fold
+        point (kept shallow by the maintenance scheduler's rollup —
+        see store/outofcore.py)."""
         import os
 
         from dgraph_tpu.store import checkpoint
@@ -233,10 +238,26 @@ class Alpha:
         self.wal = WAL(wal_path, sync=sync)
         return max_ts, max_uid
 
-    def checkpoint_to(self, p_dir: str) -> int:
+    def checkpoint_to(self, p_dir: str, pace=None) -> int:
         """Fold all committed state into an on-disk checkpoint and drop the
-        WAL records it absorbed. Returns the checkpoint base_ts."""
-        from dgraph_tpu.store import checkpoint
+        WAL records it absorbed. Returns the checkpoint base_ts.
+
+        On an out-of-core base the fold streams tablet-at-a-time
+        (store/stream.py) OUTSIDE the apply lock — applies land above
+        the fold's upto_ts and stay as delta layers; a straggler below
+        it aborts the install (FoldRaced) and the caller retries. Only
+        the WAL truncate serializes with appliers."""
+        from dgraph_tpu.store import checkpoint, stream
+        lazy = stream.lazy_preds(self.mvcc.base)
+        if lazy is not None:
+            ts = stream.checkpoint_streaming(
+                self.mvcc, p_dir, lazy.budget_bytes, pace=pace,
+                job="checkpoint")
+            with self._apply_lock:
+                if self.wal is not None:
+                    self.wal.truncate(ts)
+                self._wal_floor = max(self._wal_floor, ts)
+            return ts
         with self._apply_lock:
             store = self.mvcc.rollup()
             ts = self.mvcc.base_ts
@@ -248,6 +269,62 @@ class Alpha:
                 self.wal.truncate(ts)
             self._wal_floor = max(self._wal_floor, ts)
         return ts
+
+    def maintenance_rollup(self, p_dir: str | None = None,
+                           pace=None) -> int:
+        """Fold pending delta layers into a new fold point — the
+        background rollup job (reference: posting-list Rollup). In-core:
+        the existing in-memory fold. Out-of-core: the fold is STREAMED
+        to a new ckpt dir under `p_dir` (default: the dir the base was
+        opened from) and reopened lazily, so the budget holds — an
+        out-of-core fold point has to live on disk, exactly as Badger's
+        rollup writes back to the LSM. Returns the new fold ts."""
+        from dgraph_tpu.store import stream
+        lazy = stream.lazy_preds(self.mvcc.base)
+        if lazy is None:
+            self.mvcc.rollup()
+            return self.mvcc.base_ts
+        root = p_dir if p_dir is not None else lazy.root_dir
+        return stream.checkpoint_streaming(
+            self.mvcc, root, lazy.budget_bytes, pace=pace, job="rollup")
+
+    def export_to(self, out_path: str, format: str = "rdf",
+                  pace=None) -> int:
+        """Dump committed state as RDF N-Quads or JSON (reference:
+        worker/export.go streaming every tablet at a read ts). Streams
+        tablet-at-a-time on an out-of-core base; pending delta layers
+        are folded first (a read_view above the fold would materialize
+        everything at once)."""
+        from dgraph_tpu.server.export import export_json, export_rdf
+        if self.mvcc.layers:
+            self.maintenance_rollup(pace=pace)
+        store = self.mvcc.base
+        with open(out_path, "w") as f:
+            n = (export_json if format == "json" else export_rdf)(
+                store, f, pace=pace)
+        return n
+
+    def attach_maintenance(self, p_dir: str, *, rollup_after: int = 0,
+                           checkpoint_every_s: float = 0.0,
+                           pacing_ms: float = 0.0):
+        """Start the background maintenance scheduler on this Alpha
+        (store/maintenance.py): rollup-when-deep, periodic checkpoint,
+        requested backup/export — paced, budget-bounded, pausable."""
+        from dgraph_tpu.store.maintenance import MaintenanceScheduler
+        self.maintenance = MaintenanceScheduler(
+            self, p_dir, rollup_after=rollup_after,
+            checkpoint_every_s=checkpoint_every_s,
+            pacing_ms=pacing_ms).start()
+        return self.maintenance
+
+    def shutdown(self, p_dir: str | None = None) -> None:
+        """Drain maintenance (finish the in-flight + requested jobs),
+        then take a final checkpoint — the clean-exit path the CLI runs
+        on SIGINT."""
+        if self.maintenance is not None:
+            self.maintenance.stop(drain=True)
+        if p_dir is not None:
+            self.checkpoint_to(p_dir)
 
     # -- public api surface (api.Dgraph analog) -----------------------------
     def new_txn(self) -> "Txn":

@@ -46,24 +46,45 @@ def _series(dest: str) -> list[dict]:
     return sorted(out, key=lambda m: m["seq"])
 
 
-def backup(p_dir: str, dest: str, force_full: bool = False) -> dict:
+def backup(p_dir: str, dest: str, force_full: bool = False,
+           memory_budget: int | None = None) -> dict:
     """Append one backup to the series at `dest` from the posting dir
-    `p_dir` (offline, or a dir a live Alpha checkpoints to). Returns the
-    new manifest."""
+    `p_dir` (offline form: opens its own Alpha). `memory_budget` (bytes)
+    opens the source OUT-OF-CORE so a store larger than RAM backs up
+    tablet-at-a-time. Returns the new manifest."""
     from dgraph_tpu.server.api import Alpha
+
+    alpha = Alpha.open(p_dir, sync=False, memory_budget=memory_budget)
+    try:
+        return backup_alpha(alpha, p_dir, dest, force_full=force_full)
+    finally:
+        if alpha.wal is not None:
+            alpha.wal.close()
+
+
+def backup_alpha(alpha, p_dir: str, dest: str,
+                 force_full: bool = False, pace=None) -> dict:
+    """Append one backup from a LIVE Alpha (the maintenance scheduler's
+    backup job runs this while the node serves). Incrementals copy only
+    WAL records — never materialize anything; full backups of an
+    out-of-core store stream the fold tablet-at-a-time
+    (store/stream.py), so resident bytes stay under budget + one
+    tablet. The series manifest format is unchanged — existing
+    restore() reads both in-core- and stream-written fulls."""
+    from dgraph_tpu.store import stream
 
     series = _series(dest)
     seq = (series[-1]["seq"] + 1) if series else 1
     last_ts = series[-1]["read_ts"] if series else 0
 
-    alpha = Alpha.open(p_dir, sync=False)
     # the oracle watermark covers EVERY replayed record — including a
     # trailing DropAll, which resets mvcc state to ts 0 and would
     # otherwise regress read_ts and fall out of the incremental window
     read_ts = max(alpha.mvcc.base_ts, alpha.oracle.max_assigned,
                   max((l.commit_ts for l in alpha.mvcc.layers), default=0))
 
-    wal_path = os.path.join(p_dir, "wal.log")
+    wal_path = (alpha.wal.path if alpha.wal is not None
+                else os.path.join(p_dir, "wal.log"))
     wal_floor = alpha.mvcc.base_ts  # records ≤ this were absorbed
     incremental = (not force_full and series
                    and last_ts >= wal_floor)
@@ -89,6 +110,15 @@ def backup(p_dir: str, dest: str, force_full: bool = False) -> dict:
             n += 1
         seg.close()
         extra = {"records": n}
+    elif stream.lazy_preds(alpha.mvcc.base) is not None:
+        # out-of-core full: fold + write ONE TABLET AT A TIME straight
+        # into the backup dir (no fold-point install — the backup is a
+        # byproduct, not a new serving snapshot)
+        _ts, _guard = stream.write_fold(alpha.mvcc, bdir, pace=pace,
+                                        job="backup", manifest_ts=read_ts)
+        manifest_n, _dir = checkpoint.read_manifest(bdir)
+        extra = {"n_nodes": manifest_n["n_nodes"]}
+        last_ts = 0
     else:
         store = alpha.mvcc.rollup()
         checkpoint.save(store, bdir, base_ts=read_ts)
@@ -102,8 +132,6 @@ def backup(p_dir: str, dest: str, force_full: bool = False) -> dict:
     with open(tmp, "w") as f:
         json.dump(manifest, f, indent=1)
     os.replace(tmp, os.path.join(bdir, MANIFEST))
-    if alpha.wal is not None:
-        alpha.wal.close()
     return manifest
 
 

@@ -3,6 +3,13 @@
 Reference parity: `worker/export.go` — stream every tablet at a read
 timestamp into RDF/JSON files an operator (or the live/bulk loader) can
 re-ingest. Round-trips with `loader.chunker.parse_rdf`.
+
+Both exporters iterate via store/stream.py::iter_tablets — sorted
+predicate order, one tablet faulted at a time on an out-of-core store
+and released before the next, so an export never holds more than
+budget + one tablet resident. In-core stores take the same code path
+(get() is just a dict lookup), which is what makes the out-of-core
+output byte-identical to the in-core one.
 """
 
 from __future__ import annotations
@@ -13,6 +20,7 @@ import re
 import numpy as np
 
 from dgraph_tpu.store.store import TYPE_PRED, Store
+from dgraph_tpu.store.stream import iter_tablets
 from dgraph_tpu.store.types import Kind
 
 
@@ -24,10 +32,10 @@ _XS = {Kind.INT: "xs:int", Kind.FLOAT: "xs:float", Kind.BOOL: "xs:boolean",
        Kind.DATETIME: "xs:dateTime"}
 
 
-def export_rdf(store: Store, out) -> int:
+def export_rdf(store: Store, out, pace=None) -> int:
     """Write N-Quads to a text file object; returns statement count."""
     n = 0
-    for pred, pd in sorted(store.preds.items()):
+    for pred, pd in iter_tablets(store, pace=pace, job="export"):
         if pd.fwd is not None and pd.fwd.nnz:
             deg = pd.fwd.indptr[1:] - pd.fwd.indptr[:-1]
             src = np.repeat(np.arange(store.n_nodes), deg)
@@ -55,14 +63,17 @@ def export_rdf(store: Store, out) -> int:
     return n
 
 
-def export_json(store: Store, out) -> int:
-    """Write one JSON object per node (uid, values, edge uid refs)."""
+def export_json(store: Store, out, pace=None) -> int:
+    """Write one JSON object per node (uid, values, edge uid refs).
+
+    The per-node output dicts are the deliverable (O(output) host
+    memory); STORE residency stays tablet-bounded via iter_tablets."""
     nodes: dict[int, dict] = {}
 
     def node(rank: int) -> dict:
         return nodes.setdefault(rank, {"uid": f"0x{int(store.uids[rank]):x}"})
 
-    for pred, pd in sorted(store.preds.items()):
+    for pred, pd in iter_tablets(store, pace=pace, job="export"):
         if pd.fwd is not None and pd.fwd.nnz:
             deg = pd.fwd.indptr[1:] - pd.fwd.indptr[:-1]
             src = np.repeat(np.arange(store.n_nodes), deg)

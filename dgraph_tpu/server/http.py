@@ -115,6 +115,16 @@ def make_http_server(alpha: Alpha, addr: str = "127.0.0.1",
                 # body directly in Perfetto / chrome://tracing
                 spans = self._debug_spans()
                 self._send(200, tracing.to_chrome(spans))
+            elif self.path.startswith("/admin/maintenance"):
+                # scheduler status: running/queued jobs, pause state,
+                # policy knobs (reference: /admin health of background
+                # ops; the metric counterparts live in
+                # /debug/prometheus_metrics)
+                if alpha.maintenance is None:
+                    self._send(400, {"errors": [{
+                        "message": "maintenance scheduler not attached"}]})
+                else:
+                    self._send(200, alpha.maintenance.status())
             else:
                 self._send(404, {"errors": [{"message": "not found"}]})
 
@@ -150,6 +160,54 @@ def make_http_server(alpha: Alpha, addr: str = "127.0.0.1",
             token = (self.headers.get("X-Dgraph-AccessToken")
                      or self.headers.get("X-Dgraph-AccessJWT"))
             return alpha.acl.verify(token)
+
+        def _admin(self, acl_user):
+            """Admin triggers for the maintenance scheduler (reference:
+            /admin backup + export GraphQL mutations): POST
+            /admin/backup {"dest": …, "full"?: bool}, /admin/export
+            {"out": …, "format"?: "rdf"|"json"}, /admin/checkpoint,
+            /admin/pause, /admin/resume. Jobs queue on the background
+            scheduler; `?wait=true` blocks for the outcome (admin
+            endpoints share the Alter ACL bar)."""
+            if alpha.acl is not None:
+                alpha.acl.check_alter(acl_user)
+            if alpha.maintenance is None:
+                self._send(400, {"errors": [{
+                    "message": "maintenance scheduler not attached"}]})
+                return
+            sched = alpha.maintenance
+            body = self._body().decode()
+            req = json.loads(body) if body.strip() else {}
+            wait = "wait=true" in (self.path.partition("?")[2] or "")
+            if self.path.startswith("/admin/backup"):
+                job = sched.request_backup(req["dest"],
+                                           force_full=req.get("full",
+                                                              False))
+            elif self.path.startswith("/admin/export"):
+                job = sched.request_export(req["out"],
+                                           format=req.get("format",
+                                                          "rdf"))
+            elif self.path.startswith("/admin/checkpoint"):
+                job = sched.request_checkpoint()
+            elif self.path.startswith("/admin/pause"):
+                sched.pause()
+                self._send(200, {"data": {"paused": True}})
+                return
+            elif self.path.startswith("/admin/resume"):
+                sched.resume()
+                self._send(200, {"data": {"paused": False}})
+                return
+            else:
+                self._send(404, {"errors": [{"message": "not found"}]})
+                return
+            if wait:
+                result = job.wait(timeout=600.0)
+                self._send(200, {"data": {"job": job.name,
+                                          "outcome": "ok",
+                                          "result": result}})
+            else:
+                self._send(200, {"data": {"job": job.name,
+                                          "queued": True}})
 
         def do_POST(self):
             t0 = time.perf_counter()
@@ -273,6 +331,8 @@ def make_http_server(alpha: Alpha, addr: str = "127.0.0.1",
                                                 abort=bool(abort))
                     self._send(200, {"data": {
                         "code": "Success", "commit_ts": cts}})
+                elif self.path.startswith("/admin/"):
+                    self._admin(acl_user)
                 elif self.path.startswith("/alter"):
                     if alpha.acl is not None:
                         alpha.acl.check_alter(acl_user)

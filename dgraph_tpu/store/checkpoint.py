@@ -42,83 +42,112 @@ def _slug(pred: str) -> str:
     return f"{safe[:40]}.{h}"
 
 
+def save_uids(uids: np.ndarray, dirname: str, compress: bool) -> None:
+    """Write the uid vocabulary block (`compress` delta-varint packs it
+    via native/codec.cpp — the role the reference's codec.UidPack plays
+    for posting storage)."""
+    if compress:
+        from dgraph_tpu import native
+        vault.write_bytes(os.path.join(dirname, "uids.duc"),
+                          native.codec_encode(uids))
+    else:
+        vault.save_np(os.path.join(dirname, "uids.npy"), uids)
+
+
+def save_predicate(dirname: str, pred: str, pd) -> dict:
+    """Write ONE predicate's tablet segment files; returns its manifest
+    meta entry. The loop body of save() and the unit the streaming
+    writer (store/stream.py) emits one-at-a-time, so checkpoint/backup/
+    export of an out-of-core store never holds more than one tablet
+    resident. Byte-identical segments either way."""
+    slug = _slug(pred)
+    nbytes = sum(r.indptr.nbytes + r.indices.nbytes
+                 for r in (pd.fwd, pd.rev) if r is not None)
+    nbytes += sum(c.subj.nbytes
+                  + (c.vals.nbytes if c.vals.dtype != object
+                     else len(c.vals) * 64)
+                  for c in pd.vals.values())
+    # nbytes: size hint for out-of-core eviction accounting and the
+    # tablet-size heartbeat (neither may fault the tablet in)
+    meta = {"slug": slug, "langs": sorted(pd.vals), "nbytes": nbytes}
+    for side, rel in (("fwd", pd.fwd), ("rev", pd.rev)):
+        if rel is not None:
+            vault.save_np(
+                os.path.join(dirname, f"{slug}.{side}.indptr.npy"),
+                rel.indptr)
+            vault.save_np(
+                os.path.join(dirname, f"{slug}.{side}.indices.npy"),
+                rel.indices)
+            meta[side] = True
+    for lang, col in pd.vals.items():
+        lslug = lang or "_"
+        vault.save_np(
+            os.path.join(dirname, f"{slug}.val.{lslug}.subj.npy"),
+            col.subj)
+        vals = col.vals
+        if vals.dtype == object:  # strings: store as fixed-width UTF
+            vals = np.array([str(v) for v in vals], dtype=np.str_)
+        vault.save_np(
+            os.path.join(dirname, f"{slug}.val.{lslug}.vals.npy"),
+            vals)
+    if pd.efacets or pd.vfacets:
+        # facets ride in a JSON sidecar (they are sparse; the reference
+        # persists them inside each posting — same durability contract)
+        fdoc = {
+            "efacets": {k: {"pos": col.pos.tolist(),
+                            "vals": [enc_scalar(v) for v in col.vals]}
+                        for k, col in pd.efacets.items()},
+            "vfacets": {k: {str(r): enc_scalar(v)
+                            for r, v in m.items()}
+                        for k, m in pd.vfacets.items()},
+        }
+        vault.write_bytes(os.path.join(dirname, f"{slug}.facets.json"),
+                          json.dumps(fdoc).encode())
+        meta["facets"] = True
+    return meta
+
+
+def write_manifest(dirname: str, manifest: dict) -> None:
+    """Atomically land the manifest — the commit point of a snapshot.
+    The manifest is encrypted too: it carries the schema text and
+    predicate names (the reference likewise keeps schema inside the
+    encrypted store, exposing only sizes/timestamps in plaintext)."""
+    tmp = os.path.join(dirname, "manifest.json.tmp")
+    vault.write_bytes(tmp, json.dumps(manifest, indent=1).encode())
+    os.replace(tmp, os.path.join(dirname, "manifest.json"))
+
+
+def manifest_doc(n_nodes: int, schema_text: str, preds_meta: dict,
+                 base_ts: int, compress: bool) -> dict:
+    return {
+        "format_version": FORMAT_VERSION,
+        "base_ts": base_ts,
+        "n_nodes": n_nodes,
+        "uids_codec": bool(compress),
+        "schema": schema_text,
+        "predicates": preds_meta,
+    }
+
+
 def save(store: Store, dirname: str, base_ts: int = 0,
          compress: bool | None = None) -> None:
     """Write a Store snapshot (reference: export/backup at a timestamp).
 
-    `compress` (default: auto when the native lib is built) delta-varint
-    packs the sorted uid vocabulary via native/codec.cpp — the role the
-    reference's codec.UidPack plays for posting storage."""
+    Materialization note: iterating `store.preds.items()` on an
+    out-of-core store faults EVERY tablet in — use
+    store/stream.py::save_streaming there (same format, same bytes,
+    one tablet resident at a time)."""
     from dgraph_tpu import native
     if compress is None:
         compress = native.HAVE_NATIVE
     os.makedirs(dirname, exist_ok=True)
-    if compress:
-        vault.write_bytes(os.path.join(dirname, "uids.duc"),
-                          native.codec_encode(store.uids))
-    else:
-        vault.save_np(os.path.join(dirname, "uids.npy"), store.uids)
+    save_uids(store.uids, dirname, compress)
     preds_meta = {}
     for pred, pd in store.preds.items():
-        slug = _slug(pred)
-        nbytes = sum(r.indptr.nbytes + r.indices.nbytes
-                     for r in (pd.fwd, pd.rev) if r is not None)
-        nbytes += sum(c.subj.nbytes
-                      + (c.vals.nbytes if c.vals.dtype != object
-                         else len(c.vals) * 64)
-                      for c in pd.vals.values())
-        # nbytes: size hint for out-of-core eviction accounting and the
-        # tablet-size heartbeat (neither may fault the tablet in)
-        meta = {"slug": slug, "langs": sorted(pd.vals), "nbytes": nbytes}
-        for side, rel in (("fwd", pd.fwd), ("rev", pd.rev)):
-            if rel is not None:
-                vault.save_np(
-                    os.path.join(dirname, f"{slug}.{side}.indptr.npy"),
-                    rel.indptr)
-                vault.save_np(
-                    os.path.join(dirname, f"{slug}.{side}.indices.npy"),
-                    rel.indices)
-                meta[side] = True
-        for lang, col in pd.vals.items():
-            lslug = lang or "_"
-            vault.save_np(
-                os.path.join(dirname, f"{slug}.val.{lslug}.subj.npy"),
-                col.subj)
-            vals = col.vals
-            if vals.dtype == object:  # strings: store as fixed-width UTF
-                vals = np.array([str(v) for v in vals], dtype=np.str_)
-            vault.save_np(
-                os.path.join(dirname, f"{slug}.val.{lslug}.vals.npy"),
-                vals)
-        if pd.efacets or pd.vfacets:
-            # facets ride in a JSON sidecar (they are sparse; the reference
-            # persists them inside each posting — same durability contract)
-            fdoc = {
-                "efacets": {k: {"pos": col.pos.tolist(),
-                                "vals": [enc_scalar(v) for v in col.vals]}
-                            for k, col in pd.efacets.items()},
-                "vfacets": {k: {str(r): enc_scalar(v)
-                                for r, v in m.items()}
-                            for k, m in pd.vfacets.items()},
-            }
-            vault.write_bytes(os.path.join(dirname, f"{slug}.facets.json"),
-                              json.dumps(fdoc).encode())
-            meta["facets"] = True
-        preds_meta[pred] = meta
-    manifest = {
-        "format_version": FORMAT_VERSION,
-        "base_ts": base_ts,
-        "n_nodes": store.n_nodes,
-        "uids_codec": bool(compress),
-        "schema": store.schema.to_text(),
-        "predicates": preds_meta,
-    }
-    tmp = os.path.join(dirname, "manifest.json.tmp")
-    # manifest is encrypted too — it carries the schema text and
-    # predicate names (the reference likewise keeps schema inside the
-    # encrypted store, exposing only sizes/timestamps in plaintext)
-    vault.write_bytes(tmp, json.dumps(manifest, indent=1).encode())
-    os.replace(tmp, os.path.join(dirname, "manifest.json"))
+        preds_meta[pred] = save_predicate(dirname, pred, pd)
+    write_manifest(dirname, manifest_doc(
+        store.n_nodes, store.schema.to_text(), preds_meta, base_ts,
+        compress))
 
 
 def resolve(dirname: str) -> str:
@@ -135,11 +164,13 @@ def exists(dirname: str) -> bool:
     return os.path.exists(os.path.join(resolve(dirname), "manifest.json"))
 
 
-def save_versioned(store: Store, dirname: str, base_ts: int = 0) -> None:
-    """Crash-safe checkpoint: write a fresh `ckpt-<ts>` subdir, then flip
-    the CURRENT pointer atomically, then delete superseded subdirs. A kill
-    at ANY point leaves either the old or the new snapshot fully intact —
-    never a half-written mix (the durability role of Badger's MANIFEST)."""
+def begin_versioned(dirname: str, base_ts: int) -> str | None:
+    """First half of a crash-safe versioned checkpoint: pick the
+    `ckpt-<ts>` subdir name, or None when CURRENT already names a
+    fully-written ckpt-<base_ts> — re-saving would scribble over the
+    live snapshot in place and a crash mid-save would leave NO intact
+    snapshot. The MVCC contract makes base_ts identify the content, so
+    the existing snapshot is exactly what we'd write — no-op."""
     os.makedirs(dirname, exist_ok=True)
     sub = f"ckpt-{base_ts:016d}"
     cur = os.path.join(dirname, "CURRENT")
@@ -147,13 +178,15 @@ def save_versioned(store: Store, dirname: str, base_ts: int = 0) -> None:
         with open(cur) as f:
             if (f.read().strip() == sub and os.path.exists(
                     os.path.join(dirname, sub, "manifest.json"))):
-                # CURRENT already names a fully-written ckpt-<base_ts>:
-                # re-saving would scribble over the live snapshot in place
-                # and a crash mid-save would leave NO intact snapshot. The
-                # MVCC contract makes base_ts identify the content, so the
-                # existing snapshot is exactly what we'd write — no-op.
-                return
-    save(store, os.path.join(dirname, sub), base_ts=base_ts)
+                return None
+    return sub
+
+
+def commit_versioned(dirname: str, sub: str, keep=()) -> None:
+    """Second half: flip the CURRENT pointer atomically, then delete
+    superseded subdirs. `keep` names subdirs that must SURVIVE the
+    sweep — an out-of-core MVCC store's older fold points still fault
+    tablets from their own ckpt dirs until gc drops them."""
     tmp = os.path.join(dirname, "CURRENT.tmp")
     with open(tmp, "w") as f:
         f.write(sub)
@@ -161,9 +194,21 @@ def save_versioned(store: Store, dirname: str, base_ts: int = 0) -> None:
         os.fsync(f.fileno())
     os.replace(tmp, os.path.join(dirname, "CURRENT"))
     for name in os.listdir(dirname):
-        if name.startswith("ckpt-") and name != sub:
+        if name.startswith("ckpt-") and name != sub and name not in keep:
             import shutil
             shutil.rmtree(os.path.join(dirname, name), ignore_errors=True)
+
+
+def save_versioned(store: Store, dirname: str, base_ts: int = 0) -> None:
+    """Crash-safe checkpoint: write a fresh `ckpt-<ts>` subdir, then flip
+    the CURRENT pointer atomically, then delete superseded subdirs. A kill
+    at ANY point leaves either the old or the new snapshot fully intact —
+    never a half-written mix (the durability role of Badger's MANIFEST)."""
+    sub = begin_versioned(dirname, base_ts)
+    if sub is None:
+        return
+    save(store, os.path.join(dirname, sub), base_ts=base_ts)
+    commit_versioned(dirname, sub)
 
 
 def read_manifest(dirname: str) -> tuple[dict, str]:

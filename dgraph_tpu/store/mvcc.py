@@ -28,6 +28,15 @@ from dgraph_tpu.store.types import Kind
 _VIEW_CACHE = 8  # non-fold-point views retained (newest win)
 
 
+class FoldRaced(ValueError):
+    """An externally-materialised fold (store/stream.py streaming
+    rollup/checkpoint) cannot install: the layer set at or below its
+    fold ts changed while it streamed (a straggler absorb or a
+    predicate drop raced it). The written fold is missing that record —
+    the caller discards it and retries (the maintenance scheduler's
+    retry-with-backoff does this automatically)."""
+
+
 @dataclass
 class Mutation:
     """One txn's buffered edits (reference: pb.Mutations / DirectedEdge).
@@ -253,6 +262,84 @@ class MVCCStore:
             self._history.append((new_ts, store))
             return store
 
+    def _fold_guard(self, fold_ts: int, upto_ts: int) -> tuple:
+        """Fingerprint of what an external fold over (fold_ts, upto_ts]
+        absorbed: the exact pending-layer ts set, the retained layers at
+        or below the fold seed (a straggler absorbed BELOW the seed
+        patches folds in place — ours isn't in history yet, so it must
+        refuse), and the drop history. Checked at install time (caller
+        holds the lock)."""
+        return (fold_ts,
+                tuple(l.commit_ts for l in self.layers
+                      if fold_ts < l.commit_ts <= upto_ts),
+                frozenset(l.commit_ts for l in self.layers
+                          if l.commit_ts <= fold_ts),
+                tuple(sorted((p, tuple(t for t in dts if t <= upto_ts))
+                             for p, dts in self.dropped.items()
+                             if any(t <= upto_ts for t in dts))))
+
+    def _guard_ok(self, upto_ts: int, guard: tuple) -> bool:
+        fold_ts, pend, below, drops = guard
+        now_fold, now_pend, now_below, now_drops = \
+            self._fold_guard(fold_ts, upto_ts)
+        # gc REMOVING already-folded layers is benign; anything NEW at
+        # or below upto_ts (a straggler) or a drop is not
+        return (now_pend == pend and now_below <= below
+                and now_drops == drops)
+
+    def fold_plan(self, upto_ts: int | None = None):
+        """Immutable snapshot of what a fold up to `upto_ts` covers:
+        (fold_ts, fold_store, pending_layers, new_ts, guard). The
+        streaming writer (store/stream.py) materialises OUTSIDE the
+        store lock from these references — layers are immutable and the
+        fold store is an immutable snapshot, so concurrent applies
+        (which land above upto_ts) never invalidate the plan; the guard
+        catches the rare straggler that lands below it."""
+        with self._lock:
+            if upto_ts is None:
+                upto_ts = (self.layers[-1].commit_ts if self.layers
+                           else self._history[-1][0])
+            fold_ts, fold_store = self._fold_at(upto_ts)
+            pending = [l for l in self.layers
+                       if fold_ts < l.commit_ts <= upto_ts]
+            new_ts = pending[-1].commit_ts if pending else fold_ts
+            return (fold_ts, fold_store, pending, new_ts,
+                    self._fold_guard(fold_ts, new_ts))
+
+    def install_fold(self, new_ts: int, store: Store, guard: tuple) -> None:
+        """Install an externally-materialised fold point (a streaming
+        rollup/checkpoint that wrote per-tablet segments to disk and
+        reopened them out-of-core). Raises FoldRaced when the layer/drop
+        state below new_ts changed since the plan was taken — the fold
+        on disk is missing those records and must not serve."""
+        import bisect
+        with self._lock:
+            if not self._guard_ok(new_ts, guard):
+                raise FoldRaced(
+                    f"fold at ts {new_ts} raced a straggler/drop; "
+                    f"discard and re-plan")
+            if any(ts == new_ts for ts, _ in self._history):
+                return  # identical content by the MVCC ts contract
+            bisect.insort(self._history, (new_ts, store),
+                          key=lambda e: e[0])
+            self._views.clear()
+
+    def pending_layer_count(self) -> int:
+        """Delta layers ABOVE the newest fold point — what a rollup
+        would absorb. (len(self.layers) also counts already-folded
+        layers retained for open readers until gc; triggering policy on
+        that spins forever.)"""
+        with self._lock:
+            floor = self._history[-1][0]
+            return sum(1 for l in self.layers if l.commit_ts > floor)
+
+    def history_stores(self) -> list[tuple[int, Store]]:
+        """Copy of the retained fold points (ts ascending) — the
+        streaming checkpoint's cleanup uses this to keep on-disk ckpt
+        dirs that older fold points still fault tablets from."""
+        with self._lock:
+            return list(self._history)
+
     def drop_predicate(self, pred: str, drop_ts: int) -> None:
         """Remove a predicate's data and schema at drop_ts (reference:
         api.Operation{DropAttr}). Materialises the newest state minus the
@@ -366,24 +453,45 @@ class MVCCStore:
 
 
 def _materialize(base: Store, layers: list[_Layer],
-                 schema: Schema | None = None) -> Store:
+                 schema: Schema | None = None, only=None,
+                 vocab=None) -> Store:
     """Rebuild a Store from base + deltas (host-side; the new CSR blocks
-    re-enter HBM via Store.device_rel on first use)."""
+    re-enter HBM via Store.device_rel on first use).
+
+    `only` restricts the rebuild to that predicate set — the unit the
+    streaming fold (store/stream.py) processes one tablet at a time so
+    an out-of-core base faults exactly one tablet per call. `vocab`
+    pins the uid vocabulary (the caller precomputed the full-fold
+    union), keeping every per-tablet build in the SAME dense rank space
+    the whole-store build would use — per-tablet CSR blocks come out
+    bit-identical to the corresponding slice of a full materialize."""
     import numpy as np
     b = StoreBuilder(schema=(schema if schema is not None
                              else base.schema.clone()))
     # vocabulary is monotone: nodes with no local postings (cluster mode:
     # foreign-tablet-only nodes) must keep their rank — preserve the whole
     # base vocab plus every uid the deltas mention
-    b.touch_many(base.uids)
-    for layer_ in layers:
-        b.touch_many(sorted(layer_.mut.all_uids()))
+    if vocab is not None:
+        b.touch_many(vocab)
+    else:
+        b.touch_many(base.uids)
+        for layer_ in layers:
+            b.touch_many(sorted(layer_.mut.all_uids()))
+    if only is not None:
+        # one lock-free get() per requested tablet (faults just that
+        # tablet on an out-of-core base), and each layer restricted to it
+        base_items = [(p, base.preds.get(p)) for p in sorted(only)]
+        base_items = [(p, pd) for p, pd in base_items if pd is not None]
+        layers = [_Layer(l.commit_ts, l.mut.restrict(only))
+                  for l in layers]
+    else:
+        base_items = base.preds.items()
 
     # live edges/values from base, as dicts for delete application
     edges: dict[str, set] = {}
     efacets: dict[str, dict] = {}   # pred → {(s,o): facet dict}
     vfacets: dict[str, dict] = {}   # pred → {s: facet dict}
-    for pred, pd in base.preds.items():
+    for pred, pd in base_items:
         if pd.fwd is not None and pd.fwd.nnz:
             deg = pd.fwd.indptr[1:] - pd.fwd.indptr[:-1]
             src_r = np.repeat(np.arange(base.n_nodes), deg)
@@ -400,7 +508,7 @@ def _materialize(base: Store, layers: list[_Layer],
             for s_rank, v in d.items():
                 fm.setdefault(int(base.uids[s_rank]), {})[key] = v
     vals: dict[tuple, dict] = {}
-    for pred, pd in base.preds.items():
+    for pred, pd in base_items:
         for lang, col in pd.vals.items():
             d = vals.setdefault((pred, lang), {})
             for s, v in zip(col.subj, col.vals):

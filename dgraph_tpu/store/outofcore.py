@@ -18,13 +18,15 @@ The returned Store is immutable, like every snapshot: mutations go
 through MVCC layers on top, and eviction is invisible to readers —
 a re-fault reloads bit-identical arrays from the checkpoint.
 
-SCOPE (documented limitation): the budget governs the READ path. A
-mutation-bearing read (MVCC fold materialization), a rollup, or a
-checkpoint save rebuilds the whole store and therefore faults every
-tablet in — out-of-core mode fits read-mostly serving nodes (restore
-targets, analytics replicas), matching the reference's deployment shape
-where bulk-loaded read replicas dwarf their write volume. The
-tablet-size heartbeat reads manifest size hints and never faults.
+SCOPE: the budget governs the read path AND every write-shaped
+maintenance pass — rollup, checkpoint save, backup, and export run
+through store/stream.py, which faults one tablet at a time and releases
+it before the next, so resident bytes never exceed
+`budget + one tablet`. The remaining full-materialization paths are a
+mutation-bearing READ (MVCC fold at a read_ts above the newest fold
+point — kept shallow by the maintenance scheduler's rollup job) and the
+rare straggler-absorb/rebuild legs. The tablet-size heartbeat reads
+manifest size hints and never faults.
 """
 
 from __future__ import annotations
@@ -71,8 +73,12 @@ class LazyPreds:
     request threads."""
 
     def __init__(self, dirname: str, manifest: dict, schema,
-                 budget_bytes: int):
+                 budget_bytes: int, root_dir: str | None = None):
         self._dir = dirname
+        # the UNRESOLVED open path (versioned root with CURRENT, or the
+        # plain dir itself): where a streaming checkpoint writes the
+        # next fold of this store (store/stream.py)
+        self.root_dir = root_dir if root_dir is not None else dirname
         self._meta = manifest["predicates"]
         self._schema = schema
         self.budget_bytes = budget_bytes
@@ -81,8 +87,10 @@ class LazyPreds:
         self._lock = threading.RLock()
         self._inflight: dict[str, threading.Event] = {}
         self.resident_bytes = 0
+        self.peak_resident_bytes = 0  # high-water mark of resident_bytes
         self.faults = 0       # tablets loaded from disk
         self.evictions = 0    # tablets dropped under budget pressure
+        self.releases = 0     # tablets dropped by a streaming pass
 
     def size_hints(self) -> dict[str, int]:
         """Per-tablet byte sizes from the manifest, WITHOUT faulting —
@@ -122,14 +130,37 @@ class LazyPreds:
         return self._meta.keys()
 
     def items(self):
-        """Faults EVERYTHING in (export/debug paths); serving code uses
-        get()/[] which fault one tablet at a time."""
+        """Faults EVERYTHING in — debug/full-materialize paths only.
+        Serving code uses get()/[] (one tablet at a time) and
+        maintenance passes use store/stream.py::iter_tablets, which
+        also releases as it goes."""
         return [(p, self[p]) for p in self._meta]
 
     def values(self):
         return [self[p] for p in self._meta]
 
     # -- fault/evict ---------------------------------------------------------
+    def is_resident(self, pred: str) -> bool:
+        """Whether a tablet is currently faulted in (no LRU touch) —
+        the streaming layer uses this to release only tablets IT pulled
+        in, leaving the serving path's hot set alone."""
+        with self._lock:
+            return pred in self._resident
+
+    def release(self, pred: str) -> bool:
+        """Explicitly drop one resident tablet (streaming maintenance:
+        process a tablet, release it before faulting the next, so a
+        whole-store pass never holds more than one tablet above the
+        serving working set). Readers holding the PredicateData keep a
+        valid immutable reference; the next access re-faults."""
+        with self._lock:
+            pd = self._resident.pop(pred, None)
+            if pd is None:
+                return False
+            self.resident_bytes -= self._sizes.pop(pred)
+            self.releases += 1
+            return True
+
     def _fault(self, pred: str):
         """Resident hit: one cheap lock hop. Cold fault: the disk load +
         index build runs OUTSIDE the lock (a seconds-long cold load must
@@ -159,18 +190,32 @@ class LazyPreds:
             size = _pd_nbytes(pd)
             with self._lock:
                 self.faults += 1
+                prev = self._sizes.pop(pred, None)
+                if prev is not None:
+                    # a concurrent path re-installed this tablet while we
+                    # were loading: replacing must not double-charge the
+                    # budget — retire the old accounting first
+                    self._resident.pop(pred, None)
+                    self.resident_bytes -= prev
                 self._resident[pred] = pd
                 self._sizes[pred] = size
                 self.resident_bytes += size
-                while (self.resident_bytes > self.budget_bytes
-                       and len(self._resident) > 1):
-                    victim, vpd = self._resident.popitem(last=False)
-                    if victim == pred:  # never evict what we're returning
-                        self._resident[victim] = vpd
-                        self._resident.move_to_end(victim, last=False)
-                        break
-                    self.resident_bytes -= self._sizes.pop(victim)
-                    self.evictions += 1
+                self.peak_resident_bytes = max(self.peak_resident_bytes,
+                                               self.resident_bytes)
+                if self.resident_bytes > self.budget_bytes:
+                    # evict LRU-first, skipping the tablet being returned
+                    # (it must survive even when it alone exceeds the
+                    # budget). NOTE: no early break on encountering it —
+                    # the historical `break` left the budget exceeded
+                    # with evictable tablets still resident.
+                    for victim in list(self._resident):
+                        if self.resident_bytes <= self.budget_bytes:
+                            break
+                        if victim == pred:
+                            continue
+                        del self._resident[victim]
+                        self.resident_bytes -= self._sizes.pop(victim)
+                        self.evictions += 1
             return pd
         finally:
             with self._lock:
@@ -186,7 +231,8 @@ def open_out_of_core(dirname: str,
     manifest, resolved = checkpoint.read_manifest(dirname)
     uids = checkpoint.load_uids(resolved, manifest)
     schema = parse_schema(manifest["schema"])
-    preds = LazyPreds(resolved, manifest, schema, budget_bytes)
+    preds = LazyPreds(resolved, manifest, schema, budget_bytes,
+                      root_dir=dirname)
     store = Store(uids=np.asarray(uids, np.int64), schema=schema,
                   preds=preds)
     return store, manifest["base_ts"]

@@ -1,0 +1,225 @@
+"""Streaming maintenance layer: tablet-granular passes over the store.
+
+Reference parity: Badger's Stream framework + the background jobs the
+reference runs over it — posting-list rollups, raft snapshots, and
+incremental backups all iterate the LSM key range in order, never
+holding the whole store in memory (SURVEY §2.5, §5). This module is
+that leg for the CSR block store: iterate predicate tablets in stable
+(sorted) order, fault one in, process it, release it before the next —
+so every write-shaped maintenance pass (MVCC fold/rollup, checkpoint
+save, backup, RDF/JSON export) over an out-of-core store
+(store/outofcore.py) holds at most `max(budget, largest_tablet)`
+resident, byte-accounted through the same `_pd_nbytes` ledger the read
+path evicts by.
+
+The partitioned checkpoint writer reuses store/checkpoint.py's
+per-tablet segment format verbatim (checkpoint.save_predicate), so a
+streaming save is byte-identical per segment to an in-core save, and
+the fold writer routes each tablet through the SAME
+mvcc._materialize code path (restricted to one predicate, vocabulary
+pinned to the full-fold union) — outputs are bit-identical to the
+in-core rollup, just never all resident at once.
+
+Observability: each pass emits `maintenance.tablet` spans and keeps the
+`maintenance_resident_bytes` gauge + `maintenance_evictions_total`
+counter fresh (PR 2 registry). The `pace` hook runs between tablets —
+the maintenance scheduler (store/maintenance.py) uses it to sleep
+`--maintenance_pacing_ms` and to park at its pause gate, which is what
+bounds how long a quorum-staged apply or read can contend with a
+maintenance job: one tablet's work.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from dgraph_tpu.store import checkpoint
+from dgraph_tpu.store.mvcc import MVCCStore, _materialize
+from dgraph_tpu.store.store import Store
+from dgraph_tpu.utils import tracing
+from dgraph_tpu.utils.metrics import METRICS
+
+
+def lazy_preds(store: Store):
+    """The store's LazyPreds when it is out-of-core, else None."""
+    from dgraph_tpu.store.outofcore import LazyPreds
+    preds = getattr(store, "preds", None)
+    return preds if isinstance(preds, LazyPreds) else None
+
+
+def _account(lazy, evicted_before: int) -> None:
+    METRICS.set_gauge("maintenance_resident_bytes", lazy.resident_bytes)
+    delta = (lazy.evictions + lazy.releases) - evicted_before
+    if delta > 0:
+        METRICS.inc("maintenance_evictions_total", float(delta))
+
+
+def iter_tablets(store: Store, release: bool = True, pace=None,
+                 job: str = ""):
+    """Yield (pred, PredicateData) in stable sorted order, one tablet
+    resident at a time on an out-of-core store.
+
+    Tablets that were already resident when the pass reached them (the
+    serving path's hot set) are NOT released — only tablets this pass
+    itself faulted in. Consumer work per tablet runs inside a
+    `maintenance.tablet` span; `pace` runs between tablets."""
+    lazy = lazy_preds(store)
+    for pred in sorted(store.preds.keys()):
+        was_resident = lazy.is_resident(pred) if lazy is not None else True
+        evicted0 = (lazy.evictions + lazy.releases) if lazy else 0
+        with tracing.span("maintenance.tablet", pred=pred, job=job):
+            pd = store.preds.get(pred)
+            if pd is not None:
+                yield pred, pd
+        del pd
+        if lazy is not None:
+            if release and not was_resident:
+                lazy.release(pred)
+            _account(lazy, evicted0)
+        if pace is not None:
+            pace()
+
+
+def save_streaming(store: Store, dirname: str, base_ts: int = 0,
+                   compress: bool | None = None, pace=None,
+                   job: str = "checkpoint") -> None:
+    """checkpoint.save(), one tablet resident at a time: same segment
+    files, same manifest fields — an out-of-core store is saved without
+    ever holding more than budget + one tablet resident."""
+    from dgraph_tpu import native
+    if compress is None:
+        compress = native.HAVE_NATIVE
+    os.makedirs(dirname, exist_ok=True)
+    checkpoint.save_uids(store.uids, dirname, compress)
+    preds_meta = {}
+    for pred, pd in iter_tablets(store, pace=pace, job=job):
+        preds_meta[pred] = checkpoint.save_predicate(dirname, pred, pd)
+    checkpoint.write_manifest(dirname, checkpoint.manifest_doc(
+        store.n_nodes, store.schema.to_text(), preds_meta, base_ts,
+        compress))
+
+
+def fold_vocab(base: Store, pending) -> np.ndarray:
+    """The full-fold uid vocabulary: base vocab ∪ every uid the pending
+    layers mention — O(nodes), resident by the out-of-core contract
+    (the uid dictionary never pages out)."""
+    extra: set[int] = set()
+    for layer in pending:
+        extra.update(layer.mut.all_uids())
+    if not extra:
+        return base.uids
+    return np.union1d(base.uids,
+                      np.array(sorted(extra), np.int64)).astype(np.int64)
+
+
+def fold_preds(base: Store, pending) -> list[str]:
+    """Stable order over every tablet the fold must visit: base tablets
+    plus predicates the deltas introduce."""
+    names = set(base.preds.keys())
+    for layer in pending:
+        m = layer.mut
+        for e in m.edge_sets + m.edge_dels:
+            names.add(e[1])
+        for v in m.val_sets + m.val_dels:
+            names.add(v[1])
+    return sorted(names)
+
+
+def write_fold(mvcc: MVCCStore, dirname: str, plan=None,
+               compress: bool | None = None, pace=None,
+               job: str = "rollup",
+               manifest_ts: int | None = None) -> tuple[int, tuple]:
+    """Fold (newest fold point + pending delta layers) into a plain
+    snapshot dir, ONE TABLET AT A TIME. Returns (new_ts, guard) for
+    MVCCStore.install_fold. With no pending layers this degrades to a
+    streaming save of the base (the builder round-trip is skipped so
+    segments stay byte-identical to the base's own). `manifest_ts`
+    overrides the base_ts recorded in the manifest (a full backup
+    stamps its read watermark, which may sit above the newest commit)."""
+    from dgraph_tpu import native
+    if compress is None:
+        compress = native.HAVE_NATIVE
+    if plan is None:
+        plan = mvcc.fold_plan()
+    _fold_ts, base, pending, new_ts, guard = plan
+    stamp = new_ts if manifest_ts is None else manifest_ts
+    if not pending:
+        save_streaming(base, dirname, base_ts=stamp, compress=compress,
+                       pace=pace, job=job)
+        return new_ts, guard
+
+    vocab = fold_vocab(base, pending)
+    schema = base.schema.clone()
+    os.makedirs(dirname, exist_ok=True)
+    checkpoint.save_uids(vocab, dirname, compress)
+    lazy = lazy_preds(base)
+    preds_meta = {}
+    for pred in fold_preds(base, pending):
+        was_resident = lazy.is_resident(pred) if lazy is not None else True
+        evicted0 = (lazy.evictions + lazy.releases) if lazy else 0
+        with tracing.span("maintenance.tablet", pred=pred, job=job):
+            # the same fold code path the in-core rollup runs, restricted
+            # to one predicate with the vocabulary pinned — per-tablet
+            # output is bit-identical to the full materialize's slice
+            folded = _materialize(base, pending, schema=schema,
+                                  only={pred}, vocab=vocab)
+            pd = folded.preds.get(pred)
+            if pd is not None:
+                preds_meta[pred] = checkpoint.save_predicate(
+                    dirname, pred, pd)
+        del folded, pd
+        if lazy is not None:
+            if not was_resident:
+                lazy.release(pred)
+            _account(lazy, evicted0)
+        if pace is not None:
+            pace()
+    checkpoint.write_manifest(dirname, checkpoint.manifest_doc(
+        int(len(vocab)), schema.to_text(), preds_meta, stamp, compress))
+    return new_ts, guard
+
+
+def checkpoint_streaming(mvcc: MVCCStore, root_dir: str,
+                         budget_bytes: int, pace=None,
+                         job: str = "checkpoint") -> int:
+    """Crash-safe streaming checkpoint of an out-of-core MVCC store:
+    fold into a fresh `ckpt-<ts>` subdir tablet-at-a-time, reopen it
+    OUT-OF-CORE, install it as the newest fold point, then flip the
+    CURRENT pointer. Returns the new base_ts.
+
+    Ordering matters for crash safety: the fold installs (guard-checked
+    against stragglers) BEFORE the CURRENT flip — a crash in between
+    recovers from the old snapshot + an untruncated WAL; an install
+    refusal (FoldRaced) deletes the orphan subdir and leaves everything
+    as it was, for the scheduler's retry. Superseded ckpt dirs survive
+    the flip while an older fold point in MVCC history still faults
+    tablets from them (gc drops the fold; the next checkpoint sweeps
+    the dir)."""
+    import shutil
+
+    from dgraph_tpu.store.outofcore import open_out_of_core
+
+    plan = mvcc.fold_plan()
+    new_ts = plan[3]
+    sub = checkpoint.begin_versioned(root_dir, new_ts)
+    if sub is None:
+        return new_ts  # CURRENT already names this exact fold
+    subdir = os.path.join(root_dir, sub)
+    try:
+        write_fold(mvcc, subdir, plan=plan, pace=pace, job=job)
+        new_base, _ts = open_out_of_core(subdir, budget_bytes)
+        new_base.preds.root_dir = root_dir  # next fold writes beside it
+        mvcc.install_fold(new_ts, new_base, plan[4])
+    except BaseException:
+        shutil.rmtree(subdir, ignore_errors=True)
+        raise
+    keep = {sub}
+    for _ts, st in mvcc.history_stores():
+        lp = lazy_preds(st)
+        if lp is not None and os.path.dirname(
+                os.path.abspath(lp._dir)) == os.path.abspath(root_dir):
+            keep.add(os.path.basename(lp._dir))
+    checkpoint.commit_versioned(root_dir, sub, keep=keep)
+    return new_ts

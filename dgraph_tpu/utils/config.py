@@ -42,6 +42,14 @@ class AlphaConfig:
     rollup_every: int = 64        # commits between automatic rollups
     memory_budget_mb: int = 0     # 0 = fully resident; >0 = out-of-core
                                   # tablet faulting under this budget
+    # background maintenance scheduler (store/maintenance.py):
+    rollup_after: int = 0         # fold when this many delta layers are
+                                  # pending (0 = no background rollup)
+    checkpoint_every_s: float = 0.0  # periodic checkpoint+WAL-truncate
+                                     # period in seconds (0 = off)
+    maintenance_pacing_ms: float = 0.0  # sleep between tablets of a
+                                        # maintenance job (serving gets
+                                        # the disk/CPU back in between)
     encryption_key_file: str = ""  # at-rest AES key (reference: ee enc)
     encryption_strict: bool = False  # reject plaintext files once migrated
     slow_query_ms: int = 0        # log queries slower than this (0 = off)

@@ -12,6 +12,16 @@ their historical plain-name identity so existing consumers (snapshot
 readers, the cluster transfer-byte tests) see no change. Histograms use
 the standard µs latency bucket ladder (`BUCKETS_US`) unless the first
 observation for a name registers a custom ladder.
+
+Cardinality guard: a label value sourced from data (predicate names,
+peer addrs) can explode a metric into unbounded series — the classic
+Prometheus cardinality bomb. Each metric NAME admits at most
+`max_label_sets` distinct label-value sets (default MAX_LABEL_SETS;
+`set_label_limit` overrides per name); later novel sets collapse into
+one overflow series labeled `other="true"`, and every collapsed
+recording counts in `metrics_series_dropped_total` so the clamp itself
+is visible. Known sets keep recording exactly — only NEW identities
+overflow.
 """
 
 from __future__ import annotations
@@ -21,6 +31,10 @@ import threading
 # standard µs latency ladder: 100µs … 10s, then +Inf
 BUCKETS_US = (100, 1_000, 10_000, 100_000, 1_000_000, 10_000_000)
 _BUCKETS = BUCKETS_US  # back-compat alias
+
+MAX_LABEL_SETS = 64              # default per-name label-set cap
+OVERFLOW_KEY = (("other", "true"),)  # where novel sets collapse
+DROPPED_SERIES = "metrics_series_dropped_total"
 
 
 def _label_key(labels: dict) -> tuple:
@@ -49,6 +63,9 @@ class Registry:
         self._gauges: dict[tuple[str, tuple], float] = {}
         self._hists: dict[tuple[str, tuple], list] = {}
         self._hist_buckets: dict[str, tuple] = {}
+        self._label_sets: dict[str, set] = {}   # name → admitted label sets
+        self._label_limits: dict[str, int] = {}  # per-name cap overrides
+        self.max_label_sets = MAX_LABEL_SETS
         self._enabled = True
 
     def set_enabled(self, flag: bool) -> None:
@@ -56,18 +73,43 @@ class Registry:
         the switch the <5% query-path overhead guard flips."""
         self._enabled = bool(flag)
 
+    def set_label_limit(self, name: str, n: int) -> None:
+        """Per-name override of the label-set cardinality cap."""
+        with self._lock:
+            self._label_limits[name] = int(n)
+
+    def _guard(self, name: str, lk: tuple) -> tuple:
+        """Admit or collapse a label set (caller holds the lock).
+        Label-free series and already-admitted sets pass through; a
+        novel set past the cap collapses to `other="true"` and counts
+        a dropped recording."""
+        if not lk or lk == OVERFLOW_KEY:
+            return lk
+        seen = self._label_sets.setdefault(name, set())
+        if lk in seen:
+            return lk
+        cap = self._label_limits.get(name, self.max_label_sets)
+        if len(seen) >= cap:
+            dk = (DROPPED_SERIES, ())
+            self._counters[dk] = self._counters.get(dk, 0.0) + 1.0
+            return OVERFLOW_KEY
+        seen.add(lk)
+        return lk
+
     def inc(self, name: str, value: float = 1.0, **labels) -> None:
         if not self._enabled:
             return
-        k = (name, _label_key(labels))
+        lk = _label_key(labels)
         with self._lock:
+            k = (name, self._guard(name, lk))
             self._counters[k] = self._counters.get(k, 0.0) + value
 
     def set_gauge(self, name: str, value: float, **labels) -> None:
         if not self._enabled:
             return
+        lk = _label_key(labels)
         with self._lock:
-            self._gauges[(name, _label_key(labels))] = value
+            self._gauges[(name, self._guard(name, lk))] = value
 
     def observe(self, name: str, value: float,
                 buckets: tuple | None = None, **labels) -> None:
@@ -76,8 +118,8 @@ class Registry:
         every label set of one histogram shares one ladder)."""
         if not self._enabled:
             return
-        k = (name, _label_key(labels))
         with self._lock:
+            k = (name, self._guard(name, _label_key(labels)))
             bks = self._hist_buckets.setdefault(
                 name, tuple(buckets) if buckets else BUCKETS_US)
             h = self._hists.get(k)

@@ -177,3 +177,53 @@ def test_global_registry_exposition_is_strict():
     strictly parseable."""
     from dgraph_tpu.utils.metrics import METRICS
     check_exposition(METRICS.render())
+
+
+def test_label_cardinality_guard_caps_series():
+    """ISSUE 3 satellite: per-name label-set cap. Novel sets past the
+    cap collapse into other="true"; admitted sets keep recording
+    exactly; the clamp counts itself in metrics_series_dropped_total."""
+    from dgraph_tpu.utils.metrics import DROPPED_SERIES
+
+    r = Registry()
+    r.set_label_limit("preds_total", 8)
+    for i in range(50):
+        r.inc("preds_total", pred=f"p{i}")
+    # first 8 identities admitted, the other 42 recordings collapsed
+    snap = r.snapshot()["counters"]
+    series = [k for k in snap if k.startswith("preds_total{")]
+    assert len(series) == 9  # 8 admitted + the overflow bucket
+    assert 'preds_total{other="true"}' in snap
+    assert snap['preds_total{other="true"}'] == 42.0
+    assert snap[DROPPED_SERIES] == 42.0
+    # an admitted identity still records under its own series
+    r.inc("preds_total", pred="p3")
+    assert r.get("preds_total", pred="p3") == 2.0
+    # and the overflow keeps absorbing novel ones
+    r.inc("preds_total", pred="brand-new")
+    assert r.get("preds_total", other="true") == 43.0
+    check_exposition(r.render())
+
+
+def test_label_cardinality_guard_covers_gauges_and_histograms():
+    r = Registry()
+    r.max_label_sets = 4
+    for i in range(10):
+        r.set_gauge("g", float(i), shard=str(i))
+        r.observe("h_us", 10.0, shard=str(i))
+    snap = r.snapshot()["gauges"]
+    gauges = [k for k in snap if k.startswith("g{")]
+    assert len(gauges) == 5 and 'g{other="true"}' in snap
+    text = r.render()
+    assert 'h_us_bucket{other="true",le="100"}' in text
+    check_exposition(text)
+
+
+def test_label_free_series_never_guarded():
+    """Plain-name series bypass the cardinality machinery entirely —
+    the historical identity contract holds at any cap."""
+    r = Registry()
+    r.max_label_sets = 0
+    r.inc("plain_total", 5.0)
+    assert r.get("plain_total") == 5.0
+    assert "plain_total" in r.snapshot()["counters"]

@@ -137,6 +137,82 @@ def test_concurrent_faulting_single_load(ckpt_dir):
     assert lazy.faults == 2          # one load per predicate, not 16
 
 
+def test_concurrent_fault_accounting_invariants(ckpt_dir):
+    """Thread-safety regression (ISSUE 3 satellite): many threads
+    faulting/releasing the same tablets must never double-charge the
+    byte budget, desync the LRU bookkeeping, or leave the budget
+    exceeded while evictable tablets remain (the historical eviction
+    loop broke out early when it met the protected tablet, leaving the
+    store over budget with other victims still resident)."""
+    import threading
+
+    from dgraph_tpu.store.outofcore import _pd_nbytes
+
+    d, _a = ckpt_dir
+    # budget ≈ two tablets: constant eviction pressure under contention
+    probe, _ = open_out_of_core(d, 1 << 30)
+    sizes = [_pd_nbytes(probe.preds[p])
+             for p in ("follows", "likes", "rates", "knows")]
+    budget = int(sum(sizes) / 2)
+    store, _ = open_out_of_core(d, budget)
+    lazy = store.preds
+    preds = ["follows", "likes", "rates", "knows", "name", "score"]
+    errors = []
+
+    def hammer(seed):
+        import random
+        rng = random.Random(seed)
+        try:
+            for _ in range(120):
+                p = rng.choice(preds)
+                if rng.random() < 0.15:
+                    lazy.release(p)
+                else:
+                    pd = lazy.get(p)
+                    assert pd is not None
+        except Exception as e:  # noqa: BLE001 — surfaced below
+            errors.append(e)
+
+    threads = [threading.Thread(target=hammer, args=(i,))
+               for i in range(12)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors[:3]
+    with lazy._lock:
+        # accounting exactly matches the resident set: no double-charge,
+        # no leaked size entry
+        assert set(lazy._sizes) == set(lazy._resident)
+        assert lazy.resident_bytes == sum(lazy._sizes.values())
+        recount = sum(_pd_nbytes(pd) for pd in lazy._resident.values())
+        assert lazy.resident_bytes == recount
+        # budget invariant: over budget only when a single tablet alone
+        # exceeds it
+        assert (lazy.resident_bytes <= lazy.budget_bytes
+                or len(lazy._resident) == 1)
+    assert lazy.peak_resident_bytes <= budget + max(sizes)
+
+
+def test_release_drops_only_streamer_faults(ckpt_dir):
+    """release() is the streaming layer's lever: it must drop exactly
+    the named tablet and keep accounting exact; double-release is a
+    no-op."""
+    d, _a = ckpt_dir
+    store, _ = open_out_of_core(d, 1 << 30)
+    lazy = store.preds
+    assert lazy.get("follows") is not None
+    assert lazy.is_resident("follows")
+    before = lazy.resident_bytes
+    assert lazy.release("follows")
+    assert not lazy.is_resident("follows")
+    assert lazy.resident_bytes < before
+    assert not lazy.release("follows")   # idempotent
+    # re-touch re-faults identical data
+    assert lazy.get("follows").fwd.nnz > 0
+    assert lazy.faults >= 2
+
+
 def test_alpha_open_with_memory_budget(ckpt_dir, tmp_path):
     """The product path: Alpha.open(memory_budget=...) serves queries
     out-of-core, and mutations still commit through MVCC layers on top
