@@ -30,9 +30,13 @@ def cmd_alpha(args) -> int:
         "encryption_strict": args.encryption_strict or None,
         "slow_query_ms": args.slow_query_ms,
         "trace_dir": args.trace_dir,
+        "trace_export": args.trace_export,
         "rollup_after": args.rollup_after,
         "checkpoint_every_s": args.checkpoint_every_s,
-        "maintenance_pacing_ms": args.maintenance_pacing_ms}
+        "maintenance_pacing_ms": args.maintenance_pacing_ms,
+        "max_inflight": args.max_inflight,
+        "queue_depth": args.queue_depth,
+        "default_deadline_ms": args.default_deadline_ms}
     if args.store:
         # grouped superflag (reference: z.SuperFlag, e.g.
         # --badger "compression=zstd; numgoroutines=8")
@@ -79,6 +83,19 @@ def cmd_alpha(args) -> int:
                        memory_budget=(cfg.memory_budget_mb << 20)
                        if cfg.memory_budget_mb else None)
     alpha.slow_query_ms = cfg.slow_query_ms
+    # request lifecycle: admission control (token limit + bounded FIFO
+    # queue + shedding) and the default per-request budget
+    if cfg.max_inflight > 0:
+        alpha.attach_admission(cfg.max_inflight, cfg.queue_depth,
+                               default_deadline_ms=cfg.default_deadline_ms)
+        log.info("admission control armed: max_inflight=%d "
+                 "queue_depth=%d default_deadline_ms=%.0f",
+                 cfg.max_inflight, cfg.queue_depth,
+                 cfg.default_deadline_ms)
+    elif cfg.default_deadline_ms:
+        alpha.default_deadline_ms = cfg.default_deadline_ms
+        log.info("default request deadline: %.0f ms",
+                 cfg.default_deadline_ms)
     if cfg.slow_query_ms:
         log.info("slow-query log armed at %d ms", cfg.slow_query_ms)
     if cfg.trace_dir:
@@ -182,6 +199,12 @@ def cmd_alpha(args) -> int:
         log.info("shutting down; draining maintenance + checkpointing "
                  "to %s", cfg.p_dir)
         alpha.shutdown(cfg.p_dir)
+        if cfg.trace_export:
+            # span registry → OTLP/JSON for an external collector
+            from dgraph_tpu.utils import tracing
+            n = tracing.export_otlp(cfg.trace_export)
+            log.info("exported %d spans as OTLP/JSON to %s", n,
+                     cfg.trace_export)
     return 0
 
 
@@ -416,6 +439,18 @@ def main(argv=None) -> int:
     p.add_argument("--trace_dir", default=None,
                    help="arm jax.profiler device-trace capture "
                         "(Perfetto) for device-fenced spans")
+    p.add_argument("--trace_export", default=None,
+                   help="on shutdown, write the span registry as "
+                        "OTLP/JSON to this path (collector-ready)")
+    p.add_argument("--max_inflight", type=int, default=None,
+                   help="admission control: concurrent requests per "
+                        "lane (read/mutate); 0 = unbounded (off)")
+    p.add_argument("--queue_depth", type=int, default=None,
+                   help="bounded FIFO wait queue per lane; a full "
+                        "queue sheds with retryable 429/ServerOverloaded")
+    p.add_argument("--default_deadline_ms", type=float, default=None,
+                   help="budget for requests that carry no ?timeout=/"
+                        "X-Deadline-Ms of their own (0 = unbounded)")
     p.add_argument("--log_level", default="info")
     p.set_defaults(fn=cmd_alpha)
 

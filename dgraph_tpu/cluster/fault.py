@@ -122,10 +122,21 @@ class FaultSchedule:
     serving, never expose the gap. The event carries no link state;
     the HARNESS performs the crash-restart through the `wal_trunc_cb`
     hook (the schedule stays transport-agnostic). Off by default so
-    historical seeds keep their exact schedules."""
+    historical seeds keep their exact schedules.
+
+    `deadline=True` adds DEADLINE-FAULT events: a read on node `src`
+    runs with a tight budget (the `seconds` field) while the current
+    link faults are live — a heal-in-progress FetchLog leg gets
+    cancelled mid-flight. The harness performs the read through
+    `deadline_cb(src, budget_s)` and asserts the lifecycle contract:
+    the cancelled read raised retryably, leaked no pend, and a retry
+    with a full budget serves or refuses CLEANLY. Also off by default
+    (same seed-stability rule); with both flags on, the extended slice
+    splits between them."""
 
     def __init__(self, seed: int, n_nodes: int, steps: int = 8,
-                 max_delay_s: float = 0.03, wal_trunc: bool = False):
+                 max_delay_s: float = 0.03, wal_trunc: bool = False,
+                 deadline: bool = False):
         import random
         self.seed = seed
         self.n_nodes = n_nodes
@@ -137,9 +148,24 @@ class FaultSchedule:
         for _ in range(steps):
             src, dst = rng.choice(links)
             r = rng.random()
-            if wal_trunc and r >= 0.85:
+            extended = None
+            if r >= 0.85:
+                # the extended slice: split between whichever extended
+                # fault families are armed (order fixed so a given
+                # (flags, seed) pair always regenerates identically)
+                if wal_trunc and deadline:
+                    extended = "wal_trunc" if r < 0.925 else "deadline"
+                elif wal_trunc:
+                    extended = "wal_trunc"
+                elif deadline:
+                    extended = "deadline"
+            if extended == "wal_trunc":
                 # a crash-restart with a torn tail; dst/seconds unused
                 self.events.append(("wal_trunc", src, dst, 0.0))
+            elif extended == "deadline":
+                # a read on src with this budget, under the live faults
+                self.events.append(("deadline", src, dst,
+                                    round(rng.uniform(0.001, 0.05), 4)))
             elif r < 0.40:
                 self.events.append(("drop", src, dst, 0.0))
             elif r < 0.70:
@@ -154,12 +180,18 @@ class FaultSchedule:
                 f"n_nodes={self.n_nodes}, events={self.events})")
 
     def apply_event(self, ev: tuple[str, int, int, float],
-                    faulty_groups, addrs, wal_trunc_cb=None) -> None:
+                    faulty_groups, addrs, wal_trunc_cb=None,
+                    deadline_cb=None) -> None:
         """Apply one event; `faulty_groups[i]` is node i's FaultyGroups
         wrapper, `addrs[i]` its address. `wal_trunc_cb(src)` performs a
-        crash-restart-with-torn-tail of node src (harness-provided; the
-        event is skipped when the harness passes None)."""
+        crash-restart-with-torn-tail of node src; `deadline_cb(src,
+        budget_s)` runs the harness's tight-budget read on node src
+        (either is skipped when the harness passes None)."""
         op, src, dst, secs = ev
+        if op == "deadline":
+            if deadline_cb is not None:
+                deadline_cb(src, secs)
+            return
         if op == "wal_trunc":
             if wal_trunc_cb is not None:
                 # the node's links come back clean after a restart

@@ -18,6 +18,7 @@ reads at older timestamps fetch an as-of snapshot without caching.
 from __future__ import annotations
 
 from dgraph_tpu.store.store import Store
+from dgraph_tpu.utils import deadline
 
 
 class _RoutedPreds(dict):
@@ -29,6 +30,9 @@ class _RoutedPreds(dict):
         self.read_ts = read_ts
 
     def _fetch(self, pred):
+        # budget gate before faulting a whole foreign tablet over the
+        # wire (the remaining budget rides the RPC as its gRPC timeout)
+        deadline.checkpoint("tablet_fault")
         pd = self.alpha._fetch_tablet(pred, self.read_ts)
         if pd is not None:
             super().__setitem__(pred, pd)

@@ -25,7 +25,7 @@ from dgraph_tpu.engine.execute import Executor, LevelNode
 from dgraph_tpu.engine.ir import SubGraph
 from dgraph_tpu.engine.outputnode import to_json
 from dgraph_tpu.engine.recurse import RecurseData, _bind_recurse_vars
-from dgraph_tpu.utils import tracing
+from dgraph_tpu.utils import deadline, tracing
 from dgraph_tpu.utils.jitcache import jit_call
 from dgraph_tpu.utils.metrics import METRICS
 
@@ -159,6 +159,10 @@ def run_batch(store, plan, device_threshold: int) -> list:
     seed_lists = seeds + [np.zeros(0, np.int32)] * (B - len(seeds))
     mask0 = pack_seed_masks(g, seed_lists)
 
+    # kernel launch gate: past here the fused multi-hop program is one
+    # uninterruptible XLA dispatch — the budget check happens before
+    # the device is committed, not inside the kernel
+    deadline.checkpoint("kernel")
     # kernel-group telemetry: membership, lane-padding waste, compiles
     METRICS.inc("kernel_group_launches_total", family="recurse")
     METRICS.inc("kernel_group_queries_total", float(len(plan.blocks)),

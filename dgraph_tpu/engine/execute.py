@@ -31,6 +31,7 @@ from dgraph_tpu.engine.funcs import (EMPTY, eval_func,
 from dgraph_tpu.engine.ir import FilterNode, FuncNode, Order, SubGraph
 from dgraph_tpu.store.store import Store
 from dgraph_tpu.store.types import Kind
+from dgraph_tpu.utils import deadline as dl
 from dgraph_tpu.utils import tracing
 from dgraph_tpu.utils.jitcache import jit_call
 from dgraph_tpu.utils.metrics import METRICS
@@ -503,6 +504,7 @@ class Executor:
     # -- block execution ----------------------------------------------------
     def run_block(self, sg: SubGraph) -> LevelNode:
         """Execute one root block (reference: Request.ProcessQuery per block)."""
+        dl.checkpoint("block")
         with tracing.span("engine.block", block=sg.attr) as sp:
             node = self._run_block(sg)
             sp.attrs["nodes"] = int(len(node.nodes))
@@ -565,6 +567,9 @@ class Executor:
         applied (the fused device path, which is only eligible when no
         ordering exists). The lane-batch executor overrides this with
         mask-constrained CSR intersection (engine/treebatch.py)."""
+        # per-level cancellation point — the acceptance granularity: a
+        # deep tree stops within ONE level of its budget expiring
+        dl.checkpoint("level")
         with tracing.span("engine.level", pred=sg.attr,
                           frontier=int(len(frontier))) as sp:
             fused = self._fused_level(sg, frontier)

@@ -21,6 +21,7 @@ import numpy as np
 
 from dgraph_tpu.engine.execute import _needs_facets
 from dgraph_tpu.engine.ir import SubGraph
+from dgraph_tpu.utils import deadline
 
 MAX_RECURSE_DEPTH = 64  # guard when depth: 0 (fixpoint mode)
 
@@ -76,6 +77,9 @@ def expand_recurse(ex, root) -> None:
     for _d in range(depth):
         if len(frontier) == 0:
             break
+        # per-hop cancellation point: a pathological @recurse stops
+        # within one hop of its budget (utils/deadline.py)
+        deadline.checkpoint("recurse")
         level: dict[int, tuple[np.ndarray, np.ndarray]] = {}
         new_parts = []
         for i, esg in enumerate(data.edge_sgs):

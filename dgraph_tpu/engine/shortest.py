@@ -20,6 +20,8 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
+from dgraph_tpu.utils import deadline
+
 MAX_PATH_DEPTH = 32
 # Yen's outer loop extracts one path per iteration; when min/maxweight
 # discard most of them the search could otherwise grind through an
@@ -76,6 +78,9 @@ def _shortest_path(ex, sg) -> PathData:
         for _ in range(max_depth):
             if found or not len(frontier):
                 break
+            # per-BFS-iteration cancellation point (the acceptance
+            # granularity for shortest-path budgets)
+            deadline.checkpoint("bfs")
             level_new: dict[int, list[tuple[int, int]]] = {}
             for i, esg in enumerate(data.edge_sgs):
                 nbrs, seg, pos = ex.expand(esg.attr, esg.is_reverse,
@@ -92,15 +97,22 @@ def _shortest_path(ex, sg) -> PathData:
             frontier = np.array(sorted(level_new), np.int32)
 
         if int(dst) in parents:
-            def walk(rank: int):
-                plist = parents[rank]
+            # iterative walk-back (first-visit BFS: following the first
+            # parent at every step IS the first path the recursive
+            # enumeration would yield) — a recursive walk blows the
+            # interpreter stack on a 1000-hop chain, and a pathological
+            # path length must cancel via the deadline checkpoints
+            # above, never crash the walk
+            rev, cur = [], int(dst)
+            while True:
+                plist = parents[cur]
                 if not plist:
-                    yield [(rank, -1)]
-                    return
-                for p, pi in plist:
-                    for prefix in walk(p):
-                        yield prefix + [(rank, pi)]
-            data.paths = [next(walk(int(dst)))]
+                    rev.append((cur, -1))
+                    break
+                p, pi = plist[0]
+                rev.append((cur, pi))
+                cur = p
+            data.paths = [rev[::-1]]
     else:
         data.paths = _k_shortest(ex, data, int(src), int(dst), max_depth,
                                  k, args.minweight, args.maxweight)
@@ -148,6 +160,7 @@ def _k_shortest(ex, data: PathData, src: int, dst: int, max_depth: int,
     for level in range(max_depth):
         if not len(frontier):
             break
+        deadline.checkpoint("bfs")
         level_new: dict[int, list[tuple[int, int]]] = {}
         for i, esg in enumerate(data.edge_sgs):
             nbrs, seg, pos = ex.expand(esg.attr, esg.is_reverse, frontier)
@@ -251,6 +264,7 @@ def _weighted_one(ex, data: PathData, src: int, dst: int, wkeys,
     for _round in range(max(n, 1)):
         if not len(frontier):
             break
+        deadline.checkpoint("bfs")  # per relaxation round
         nbr_parts, nd_parts = [], []
         for i, esg in enumerate(data.edge_sgs):
             nbrs, seg, ws = relax_edges(frontier, i, esg)

@@ -346,9 +346,11 @@ def run_tree_batch(store, plan: TreePlan, device_threshold: int) -> list:
     seeds_np = [_pack_global(n, lst, lanes) for lst in seed_lists]
     filts_np = [_pack_global(n, lst, lanes) for lst in filt_lists]
 
-    from dgraph_tpu.utils import tracing
+    from dgraph_tpu.utils import deadline, tracing
     from dgraph_tpu.utils.jitcache import jit_call
     from dgraph_tpu.utils.metrics import METRICS
+    # budget gate before the device is committed to the fused program
+    deadline.checkpoint("kernel")
     METRICS.inc("kernel_group_launches_total", family="tree")
     METRICS.inc("kernel_group_queries_total", float(B), family="tree")
     METRICS.inc("kernel_padded_lanes_total", float(lanes - B),

@@ -1,0 +1,221 @@
+"""Admission control: bounded concurrency, FIFO queueing, load shedding.
+
+Reference parity: the reference bounds work at the `worker.Task` gRPC
+boundary with context deadlines and lets gRPC's stream limits shed the
+rest; a serving stack at north-star traffic (millions of users) needs
+the explicit form — a token-based concurrency limit per LANE (reads and
+mutations don't starve each other), a bounded FIFO wait queue in front
+of each, and shedding: when the queue is full the request is REFUSED
+with a retryable `ServerOverloaded` carrying a retry-after hint, rather
+than queued into a latency collapse (the classic overload spiral:
+everything admitted, nothing finishing inside its deadline).
+
+The retry-after hint is not a guess: each lane keeps an EMA of observed
+service time (the spirit of TpuGraphs' learned cost priors — measured
+spans over assumed costs), so the hint scales with what the workload is
+actually doing: `queued/inflight slots ahead × recent service time`.
+
+Queued waiters respect the request's deadline: a request whose budget
+expires while waiting is shed (`shed_total{reason="deadline"}`) instead
+of being admitted to do work nobody will read. Token handoff is FIFO by
+construction — release passes the token to the OLDEST waiter under the
+lane lock, so a burst drains in arrival order.
+
+The maintenance scheduler consults `saturated()` at tablet boundaries
+and yields the machine while real traffic is queued
+(store/maintenance.py `_pace`).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from collections import deque
+
+from dgraph_tpu.utils import tracing
+from dgraph_tpu.utils.metrics import METRICS
+
+__all__ = ["AdmissionController", "ServerOverloaded", "LANES"]
+
+LANES = ("read", "mutate")
+
+# service-time EMA smoothing + the floor the retry-after hint never
+# drops below (a hint of 0 would make clients hammer-retry)
+_EMA_ALPHA = 0.2
+_MIN_RETRY_S = 0.01
+
+
+class ServerOverloaded(Exception):
+    """RETRYABLE: the lane's wait queue is full — the server sheds
+    rather than queue into latency collapse. `retry_after_s` is the
+    server's estimate of when a slot frees up (HTTP surfaces it as a
+    `Retry-After` header + 429)."""
+
+    def __init__(self, msg: str, retry_after_s: float = _MIN_RETRY_S,
+                 lane: str = ""):
+        super().__init__(msg)
+        self.retry_after_s = retry_after_s
+        self.lane = lane
+
+
+class _Waiter:
+    __slots__ = ("event", "granted")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.granted = False
+
+
+class _Lane:
+    """One admission lane: `max_inflight` tokens + a FIFO queue bounded
+    at `queue_depth`."""
+
+    def __init__(self, name: str, max_inflight: int, queue_depth: int):
+        self.name = name
+        self.max_inflight = max(1, int(max_inflight))
+        self.queue_depth = max(0, int(queue_depth))
+        self.lock = threading.Lock()
+        self.inflight = 0
+        self.waiters: deque[_Waiter] = deque()
+        self.admitted_total = 0
+        self.shed_total = 0
+        self.service_ema_s = 0.05  # seeded guess; real spans take over
+
+    # -- gauges ---------------------------------------------------------------
+    def _publish(self) -> None:
+        """Caller holds the lock."""
+        METRICS.set_gauge("admission_inflight", float(self.inflight),
+                          lane=self.name)
+        METRICS.set_gauge("admission_queued", float(len(self.waiters)),
+                          lane=self.name)
+
+    def _retry_after_s(self, queued: int) -> float:
+        """Slots ahead of a would-be waiter × recent service time."""
+        ahead = (queued + self.inflight) / self.max_inflight
+        return max(_MIN_RETRY_S, ahead * self.service_ema_s)
+
+    # -- token protocol -------------------------------------------------------
+    def acquire(self, ctx=None) -> None:
+        """Take a token, queueing FIFO behind earlier waiters. Raises
+        `ServerOverloaded` when the queue is full, or the context's
+        `DeadlineExceeded`/`Cancelled` when the budget dies while
+        queued."""
+        with self.lock:
+            if self.inflight < self.max_inflight and not self.waiters:
+                self.inflight += 1
+                self.admitted_total += 1
+                self._publish()
+                return
+            if len(self.waiters) >= self.queue_depth:
+                self.shed_total += 1
+                hint = self._retry_after_s(len(self.waiters))
+                METRICS.inc("shed_total", lane=self.name,
+                            reason="queue_full")
+                raise ServerOverloaded(
+                    f"{self.name} lane overloaded: {self.inflight} "
+                    f"inflight, {len(self.waiters)} queued (limits "
+                    f"{self.max_inflight}/{self.queue_depth}); retry "
+                    f"after {hint:.3f}s", retry_after_s=hint,
+                    lane=self.name)
+            w = _Waiter()
+            self.waiters.append(w)
+            self._publish()
+        t0 = time.perf_counter()
+        with tracing.span("admission.wait", lane=self.name):
+            while True:
+                timeout = None
+                if ctx is not None:
+                    rem = ctx.remaining_s()
+                    if rem is not None:
+                        timeout = max(rem, 0.0)
+                if w.event.wait(timeout):
+                    break
+                # budget died while queued: withdraw — unless release
+                # granted the token in the same instant (checked under
+                # the lock), in which case we keep it and let the next
+                # checkpoint raise
+                with self.lock:
+                    if w.granted:
+                        break
+                    self.waiters.remove(w)
+                    self.shed_total += 1
+                    self._publish()
+                    METRICS.inc("shed_total", lane=self.name,
+                                reason="deadline")
+                if ctx is not None:
+                    ctx.check("admission")
+                raise ServerOverloaded(  # cancel-less fallback
+                    f"{self.name} lane wait abandoned", lane=self.name)
+        METRICS.observe("admission_wait_us",
+                        (time.perf_counter() - t0) * 1e6, lane=self.name)
+
+    def release(self, service_s: float | None = None) -> None:
+        """Return a token; the OLDEST waiter inherits it (FIFO)."""
+        with self.lock:
+            if service_s is not None:
+                self.service_ema_s += _EMA_ALPHA * (service_s
+                                                    - self.service_ema_s)
+            if self.waiters:
+                w = self.waiters.popleft()
+                w.granted = True
+                self.admitted_total += 1
+                # inflight unchanged: the token transfers to the waiter
+                self._publish()
+                w.event.set()
+            else:
+                self.inflight -= 1
+                self._publish()
+
+    def status(self) -> dict:
+        with self.lock:
+            return {"inflight": self.inflight,
+                    "queued": len(self.waiters),
+                    "max_inflight": self.max_inflight,
+                    "queue_depth": self.queue_depth,
+                    "admitted_total": self.admitted_total,
+                    "shed_total": self.shed_total,
+                    "service_ema_ms": round(self.service_ema_s * 1e3,
+                                            3)}
+
+
+class AdmissionController:
+    """Separate read/mutate lanes over one Alpha (see module doc)."""
+
+    def __init__(self, max_inflight: int, queue_depth: int):
+        self.lanes = {name: _Lane(name, max_inflight, queue_depth)
+                      for name in LANES}
+        self._tls = threading.local()
+
+    @contextlib.contextmanager
+    def admit(self, lane: str, ctx=None):
+        """Hold one `lane` token for the duration. Reentrant per
+        thread: a nested server call (an upsert's query leg, a txn read
+        inside a continued txn) rides the token its request already
+        holds — re-admitting would deadlock a full lane against
+        itself."""
+        if getattr(self._tls, "holding", False):
+            yield
+            return
+        ln = self.lanes[lane]
+        ln.acquire(ctx)
+        self._tls.holding = True
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self._tls.holding = False
+            ln.release(time.perf_counter() - t0)
+
+    def queued(self) -> int:
+        return sum(len(ln.waiters) for ln in self.lanes.values())
+
+    def saturated(self) -> bool:
+        """True while real traffic is queued — the signal maintenance
+        yields to at tablet boundaries."""
+        return any(ln.waiters for ln in self.lanes.values())
+
+    def status(self) -> dict:
+        return {"lanes": {name: ln.status()
+                          for name, ln in self.lanes.items()},
+                "queued": self.queued()}

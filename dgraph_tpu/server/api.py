@@ -30,6 +30,7 @@ from dgraph_tpu.store.mvcc import MVCCStore, Mutation
 from dgraph_tpu.store.schema import parse_schema
 from dgraph_tpu.store.store import Store
 from dgraph_tpu.store.types import Kind, hash_password
+from dgraph_tpu.utils import deadline as dl
 from dgraph_tpu.utils import tracing
 from dgraph_tpu.utils.metrics import METRICS
 
@@ -120,6 +121,12 @@ class Alpha:
         # store/maintenance.MaintenanceScheduler | None: background
         # rollup/checkpoint/backup/export jobs (attach_maintenance)
         self.maintenance = None
+        # server/admission.AdmissionController | None: bounded
+        # concurrency + FIFO queue + shedding (attach_admission);
+        # default_deadline_ms applies to requests with no explicit
+        # budget (0 = unbounded, the historical behavior)
+        self.admission = None
+        self.default_deadline_ms = 0.0
         self._apply_lock = threading.Lock()
         self._state_lock = threading.Lock()
         self._open_txns: dict[int, Txn] = {}
@@ -317,6 +324,44 @@ class Alpha:
             pacing_ms=pacing_ms).start()
         return self.maintenance
 
+    def attach_admission(self, max_inflight: int, queue_depth: int,
+                         default_deadline_ms: float = 0.0):
+        """Arm admission control on this Alpha (server/admission.py):
+        per-lane token limits, a bounded FIFO wait queue, and shedding
+        with a retryable `ServerOverloaded`. `default_deadline_ms`
+        budgets requests that bring none of their own."""
+        from dgraph_tpu.server.admission import AdmissionController
+        self.admission = AdmissionController(max_inflight, queue_depth)
+        self.default_deadline_ms = float(default_deadline_ms)
+        return self.admission
+
+    @contextlib.contextmanager
+    def _request(self, lane: str, deadline_ms: float | None):
+        """Request-lifecycle shell every public entrypoint runs inside:
+        establish the budget (explicit deadline_ms, else the configured
+        default), install it as the thread's ambient context
+        (utils/deadline.py — hot-loop checkpoints + RPC budget
+        forwarding find it there), and hold an admission token for the
+        duration. A nested server call (a txn read issued inside an
+        already-admitted request) reuses the enclosing context: the
+        OUTER budget governs, and no second token is taken — a full
+        lane must never deadlock against its own request."""
+        outer = dl.current()
+        if outer is not None:
+            yield outer
+            return
+        if deadline_ms is None and self.default_deadline_ms:
+            deadline_ms = self.default_deadline_ms
+        ctx = dl.RequestContext(deadline_ms)
+        with dl.activate(ctx):
+            if self.admission is not None:
+                with self.admission.admit(lane, ctx):
+                    # budget may have died while queued
+                    ctx.check("admission")
+                    yield ctx
+            else:
+                yield ctx
+
     def shutdown(self, p_dir: str | None = None) -> None:
         """Drain maintenance (finish the in-flight + requested jobs),
         then take a final checkpoint — the clean-exit path the CLI runs
@@ -447,6 +492,11 @@ class Alpha:
         unreachable: dict[str, int | None] = {}
         reachable: list[str] = []
         for addr in replicas:
+            # per-peer probe budget gate: a read whose deadline died
+            # mid-verification raises HERE (retryable), with no chain
+            # state half-advanced — _last_from/_origin_gaps only move
+            # after a completed catch-up
+            dl.checkpoint("chain_head")
             t0 = _time.perf_counter()
             try:
                 node, head = self.groups.pool(addr).chain_head()
@@ -550,35 +600,45 @@ class Alpha:
 
     def query(self, dql: str, variables: dict | None = None,
               read_ts: int | None = None,
-              acl_user: str | None = None) -> dict:
+              acl_user: str | None = None,
+              deadline_ms: float | None = None) -> dict:
         """Read-only query at a snapshot (reference: Server.Query with
         best-effort/read-only txn). With ACL enabled and an acl_user,
         unreadable predicates are invisible (reference: query rewriting
-        drops unauthorized predicates)."""
-        with self._reading(read_ts) as ts:
-            self._verify_read_chains(ts)
-            store = self._query_view(ts, acl_user)
-            out = Engine(store, device_threshold=self.device_threshold,
-                         mesh=self.mesh).query(dql, variables)
+        drops unauthorized predicates). `deadline_ms` bounds the whole
+        request — engine hot loops and RPC legs checkpoint against it
+        and raise a retryable `DeadlineExceeded` within one level/BFS
+        iteration of the budget."""
+        with self._request("read", deadline_ms):
+            with self._reading(read_ts) as ts:
+                self._verify_read_chains(ts)
+                store = self._query_view(ts, acl_user)
+                out = Engine(store,
+                             device_threshold=self.device_threshold,
+                             mesh=self.mesh).query(dql, variables)
         self._maybe_gc()
         return out
 
     def query_raw(self, dql: str, variables: dict | None = None,
                   read_ts: int | None = None,
-                  acl_user: str | None = None) -> bytes:
+                  acl_user: str | None = None,
+                  deadline_ms: float | None = None) -> bytes:
         """Serving-path query: response BYTES via the native JSON emitter
         (engine/emit.py), never a Python object tree (reference:
         outputnode.go ToJson writes bytes straight into the response)."""
-        with self._reading(read_ts) as ts:
-            self._verify_read_chains(ts)
-            store = self._query_view(ts, acl_user)
-            raw = Engine(store, device_threshold=self.device_threshold,
-                         mesh=self.mesh).query_bytes(dql, variables)
+        with self._request("read", deadline_ms):
+            with self._reading(read_ts) as ts:
+                self._verify_read_chains(ts)
+                store = self._query_view(ts, acl_user)
+                raw = Engine(store,
+                             device_threshold=self.device_threshold,
+                             mesh=self.mesh).query_bytes(dql, variables)
         self._maybe_gc()
         return raw
 
     def query_batch(self, dqls: list, read_ts: int | None = None,
-                    acl_user: str | None = None) -> list:
+                    acl_user: str | None = None,
+                    deadline_ms: float | None = None) -> list:
         """Serve MANY queries at once: structurally-compatible @recurse
         batches execute as ONE lane-packed kernel launch (the north-star
         throughput path, engine/batch.py); everything else falls back to
@@ -586,7 +646,8 @@ class Alpha:
         from dgraph_tpu.dql.parser import parse
         from dgraph_tpu.engine.batch import plan_batch_groups, run_batch
 
-        with self._reading(read_ts) as ts:
+        with self._request("read", deadline_ms), \
+                self._reading(read_ts) as ts:
             self._verify_read_chains(ts)
             store = self._query_view(ts, acl_user)
             from dgraph_tpu.utils import logging as xlog
@@ -618,6 +679,8 @@ class Alpha:
                     try:
                         out = run_batch(store, plan,
                                         self.device_threshold)
+                    except (dl.DeadlineExceeded, dl.Cancelled):
+                        raise  # the whole request's budget died
                     except Exception:  # noqa: BLE001 — optimization only
                         xlog.get("alpha").debug(
                             "batch group failed; per-query fallback",
@@ -629,18 +692,24 @@ class Alpha:
                     for i, o in zip(idxs, out):
                         results[i] = o
                 leftover.sort()
+            except (dl.DeadlineExceeded, dl.Cancelled):
+                raise
             except Exception:  # noqa: BLE001 — batch is an optimization
                 xlog.get("alpha").debug("batch plan failed; per-query "
                                         "fallback", exc_info=True)
                 leftover = list(range(len(dqls)))
             # per-query fallback with per-query error isolation: one bad
             # query yields an error OBJECT in its slot, never a failed
-            # batch (the other results still return)
+            # batch (the other results still return) — but a dead
+            # REQUEST budget fails the batch: grinding through the
+            # remaining queries would defeat the deadline's point
             eng = Engine(store, device_threshold=self.device_threshold,
                          mesh=self.mesh)
             for i in leftover:
                 try:
                     results[i] = eng.query(dqls[i])
+                except (dl.DeadlineExceeded, dl.Cancelled):
+                    raise
                 except Exception as e:  # noqa: BLE001
                     results[i] = {"errors": [{"message": str(e)}]}
         self._maybe_gc()
@@ -651,10 +720,25 @@ class Alpha:
                set_json=None, del_json=None,
                commit_now: bool = True,
                start_ts: int | None = None,
-               acl_user: str | None = None) -> dict:
+               acl_user: str | None = None,
+               deadline_ms: float | None = None) -> dict:
         """Mutation RPC. With start_ts: continue that open txn. With
         commit_now=False: leave the txn open and return its start_ts
-        (reference: Server.Mutate + CommitNow flag)."""
+        (reference: Server.Mutate + CommitNow flag). The deadline stops
+        the request only BEFORE the two-phase stage begins; once
+        staging starts the decision protocol runs to completion (an
+        interrupt between stage and decide would leak an undecided
+        pend)."""
+        with self._request("mutate", deadline_ms):
+            return self._mutate(set_nquads=set_nquads,
+                                del_nquads=del_nquads,
+                                set_json=set_json, del_json=del_json,
+                                commit_now=commit_now,
+                                start_ts=start_ts, acl_user=acl_user)
+
+    def _mutate(self, *, set_nquads=None, del_nquads=None, set_json=None,
+                del_json=None, commit_now=True, start_ts=None,
+                acl_user=None) -> dict:
         created = not start_ts
         txn = self.txn(start_ts) if start_ts else self.new_txn()
         try:
@@ -730,10 +814,15 @@ class Alpha:
         self.acl.check_mutation(acl_user, touched)
 
     def _run_upsert(self, commit_now: bool, start_ts: int | None,
-                    run) -> dict:
+                    run, deadline_ms: float | None = None) -> dict:
         """Txn bookkeeping shared by the RDF and JSON upsert forms;
         `run(txn)` performs query + substitution + buffered mutates and
         returns (queries_json, uids, applied)."""
+        with self._request("mutate", deadline_ms):
+            return self._run_upsert_body(commit_now, start_ts, run)
+
+    def _run_upsert_body(self, commit_now: bool, start_ts: int | None,
+                         run) -> dict:
         created = not start_ts
         txn = self.txn(start_ts) if start_ts else self.new_txn()
         try:
@@ -753,7 +842,8 @@ class Alpha:
 
     def upsert(self, src: str, commit_now: bool = True,
                start_ts: int | None = None,
-               acl_user: str | None = None) -> dict:
+               acl_user: str | None = None,
+               deadline_ms: float | None = None) -> dict:
         """Upsert block: run the query at the txn's read_ts, bind vars,
         evaluate @if conditions, substitute uid(v)/val(v) into the
         mutations, commit through the normal conflict path (reference:
@@ -780,12 +870,14 @@ class Alpha:
             self._check_txn_acl(txn, acl_user)
             return out, uids, applied
 
-        return self._run_upsert(commit_now, start_ts, run)
+        return self._run_upsert(commit_now, start_ts, run,
+                                deadline_ms=deadline_ms)
 
     def upsert_json(self, query: str, cond: str = "",
                     set_json=None, del_json=None, commit_now: bool = True,
                     start_ts: int | None = None,
-                    acl_user: str | None = None) -> dict:
+                    acl_user: str | None = None,
+                    deadline_ms: float | None = None) -> dict:
         """The HTTP JSON upsert form: {"query", "cond", "set"/"delete" as
         JSON mutation lists with uid(v)/val(v) references} (reference:
         Dgraph HTTP /mutate JSON upsert)."""
@@ -816,15 +908,18 @@ class Alpha:
             self._check_txn_acl(txn, acl_user)
             return out, uids, applied
 
-        return self._run_upsert(commit_now, start_ts, run)
+        return self._run_upsert(commit_now, start_ts, run,
+                                deadline_ms=deadline_ms)
 
-    def commit_or_abort(self, start_ts: int, abort: bool = False) -> int:
+    def commit_or_abort(self, start_ts: int, abort: bool = False,
+                        deadline_ms: float | None = None) -> int:
         """reference: Server.CommitOrAbort. Returns commit_ts (0 on abort)."""
-        txn = self.txn(start_ts)
-        if abort:
-            txn.discard()
-            return 0
-        return txn.commit()
+        with self._request("mutate", deadline_ms):
+            txn = self.txn(start_ts)
+            if abort:
+                txn.discard()
+                return 0
+            return txn.commit()
 
     def alter(self, schema_text: str) -> None:
         """Schema mutation + index rebuild (reference: Server.Alter →
@@ -904,6 +999,11 @@ class Alpha:
 
     # -- commit path (worker/draft.go applyMutations analog) ----------------
     def _commit(self, txn: "Txn") -> int:
+        # LAST cancellation point on the write path: past here the
+        # two-phase stage/decide protocol runs to completion —
+        # interrupting between stage and decide would leak an
+        # undecided pend on every replica that acked
+        dl.checkpoint("commit")
         with self._apply_lock:
             if self.groups is not None:
                 # pre-flight BEFORE the oracle assigns a commit_ts: a
@@ -1252,6 +1352,9 @@ class Alpha:
         against folded history)."""
         from dgraph_tpu.utils import logging as xlog
         log = xlog.get("alpha")
+        # budget gate per RPC leg: the remaining budget also rides the
+        # wire as the gRPC timeout (server/task.py Client._call)
+        dl.checkpoint("fetch_log")
         since_ts = max(since_ts, self.mvcc.base_ts)
         with tracing.span("rpc.fetch_log", peer=addr,
                           since_ts=since_ts) as sp:
@@ -1506,6 +1609,7 @@ class Alpha:
         cached = self._cached_tablet(pred, read_ts, view)
         if cached is not None:
             return cached
+        dl.checkpoint("tablet_snapshot")
         from dgraph_tpu.cluster.tablet import unpack_tablet
         with tracing.span("rpc.tablet_snapshot", pred=pred,
                           read_ts=read_ts) as sp:
@@ -1551,6 +1655,7 @@ class Alpha:
         import numpy as np
         if self.groups is None or len(frontier) > self.remote_hop_max:
             return None
+        dl.checkpoint("serve_task")
         gid = self.groups.tablet_owner(pred, claim=False)
         if gid is None or gid == self.groups.gid:
             return None

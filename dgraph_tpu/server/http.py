@@ -16,11 +16,30 @@ import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from dgraph_tpu.dql.upsert import is_upsert as _is_upsert
+from dgraph_tpu.server.admission import ServerOverloaded
 from dgraph_tpu.server.api import (Alpha, NoQuorum, ReadUnavailable,
                                    TxnAborted)
 from dgraph_tpu.utils import logging as xlog
 from dgraph_tpu.utils import tracing
+from dgraph_tpu.utils.deadline import Cancelled, DeadlineExceeded
 from dgraph_tpu.utils.metrics import METRICS
+
+
+def _parse_timeout_ms(val: str) -> float:
+    """`?timeout=` value → ms. Accepts the Dgraph/Go duration forms the
+    reference takes (`500ms`, `2s`, `1m`) and a bare number (seconds)."""
+    v = val.strip().lower()
+    try:
+        if v.endswith("ms"):
+            return float(v[:-2])
+        if v.endswith("s") and not v.endswith("ms"):
+            return float(v[:-1]) * 1e3
+        if v.endswith("m"):
+            return float(v[:-1]) * 60e3
+        return float(v) * 1e3
+    except ValueError:
+        raise ValueError(f"bad timeout value {val!r}: want e.g. "
+                         f"500ms, 2s, or seconds as a number") from None
 
 
 def make_http_server(alpha: Alpha, addr: str = "127.0.0.1",
@@ -38,12 +57,26 @@ def make_http_server(alpha: Alpha, addr: str = "127.0.0.1",
             self._send_bytes(code, data, ctype)
 
         def _send_bytes(self, code: int, data: bytes,
-                        ctype: str = "application/json"):
+                        ctype: str = "application/json",
+                        headers: dict | None = None):
             self.send_response(code)
             self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(data)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
             self.end_headers()
             self.wfile.write(data)
+
+        def _deadline_ms(self):
+            """Request budget from `?timeout=` (Go-duration form) or the
+            `X-Deadline-Ms` header (None = server default applies)."""
+            qs = urllib.parse.parse_qs(
+                urllib.parse.urlsplit(self.path).query)
+            t = (qs.get("timeout") or [None])[0]
+            if t:
+                return _parse_timeout_ms(t)
+            h = self.headers.get("X-Deadline-Ms")
+            return float(h) if h else None
 
         def _body(self) -> bytes:
             n = int(self.headers.get("Content-Length") or 0)
@@ -107,7 +140,9 @@ def make_http_server(alpha: Alpha, addr: str = "127.0.0.1",
             elif self.path.startswith("/debug/traces"):
                 # span JSON: ?trace_id=… resolves one request's spans
                 # (the id echoed in that response's extensions); bare
-                # GET returns the recent ring buffer
+                # GET returns the recent ring buffer; ?peer=host:port
+                # pulls a CLUSTER PEER's registry over the worker
+                # transport (gRPC-leg spans, not just HTTP-originated)
                 spans = self._debug_spans()
                 self._send(200, {"spans": [s.to_dict() for s in spans]})
             elif self.path.startswith("/debug/events"):
@@ -115,6 +150,15 @@ def make_http_server(alpha: Alpha, addr: str = "127.0.0.1",
                 # body directly in Perfetto / chrome://tracing
                 spans = self._debug_spans()
                 self._send(200, tracing.to_chrome(spans))
+            elif self.path.startswith("/debug/admission"):
+                # admission-control status: per-lane inflight/queued/
+                # shed counts + limits (the numbers the overload
+                # acceptance test cross-checks against metrics)
+                if alpha.admission is None:
+                    self._send(200, {"enabled": False})
+                else:
+                    self._send(200, {"enabled": True,
+                                     **alpha.admission.status()})
             elif self.path.startswith("/admin/maintenance"):
                 # scheduler status: running/queued jobs, pause state,
                 # policy knobs (reference: /admin health of background
@@ -132,9 +176,21 @@ def make_http_server(alpha: Alpha, addr: str = "127.0.0.1",
             qs = urllib.parse.parse_qs(
                 urllib.parse.urlsplit(self.path).query)
             tid = (qs.get("trace_id") or [None])[0]
+            n = int((qs.get("n") or [256])[0])
+            peer = (qs.get("peer") or [None])[0]
+            if peer:
+                # proxy to the peer's registry over the worker
+                # transport (DebugTraces RPC): peer-leg spans become
+                # reachable from THIS node's debug surface
+                from dgraph_tpu.server.task import Client
+                c = Client(peer)
+                try:
+                    dicts = c.debug_traces(trace_id=tid or "", n=n)
+                finally:
+                    c.close()
+                return [tracing.Span(**d) for d in dicts]
             if tid:
                 return tracing.trace_spans(tid)
-            n = int((qs.get("n") or [256])[0])
             return tracing.recent(n)
 
         def _slow_query_check(self, us: int, trace_id: str,
@@ -223,12 +279,14 @@ def make_http_server(alpha: Alpha, addr: str = "127.0.0.1",
                     self._send(200, {"data": {"accessJWT": token}})
                     return
                 acl_user = self._acl_user()
+                deadline_ms = self._deadline_ms()
                 if self.path.startswith("/query/batch"):
                     req = json.loads(self._body().decode())
                     with tracing.trace("http.query_batch",
                                        queries=len(req["queries"])) as tid:
                         outs = alpha.query_batch(req["queries"],
-                                                 acl_user=acl_user)
+                                                 acl_user=acl_user,
+                                                 deadline_ms=deadline_ms)
                     us = int((time.perf_counter() - t0) * 1e6)
                     METRICS.observe("query_latency_us", us,
                                     endpoint="query_batch")
@@ -247,7 +305,8 @@ def make_http_server(alpha: Alpha, addr: str = "127.0.0.1",
                         q, variables = body, None
                     with tracing.trace("http.query") as tid:
                         raw = alpha.query_raw(q, variables,
-                                              acl_user=acl_user)
+                                              acl_user=acl_user,
+                                              deadline_ms=deadline_ms)
                     us = int((time.perf_counter() - t0) * 1e6)
                     METRICS.observe("query_latency_us", us,
                                     endpoint="query")
@@ -290,30 +349,35 @@ def make_http_server(alpha: Alpha, addr: str = "127.0.0.1",
                                 res = alpha.upsert(
                                     src, commit_now=cn,
                                     start_ts=start_ts,
-                                    acl_user=acl_user)
+                                    acl_user=acl_user,
+                                    deadline_ms=deadline_ms)
                             else:
                                 res = alpha.upsert_json(
                                     req["query"], req.get("cond", ""),
                                     set_json=req.get("set"),
                                     del_json=req.get("delete"),
                                     commit_now=cn, start_ts=start_ts,
-                                    acl_user=acl_user)
+                                    acl_user=acl_user,
+                                    deadline_ms=deadline_ms)
                         else:
                             res = alpha.mutate(
                                 set_json=req.get("set"),
                                 del_json=req.get("delete"),
                                 commit_now=(commit_now or
                                             req.get("commitNow", False)),
-                                start_ts=start_ts, acl_user=acl_user)
+                                start_ts=start_ts, acl_user=acl_user,
+                                deadline_ms=deadline_ms)
                     elif _is_upsert(body):
                         res = alpha.upsert(body, commit_now=commit_now,
                                            start_ts=start_ts,
-                                           acl_user=acl_user)
+                                           acl_user=acl_user,
+                                           deadline_ms=deadline_ms)
                     else:
                         res = alpha.mutate(set_nquads=body,
                                            commit_now=commit_now,
                                            start_ts=start_ts,
-                                           acl_user=acl_user)
+                                           acl_user=acl_user,
+                                           deadline_ms=deadline_ms)
                     self._send(200, {"data": res})
                 elif self.path.startswith("/commit"):
                     qs = self.path.partition("?")[2]
@@ -328,7 +392,8 @@ def make_http_server(alpha: Alpha, addr: str = "127.0.0.1",
                             {"message": "startTs required"}]})
                         return
                     cts = alpha.commit_or_abort(start_ts,
-                                                abort=bool(abort))
+                                                abort=bool(abort),
+                                                deadline_ms=deadline_ms)
                     self._send(200, {"data": {
                         "code": "Success", "commit_ts": cts}})
                 elif self.path.startswith("/admin/"):
@@ -353,6 +418,32 @@ def make_http_server(alpha: Alpha, addr: str = "127.0.0.1",
             except TxnAborted as e:
                 self._send(409, {"errors": [{"message": str(e),
                                              "code": "Aborted"}]})
+            except ServerOverloaded as e:
+                # RETRYABLE shed: 429 + a Retry-After hint scaled by
+                # the lane's measured service time — clients and load
+                # balancers back off instead of hammering
+                METRICS.inc("http_overload_responses_total")
+                self._send_bytes(
+                    429,
+                    json.dumps({"errors": [{
+                        "message": str(e),
+                        "code": "ServerOverloaded",
+                        "retry_after_s": round(e.retry_after_s, 3)}]}
+                    ).encode(),
+                    headers={"Retry-After":
+                             f"{max(e.retry_after_s, 0.001):.3f}"})
+            except DeadlineExceeded as e:
+                # RETRYABLE: the request's own budget expired — 504
+                # (the server gave up inside the client's deadline
+                # contract, not a client error)
+                self._send(504, {"errors": [{"message": str(e),
+                                             "code": "DeadlineExceeded",
+                                             "stage": e.stage}]})
+            except Cancelled as e:
+                # 499 (client-closed-request convention): the client
+                # cancelled; nothing to retry unless it wants to
+                self._send(499, {"errors": [{"message": str(e),
+                                             "code": "Cancelled"}]})
             except (NoQuorum, ReadUnavailable) as e:
                 # RETRYABLE partition refusals, not client errors: the
                 # minority side refuses writes (NoQuorum) and refuses

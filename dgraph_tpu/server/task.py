@@ -21,11 +21,32 @@ import numpy as np
 
 from dgraph_tpu.engine.execute import Executor
 from dgraph_tpu.protos import task_pb2 as pb
+from dgraph_tpu.server.admission import ServerOverloaded
 from dgraph_tpu.server.api import (Alpha, NoQuorum, ReadUnavailable,
                                    StageRefused, TxnAborted)
+from dgraph_tpu.utils import deadline as dl
+from dgraph_tpu.utils import tracing
 
 SERVICE_DGRAPH = "dgraph_tpu.Dgraph"
 SERVICE_WORKER = "dgraph_tpu.Worker"
+
+# read-shaped worker RPCs whose outbound calls FORWARD the remaining
+# request budget as the gRPC timeout (the Go context-propagation
+# analog). Mutation-protocol legs (ApplyMutation/ApplyDecision) are
+# deliberately absent: once two-phase staging starts the decision
+# protocol must run to completion — a budget interrupt between stage
+# and decide would leak an undecided pend.
+_BUDGET_FORWARDED = {"ServeTask", "FetchLog", "TabletSnapshot",
+                     "ChainHead", "Query", "DebugTraces"}
+
+
+def _grpc_deadline_ms(ctx) -> float | None:
+    """Re-establish a request budget from the inbound gRPC deadline
+    (reference: the server-side context.Context carrying the caller's
+    deadline). Tolerates a missing context (tests drive handlers
+    directly)."""
+    rem = ctx.time_remaining() if ctx is not None else None
+    return None if rem is None else max(rem, 0.0) * 1e3
 
 
 class DgraphService:
@@ -55,12 +76,22 @@ class DgraphService:
         try:
             raw = self.alpha.query_raw(req.query, dict(req.vars) or None,
                                        read_ts=start_ts,
-                                       acl_user=acl_user)
+                                       acl_user=acl_user,
+                                       deadline_ms=_grpc_deadline_ms(ctx))
         except ReadUnavailable as e:
             # retryable by contract: the replica cannot verify its
             # snapshot is gap-free (partitioned) — same code the
             # reference maps unreachable-quorum reads onto
             ctx.abort(grpc.StatusCode.UNAVAILABLE, str(e))
+        except dl.DeadlineExceeded as e:
+            ctx.abort(grpc.StatusCode.DEADLINE_EXCEEDED, str(e))
+        except dl.Cancelled as e:
+            ctx.abort(grpc.StatusCode.CANCELLED, str(e))
+        except ServerOverloaded as e:
+            # RESOURCE_EXHAUSTED is gRPC's retryable overload code; the
+            # retry-after hint rides the message (HTTP carries it as a
+            # real Retry-After header)
+            ctx.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
         return pb.Response(
             json=raw,
             txn=pb.TxnContext(start_ts=start_ts or 0),
@@ -76,13 +107,20 @@ class DgraphService:
                 del_json=req.del_json or None,
                 commit_now=req.commit_now,
                 start_ts=req.start_ts or None,
-                acl_user=acl_user)
+                acl_user=acl_user,
+                deadline_ms=_grpc_deadline_ms(ctx))
         except TxnAborted as e:
             ctx.abort(grpc.StatusCode.ABORTED, str(e))
         except NoQuorum as e:
             # UNAVAILABLE, not ABORTED: the txn did not lose a conflict —
             # the replica group cannot commit right now (minority side)
             ctx.abort(grpc.StatusCode.UNAVAILABLE, str(e))
+        except dl.DeadlineExceeded as e:
+            ctx.abort(grpc.StatusCode.DEADLINE_EXCEEDED, str(e))
+        except dl.Cancelled as e:
+            ctx.abort(grpc.StatusCode.CANCELLED, str(e))
+        except ServerOverloaded as e:
+            ctx.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
         except PermissionError as e:
             ctx.abort(grpc.StatusCode.PERMISSION_DENIED, str(e))
         return pb.MutationResp(
@@ -92,12 +130,17 @@ class DgraphService:
 
     def CommitOrAbort(self, req: pb.TxnContext, ctx) -> pb.TxnContext:
         try:
-            cts = self.alpha.commit_or_abort(req.start_ts,
-                                             abort=req.aborted)
+            cts = self.alpha.commit_or_abort(
+                req.start_ts, abort=req.aborted,
+                deadline_ms=_grpc_deadline_ms(ctx))
         except TxnAborted as e:
             ctx.abort(grpc.StatusCode.ABORTED, str(e))
         except NoQuorum as e:
             ctx.abort(grpc.StatusCode.UNAVAILABLE, str(e))
+        except dl.DeadlineExceeded as e:
+            ctx.abort(grpc.StatusCode.DEADLINE_EXCEEDED, str(e))
+        except ServerOverloaded as e:
+            ctx.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
         return pb.TxnContext(start_ts=req.start_ts, commit_ts=cts,
                              aborted=req.aborted)
 
@@ -130,9 +173,21 @@ class WorkerService:
     def ServeTask(self, req: pb.TaskQuery, ctx) -> pb.TaskResult:
         # one-shot read: read_only_ts never registers a pending txn (a
         # leaked read_ts would pin the oracle gc watermark forever), and
-        # _reading keeps gc from dropping the snapshot mid-task
-        with self.alpha._reading(int(req.read_ts) or None) as ts:
-            return self._serve(req, ts)
+        # _reading keeps gc from dropping the snapshot mid-task. The
+        # caller's remaining budget (gRPC deadline) becomes THIS node's
+        # request context, so a forwarded hop keeps checkpointing —
+        # context propagation, as the reference's ctx crosses
+        # ProcessTaskOverNetwork. Server-side spans land in this peer's
+        # registry, reachable from any node's /debug/traces?peer=.
+        try:
+            with dl.activate(dl.RequestContext(_grpc_deadline_ms(ctx))):
+                with tracing.span("worker.serve_task", attr=req.attr,
+                                  frontier=len(req.frontier.uids)):
+                    with self.alpha._reading(
+                            int(req.read_ts) or None) as ts:
+                        return self._serve(req, ts)
+        except dl.DeadlineExceeded as e:
+            ctx.abort(grpc.StatusCode.DEADLINE_EXCEEDED, str(e))
 
     def _serve(self, req: pb.TaskQuery, ts: int) -> pb.TaskResult:
         store = self.alpha.mvcc.read_view(ts)
@@ -240,27 +295,47 @@ class WorkerService:
         can extract its own subset."""
         from dgraph_tpu.store.wal import mut_to_bytes, resolved_replay
         since = int(req.since_ts)
-        out = pb.LogRecords(complete=since >= self.alpha._wal_floor)
-        if self.alpha.wal is None:
-            out.complete = False
+        with tracing.span("worker.fetch_log", since_ts=since) as sp:
+            out = pb.LogRecords(complete=since >= self.alpha._wal_floor)
+            if self.alpha.wal is None:
+                out.complete = False
+                return out
+            # resolved stream: pend+dec pairs surface as committed muts
+            # or abort markers; unresolved pends never leave this node
+            for ts, kind, obj in resolved_replay(self.alpha.wal.path):
+                if ts <= since:
+                    continue
+                if kind == "mut":
+                    out.records.append(pb.LogRecord(
+                        ts=ts, mut_json=mut_to_bytes(obj)))
+                elif kind == "abort":
+                    out.records.append(pb.LogRecord(ts=ts, abort=True))
+                elif kind == "schema":
+                    out.records.append(pb.LogRecord(ts=ts, schema=obj))
+                elif kind == "drop_attr":
+                    out.records.append(pb.LogRecord(ts=ts,
+                                                    drop_attr=obj))
+                else:
+                    out.records.append(pb.LogRecord(ts=ts, drop=True))
+            sp.attrs["records"] = len(out.records)
             return out
-        # resolved stream: pend+dec pairs surface as committed muts or
-        # abort markers; unresolved pends never leave this node
-        for ts, kind, obj in resolved_replay(self.alpha.wal.path):
-            if ts <= since:
-                continue
-            if kind == "mut":
-                out.records.append(pb.LogRecord(
-                    ts=ts, mut_json=mut_to_bytes(obj)))
-            elif kind == "abort":
-                out.records.append(pb.LogRecord(ts=ts, abort=True))
-            elif kind == "schema":
-                out.records.append(pb.LogRecord(ts=ts, schema=obj))
-            elif kind == "drop_attr":
-                out.records.append(pb.LogRecord(ts=ts, drop_attr=obj))
-            else:
-                out.records.append(pb.LogRecord(ts=ts, drop=True))
-        return out
+
+    def DebugTraces(self, req: pb.Operation, ctx) -> pb.Payload:
+        """Serve this node's span registry over the worker transport so
+        the HTTP debug surface of ANY node can pull peer-leg spans
+        (/debug/traces?peer= — ROADMAP observability follow-on).
+        Reuses Operation (schema=trace_id, drop_attr=max-n) the way
+        ChainHead reuses AssignedIds — no proto regen for two strings;
+        the payload is the span-dict JSON /debug/traces already
+        serves."""
+        import json as _json
+        tid = req.schema
+        if tid:
+            spans = tracing.trace_spans(tid)
+        else:
+            spans = tracing.recent(int(req.drop_attr or 256))
+        return pb.Payload(data=_json.dumps(
+            [s.to_dict() for s in spans]).encode())
 
     def PullTablet(self, req: pb.PullTabletRequest, ctx) -> pb.Payload:
         """Pull a whole tablet from a peer and install it locally — the
@@ -288,13 +363,16 @@ class WorkerService:
         """Serve a whole-tablet snapshot as-of read_ts (reference: Badger
         Stream snapshot / tablet move source)."""
         from dgraph_tpu.cluster.tablet import pack_tablet
-        with self.alpha._reading(int(req.read_ts) or None) as ts:
-            store = self.alpha.mvcc.read_view(ts)
-            pd = store.preds.get(req.attr)
-            version = self.alpha.tablet_versions.get(req.attr, 0)
-            if pd is None:
-                return pb.TabletSnapshot(blob=b"", version=version)
-            return pb.TabletSnapshot(blob=pack_tablet(pd), version=version)
+        with tracing.span("worker.tablet_snapshot", attr=req.attr) as sp:
+            with self.alpha._reading(int(req.read_ts) or None) as ts:
+                store = self.alpha.mvcc.read_view(ts)
+                pd = store.preds.get(req.attr)
+                version = self.alpha.tablet_versions.get(req.attr, 0)
+                if pd is None:
+                    return pb.TabletSnapshot(blob=b"", version=version)
+                blob = pack_tablet(pd)
+                sp.attrs["bytes"] = len(blob)
+                return pb.TabletSnapshot(blob=blob, version=version)
 
 
 def _unary(fn, req_cls):
@@ -324,6 +402,7 @@ def make_server(alpha: Alpha, addr: str = "127.0.0.1:0",
             "ApplyMutation": _unary(w.ApplyMutation, pb.MutationMsg),
             "ApplyDecision": _unary(w.ApplyDecision, pb.DecisionMsg),
             "FetchLog": _unary(w.FetchLog, pb.FetchLogRequest),
+            "DebugTraces": _unary(w.DebugTraces, pb.Operation),
             "PullTablet": _unary(w.PullTablet, pb.PullTabletRequest),
             "TabletSnapshot": _unary(w.TabletSnapshot,
                                      pb.TabletSnapshotRequest),
@@ -344,6 +423,33 @@ class Client:
             f"/{service}/{method}",
             request_serializer=lambda m: m.SerializeToString(),
             response_deserializer=resp_cls.FromString)
+        # budget forwarding: a read-shaped leg inside an active request
+        # context carries the REMAINING budget as its gRPC timeout, so
+        # a peer never works past what the client will wait for. An
+        # expired budget refuses before the wire; a deadline that fires
+        # mid-call surfaces as DeadlineExceeded (ours), NOT RpcError —
+        # the peer is alive, OUR budget died, and callers must not
+        # mistake that for an unreachable replica.
+        if method in _BUDGET_FORWARDED:
+            ctx = dl.current()
+            if ctx is not None:
+                rem = ctx.remaining_s()
+                if rem is not None:
+                    ctx.check(f"rpc.{method}")
+                    try:
+                        return rpc(req, timeout=rem)
+                    except grpc.RpcError as e:
+                        code = (e.code() if hasattr(e, "code")
+                                else None)
+                        if code == grpc.StatusCode.DEADLINE_EXCEEDED:
+                            ctx.check(f"rpc.{method}")  # raises if dead
+                            from dgraph_tpu.utils.metrics import METRICS
+                            METRICS.inc("deadline_exceeded_total",
+                                        stage=f"rpc.{method}")
+                            raise dl.DeadlineExceeded(
+                                f"budget expired inside {method} RPC",
+                                stage=f"rpc.{method}") from e
+                        raise
         return rpc(req)
 
     def query(self, dql: str, start_ts: int = 0) -> dict:
@@ -398,6 +504,15 @@ class Client:
                    pb.DecisionMsg(commit_ts=commit_ts, commit=commit,
                                   origin=origin),
                    pb.Payload)
+
+    def debug_traces(self, trace_id: str = "", n: int = 256) -> list:
+        """Pull the peer's span registry (DebugTraces RPC): span dicts,
+        one trace's spans when trace_id is given, else the recent ring."""
+        import json as _json
+        r = self._call(SERVICE_WORKER, "DebugTraces",
+                       pb.Operation(schema=trace_id, drop_attr=str(n)),
+                       pb.Payload)
+        return _json.loads(bytes(r.data).decode())
 
     def fetch_log(self, since_ts: int):
         """Returns ([(ts, kind, obj)...], complete) mirroring wal.replay."""

@@ -147,9 +147,19 @@ class MaintenanceScheduler:
     def paused(self) -> bool:
         return not self._resume.is_set()
 
+    # longest a job yields to queued foreground traffic per tablet
+    # boundary: bounded so a permanently-saturated server still makes
+    # maintenance progress (one tablet per window) instead of starving
+    # rollups until the delta stack kills read latency anyway
+    LOAD_YIELD_MAX_S = 2.0
+
     def _pace(self) -> None:
         """Between-tablet hook handed to the streaming layer: apply the
-        configured pacing, then honor the pause gate."""
+        configured pacing, honor the pause gate, then YIELD to queued
+        foreground traffic — when the admission controller reports
+        waiters (server/admission.py `saturated()`), the job parks at
+        this tablet boundary (bounded by LOAD_YIELD_MAX_S) so overload
+        never competes with maintenance for the disk/CPU."""
         if self.pacing_ms > 0:
             time.sleep(self.pacing_ms / 1e3)
         if not self._resume.is_set():
@@ -157,6 +167,19 @@ class MaintenanceScheduler:
             t0 = time.perf_counter()
             with tracing.span("maintenance.pause", job=self._running or ""):
                 self._resume.wait()
+            METRICS.observe("maintenance_pause_wait_us",
+                            (time.perf_counter() - t0) * 1e6)
+        adm = getattr(self.alpha, "admission", None)
+        if adm is not None and adm.saturated():
+            METRICS.inc("maintenance_load_pauses_total")
+            t0 = time.perf_counter()
+            with tracing.span("maintenance.load_pause",
+                              job=self._running or ""):
+                limit = t0 + self.LOAD_YIELD_MAX_S
+                while (adm.saturated() and self._resume.is_set()
+                       and not self._stop
+                       and time.perf_counter() < limit):
+                    time.sleep(0.01)
             METRICS.observe("maintenance_pause_wait_us",
                             (time.perf_counter() - t0) * 1e6)
 
@@ -234,6 +257,13 @@ class MaintenanceScheduler:
             # a failed job backing off blocks its policy twin — spawning
             # a fresh rollup every tick would bypass the backoff
             backing_off = {j.name for j in self._queue}
+        # queued foreground traffic defers policy jobs entirely (an
+        # operator-REQUESTED job still runs — they asked): starting a
+        # rollup while the admission queue is non-empty would hand the
+        # machine to background work exactly when it's scarcest
+        adm = getattr(self.alpha, "admission", None)
+        if adm is not None and adm.saturated():
+            return None
         if not self.paused:
             return self._due_policy_job(exclude=backing_off)
         return None

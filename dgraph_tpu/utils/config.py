@@ -50,6 +50,16 @@ class AlphaConfig:
     maintenance_pacing_ms: float = 0.0  # sleep between tablets of a
                                         # maintenance job (serving gets
                                         # the disk/CPU back in between)
+    # admission control + request lifecycle (server/admission.py,
+    # utils/deadline.py):
+    max_inflight: int = 0         # per-lane concurrent-request tokens
+                                  # (0 = admission control off)
+    queue_depth: int = 16         # bounded FIFO wait queue per lane;
+                                  # full queue sheds (ServerOverloaded)
+    default_deadline_ms: float = 0.0  # budget for requests that bring
+                                      # none (0 = unbounded)
+    trace_export: str = ""        # write the span registry as
+                                  # OTLP/JSON here on shutdown
     encryption_key_file: str = ""  # at-rest AES key (reference: ee enc)
     encryption_strict: bool = False  # reject plaintext files once migrated
     slow_query_ms: int = 0        # log queries slower than this (0 = off)

@@ -1,0 +1,157 @@
+"""Request lifecycle: deadlines + cooperative cancellation.
+
+Reference parity: the reference enforces request lifecycles with Go
+`context.Context` — every `worker.Task` gRPC leg carries a deadline, and
+a query that outlives it is cancelled cooperatively at loop boundaries
+(`ctx.Err()` checks in ProcessGraph / processTask). Python has no
+ambient context, so this module provides one: a `RequestContext` with a
+MONOTONIC deadline and a thread-safe cancel flag, installed thread-local
+by the serving layer (`Alpha._request`) and consulted by `checkpoint()`
+calls in the hot loops — level expansions, BFS iterations, kernel-group
+launches, cluster RPC legs.
+
+Checkpoint granularity is one level / one BFS iteration / one RPC: a
+pathological `@recurse` or shortest-path query stops within one loop
+body of its budget instead of holding the Alpha until it finishes.
+Everything a cancelled request held (read registrations, admission
+tokens, fold gates) is released by the enclosing `with`/`finally`
+blocks it raises through — cancellation is an exception, never a
+thread kill.
+
+Budget forwarding: the remaining budget rides outbound cluster RPCs as
+the gRPC timeout (server/task.py Client._call) and is re-established on
+the receiving peer from `ServicerContext.time_remaining()` — the Go
+context propagation analog, without a proto change.
+
+Both `DeadlineExceeded` and `Cancelled` are RETRYABLE by contract: the
+server refused to spend more than the client's budget; nothing
+half-applied (the mutate path checkpoints only BEFORE the two-phase
+stage begins — interrupting between stage and decide would leak an
+undecided pend, so once staging starts the decision protocol runs to
+completion).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+
+from dgraph_tpu.utils.metrics import METRICS
+
+__all__ = ["RequestContext", "DeadlineExceeded", "Cancelled",
+           "current", "activate", "checkpoint", "remaining_s"]
+
+
+class DeadlineExceeded(Exception):
+    """RETRYABLE: the request's time budget expired mid-flight. The
+    partially-done work was discarded cleanly (no leaked read
+    registrations, pends, or admission tokens); retry with a larger
+    budget."""
+
+    def __init__(self, msg: str, stage: str = ""):
+        super().__init__(msg)
+        self.stage = stage
+
+
+class Cancelled(Exception):
+    """RETRYABLE: the client cancelled the request (connection drop,
+    explicit cancel). Same cleanup contract as DeadlineExceeded."""
+
+    def __init__(self, msg: str, stage: str = ""):
+        super().__init__(msg)
+        self.stage = stage
+
+
+class RequestContext:
+    """One request's budget: monotonic deadline + cancel flag.
+
+    `deadline_ms=None` (or 0) means unbounded — `check()` then only
+    honors the cancel flag. The cancel flag is an Event so any thread
+    (an HTTP handler noticing a closed socket, an operator endpoint)
+    can cancel a request executing elsewhere."""
+
+    __slots__ = ("started", "deadline", "_cancel")
+
+    def __init__(self, deadline_ms: float | None = None):
+        self.started = time.monotonic()
+        self.deadline = (self.started + deadline_ms / 1e3
+                         if deadline_ms else None)
+        self._cancel = threading.Event()
+
+    def cancel(self) -> None:
+        self._cancel.set()
+
+    @property
+    def cancelled(self) -> bool:
+        return self._cancel.is_set()
+
+    def remaining_s(self) -> float | None:
+        """Seconds of budget left (None = unbounded; ≤ 0 = expired).
+        This is what outbound RPC legs forward to peers."""
+        if self.deadline is None:
+            return None
+        return self.deadline - time.monotonic()
+
+    def remaining_ms(self) -> float | None:
+        r = self.remaining_s()
+        return None if r is None else r * 1e3
+
+    def expired(self) -> bool:
+        return (self.deadline is not None
+                and time.monotonic() >= self.deadline)
+
+    def check(self, stage: str = "") -> None:
+        """Raise (retryably) if the budget is gone — the cooperative
+        cancellation point. Metrics label the STAGE that noticed, so an
+        overrunning workload names its hot loop."""
+        if self._cancel.is_set():
+            METRICS.inc("request_cancelled_total", stage=stage)
+            raise Cancelled(f"request cancelled at stage "
+                            f"{stage or 'unknown'}", stage=stage)
+        if self.deadline is not None:
+            now = time.monotonic()
+            if now >= self.deadline:
+                METRICS.inc("deadline_exceeded_total", stage=stage)
+                raise DeadlineExceeded(
+                    f"deadline exceeded at stage {stage or 'unknown'} "
+                    f"({(now - self.started) * 1e3:.1f} ms elapsed, "
+                    f"budget "
+                    f"{(self.deadline - self.started) * 1e3:.1f} ms); "
+                    f"retry with a larger deadline", stage=stage)
+
+
+_TLS = threading.local()
+
+
+def current() -> RequestContext | None:
+    """The thread's active RequestContext (None outside any request)."""
+    return getattr(_TLS, "ctx", None)
+
+
+@contextlib.contextmanager
+def activate(ctx: RequestContext):
+    """Install `ctx` as the thread's ambient request context."""
+    prev = getattr(_TLS, "ctx", None)
+    _TLS.ctx = ctx
+    try:
+        yield ctx
+    finally:
+        _TLS.ctx = prev
+
+
+def checkpoint(stage: str = "") -> None:
+    """Cooperative cancellation point for hot loops: one thread-local
+    load + None check when no request context is active (the
+    observability-overhead bar applies here too — tier-1 guards the
+    uncontended path at <5%)."""
+    ctx = getattr(_TLS, "ctx", None)
+    if ctx is not None:
+        ctx.check(stage)
+
+
+def remaining_s() -> float | None:
+    """Remaining budget of the ambient context (None = unbounded or no
+    context) — what transports forward to peers."""
+    ctx = getattr(_TLS, "ctx", None)
+    return None if ctx is None else ctx.remaining_s()

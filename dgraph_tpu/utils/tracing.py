@@ -192,6 +192,117 @@ def _jsonable(v):
     return str(v)
 
 
+# -- OTLP/JSON export (ROADMAP: span export to an external collector) --------
+
+def _otlp_any(v) -> dict:
+    """Python value → OTLP AnyValue (the typed union OTLP mandates)."""
+    if isinstance(v, bool):
+        return {"boolValue": v}
+    if isinstance(v, int):
+        return {"intValue": str(v)}  # OTLP/JSON carries int64 as string
+    if isinstance(v, float):
+        return {"doubleValue": v}
+    return {"stringValue": v if isinstance(v, str) else str(v)}
+
+
+def _from_otlp_any(d: dict):
+    if "boolValue" in d:
+        return bool(d["boolValue"])
+    if "intValue" in d:
+        return int(d["intValue"])
+    if "doubleValue" in d:
+        return float(d["doubleValue"])
+    return d.get("stringValue", "")
+
+
+def _otlp_trace_id(tid: str) -> str:
+    """Our 16-hex trace ids → the 32-hex (16-byte) ids OTLP requires.
+    Left-padded with zeros; non-hex ids (tests pass arbitrary strings)
+    fall back to a hex encoding of the string bytes."""
+    if not tid:
+        return "0" * 32
+    try:
+        return f"{int(tid, 16):032x}"
+    except ValueError:
+        return tid.encode().hex()[:32].ljust(32, "0")
+
+
+def to_otlp(spans: list[Span]) -> dict:
+    """OTLP/JSON (`ExportTraceServiceRequest` shape) — POSTable to any
+    collector's `/v1/traces` as-is. Span ids hex-encode to the 8-byte
+    spanId field; nanosecond timestamps derive from start_us + dur_us;
+    attrs become typed keyValue pairs. The raw registry identifiers
+    also ride as `dgraph.*` attributes so `from_otlp` round-trips
+    losslessly (the round-trip test pins this)."""
+    out = []
+    for s in spans:
+        attrs = [{"key": k, "value": _otlp_any(_jsonable(v))}
+                 for k, v in s.attrs.items()]
+        attrs.append({"key": "dgraph.trace_id",
+                      "value": {"stringValue": s.trace_id}})
+        attrs.append({"key": "dgraph.tid",
+                      "value": {"intValue": str(s.tid)}})
+        out.append({
+            "traceId": _otlp_trace_id(s.trace_id),
+            "spanId": f"{s.span_id:016x}",
+            "parentSpanId": (f"{s.parent_id:016x}" if s.parent_id
+                             else ""),
+            "name": s.name,
+            "kind": 1,  # SPAN_KIND_INTERNAL
+            "startTimeUnixNano": str(s.start_us * 1000),
+            "endTimeUnixNano": str((s.start_us + s.dur_us) * 1000),
+            "attributes": attrs,
+        })
+    return {"resourceSpans": [{
+        "resource": {"attributes": [
+            {"key": "service.name",
+             "value": {"stringValue": "dgraph_tpu"}}]},
+        "scopeSpans": [{"scope": {"name": "dgraph_tpu"},
+                        "spans": out}],
+    }]}
+
+
+def from_otlp(doc: dict) -> list[Span]:
+    """Inverse of `to_otlp` (the round-trip contract): rebuild Span
+    objects from an OTLP/JSON document."""
+    spans = []
+    for rs in doc.get("resourceSpans", ()):
+        for ss in rs.get("scopeSpans", ()):
+            for o in ss.get("spans", ()):
+                attrs, tid, os_tid = {}, "", 0
+                for kv in o.get("attributes", ()):
+                    v = _from_otlp_any(kv.get("value", {}))
+                    if kv["key"] == "dgraph.trace_id":
+                        tid = v
+                    elif kv["key"] == "dgraph.tid":
+                        os_tid = int(v)
+                    else:
+                        attrs[kv["key"]] = v
+                start_us = int(o["startTimeUnixNano"]) // 1000
+                spans.append(Span(
+                    name=o["name"],
+                    span_id=int(o["spanId"], 16),
+                    parent_id=(int(o["parentSpanId"], 16)
+                               if o.get("parentSpanId") else 0),
+                    trace_id=tid,
+                    start_us=start_us,
+                    dur_us=int(o["endTimeUnixNano"]) // 1000 - start_us,
+                    tid=os_tid, attrs=attrs))
+    return spans
+
+
+def export_otlp(path: str, spans: list[Span] | None = None) -> int:
+    """Write the span registry (default: the full ring buffer) as
+    OTLP/JSON to `path` — the `--trace_export` flag's shutdown hook and
+    an offline bridge to collectors. Returns the span count."""
+    import json
+    if spans is None:
+        spans = recent(len(_BUF))
+    with open(path, "w") as f:
+        json.dump(to_otlp(spans), f)
+    return len(spans)
+
+
 def clear() -> None:
     with _LOCK:
         _BUF.clear()

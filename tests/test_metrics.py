@@ -227,3 +227,33 @@ def test_label_free_series_never_guarded():
     r.inc("plain_total", 5.0)
     assert r.get("plain_total") == 5.0
     assert "plain_total" in r.snapshot()["counters"]
+
+
+# ---------------------------------------------------------------------------
+# doc lint: silent metric drift fails the build
+
+def test_every_emitted_metric_name_is_documented():
+    """Every metric NAME the source emits through the registry must
+    appear (backticked) in README's observability table — a new
+    counter nobody documented is invisible to operators until an
+    incident. Scans every `METRICS.inc/observe/set_gauge("name"...)`
+    literal under dgraph_tpu/ and bench.py plus the registry's own
+    DROPPED_SERIES constant."""
+    import pathlib
+
+    from dgraph_tpu.utils.metrics import DROPPED_SERIES
+
+    root = pathlib.Path(__file__).resolve().parents[1]
+    call = re.compile(
+        r'METRICS\.(?:inc|observe|set_gauge)\(\s*"([a-z][a-z0-9_]*)"')
+    names = {DROPPED_SERIES}
+    sources = list((root / "dgraph_tpu").rglob("*.py"))
+    sources.append(root / "bench.py")
+    for p in sources:
+        names |= set(call.findall(p.read_text()))
+    assert len(names) > 30, "metric scan went blind — check the regex"
+    readme = (root / "README.md").read_text()
+    missing = sorted(n for n in names if f"`{n}" not in readme)
+    assert not missing, (
+        f"metric name(s) emitted but undocumented in README's "
+        f"observability table: {missing}")

@@ -29,6 +29,7 @@ from dgraph_tpu.cluster.fault import FaultSchedule, FaultyGroups
 from dgraph_tpu.cluster.oracle import TxnAborted
 from dgraph_tpu.cluster.zero import ZeroClient, ZeroState, make_zero_server
 from dgraph_tpu.server.api import NoQuorum, ReadUnavailable
+from dgraph_tpu.utils.deadline import DeadlineExceeded
 from dgraph_tpu.utils.metrics import METRICS
 
 
@@ -301,6 +302,124 @@ def test_wal_truncation_race_heals_via_fetchlog(bank_trio):
     assert sum(accts.values()) == N_ACCT * PER
     # the heal is visible: the restarted node pulled its missing tail
     assert _counter_sum("fetchlog_heals_total") > heals_before
+
+
+def _converge(nodes, tag, rounds=2):
+    """Convergence nudges: each node's chained broadcast resolves its
+    stale pends on peers and carries prev_ts for gap detection. Two
+    rounds so every pend whose ORIGIN nudged last also resolves."""
+    for r in range(rounds):
+        for a, _s in nodes:
+            a.mutate(set_nquads=f'_:h <name> "heal-{tag}-{r}" .')
+
+
+def test_read_cancelled_mid_fetchlog_heal_retries_cleanly(bank_trio):
+    """ISSUE-4 satellite: a read whose budget dies while the read gate
+    is healing a replication gap (chain probe + FetchLog pull, both
+    artificially slow) must raise retryable DeadlineExceeded — counted
+    in deadline_exceeded_total — leak NO pend, and a full-budget retry
+    must heal and serve the correct balances."""
+    nodes, addrs, uids = bank_trio
+    rng = random.Random(777)
+    # open a replication gap on node1: node0 commits while its link to
+    # node1 is down (majority node0+node2 still commits)
+    nodes[0][0].groups.drop_link(addrs[1])
+    committed = sum(_transfer(nodes[0][0], uids, rng) == "committed"
+                    for _ in range(6))
+    assert committed >= 1
+    nodes[0][0].groups.heal_link(addrs[1])
+    # node1's heal legs are now slow: a 40 ms budget dies mid-heal
+    g1 = nodes[1][0].groups
+    g1.delay_link(addrs[0], 0.15)
+    g1.delay_link(addrs[2], 0.15)
+    dl0 = _counter_sum("deadline_exceeded_total")
+    pends_before = [len(a._pending) for a, _s in nodes]
+    with pytest.raises(DeadlineExceeded):
+        nodes[1][0].query('{ q(func: has(balance)) { balance } }',
+                          deadline_ms=40)
+    assert _counter_sum("deadline_exceeded_total") > dl0
+    # the interrupted heal left no pend behind (pend-count invariant:
+    # an aborted READ can never grow the staged set)
+    assert [len(a._pending) for a, _s in nodes] == pends_before
+    g1.heal_all()
+    # full-budget retry heals via FetchLog and serves every acked commit
+    out = nodes[1][0].query('{ q(func: has(balance), orderasc: name) '
+                            '{ name balance } }')
+    accts = {r["name"]: r["balance"] for r in out["q"]
+             if r["name"].startswith("acct")}
+    assert sum(accts.values()) == N_ACCT * PER
+    _converge(nodes, "dlread")
+    for k, (a, _s) in enumerate(nodes):
+        assert not a._pending, (
+            f"node {k} leaked pends {sorted(a._pending)} after a "
+            f"cancelled read + heal")
+
+
+def test_deadline_fault_fuzz_schedule(bank_trio):
+    """Seeded schedules from the deadline-extended space: tight-budget
+    reads fire under live link faults (a heal mid-FetchLog gets
+    cancelled), and per seed the harness asserts the lifecycle
+    contract — cancelled reads raise retryably and are metric-visible,
+    the bank invariant holds, replicas converge, and NO pend leaks
+    (DGRAPH_TPU_FUZZ_SEED replays one seed exactly)."""
+    nodes, addrs, uids = bank_trio
+    env_seed = os.environ.get("DGRAPH_TPU_FUZZ_SEED")
+    # base chosen so every default seed's schedule contains ≥1
+    # deadline event (the extended slice is probabilistic)
+    seeds = [int(env_seed)] if env_seed else [51002 + i for i in range(3)]
+    for seed in seeds:
+        sched = FaultSchedule(seed, len(nodes), deadline=True)
+        assert any(op == "deadline" for op, *_ in sched.events) or \
+            env_seed, f"seed {seed} generated no deadline events"
+        rng = random.Random(seed ^ 0x9E3779B9)
+        dl0 = _counter_sum("deadline_exceeded_total")
+        raised = [0]
+
+        def deadline_cb(src, budget_s):
+            a = nodes[src][0]
+            try:
+                a.query('{ q(func: has(balance)) { name balance } }',
+                        deadline_ms=budget_s * 1e3)
+            except DeadlineExceeded:
+                raised[0] += 1
+            except (ReadUnavailable, NoQuorum):
+                pass  # the partition said no first — also retryable
+
+        groups = [a.groups for a, _s in nodes]
+        try:
+            for ev in sched.events:
+                sched.apply_event(ev, groups, addrs,
+                                  deadline_cb=deadline_cb)
+                for _ in range(2):
+                    k = rng.randrange(len(nodes))
+                    res = _transfer(nodes[k][0], uids, rng)
+                    if sched.isolated(k):
+                        assert res == "refused", (
+                            f"seed {seed}: isolated node {k} answered "
+                            f"{res!r}")
+        finally:
+            sched.heal_all(groups)
+        _converge(nodes, f"dl-{seed}")
+        views = [_balances(a, uids) for a, _s in nodes]
+        for k, v in enumerate(views[1:], 1):
+            assert v == views[0], (
+                f"seed {seed}: replica {k} diverged after heal "
+                f"(replay with DGRAPH_TPU_FUZZ_SEED={seed}): "
+                f"{v} != {views[0]}")
+        accts = {n: b for n, b in views[0].items()
+                 if n.startswith("acct")}
+        assert sum(accts.values()) == N_ACCT * PER, (
+            f"seed {seed}: money leaked")
+        # pend-count invariant: cancelled reads never leave a staged
+        # record behind; post-heal convergence resolves every pend
+        for k, (a, _s) in enumerate(nodes):
+            assert not a._pending, (
+                f"seed {seed}: node {k} leaked pends "
+                f"{sorted(a._pending)} (replay with "
+                f"DGRAPH_TPU_FUZZ_SEED={seed})")
+        # every cancellation the workload observed is metric-visible
+        assert _counter_sum("deadline_exceeded_total") - dl0 \
+            >= raised[0]
 
 
 def test_wal_truncation_fuzz_schedule(bank_trio):

@@ -106,6 +106,59 @@ def test_trace_context_groups_spans_and_exports_chrome_json():
     assert child["args"]["k"] == "v"
 
 
+def test_otlp_export_round_trips(tmp_path):
+    """OTLP/JSON export (ROADMAP: span export to an external collector):
+    the document carries the OTLP shape a collector's /v1/traces
+    accepts — resourceSpans/scopeSpans, 32-hex traceId, 16-hex spanId,
+    nanosecond timestamps, typed attributes — and `from_otlp` restores
+    the exact Span objects (identity, nesting, timing, attrs)."""
+    with tracing.trace("request") as tid:
+        with tracing.span("child", k="v", n=3, ratio=1.5, flag=True):
+            pass
+    spans = tracing.trace_spans(tid)
+    doc = json.loads(json.dumps(tracing.to_otlp(spans)))  # JSON-clean
+
+    rs = doc["resourceSpans"][0]
+    svc = rs["resource"]["attributes"][0]
+    assert svc["key"] == "service.name"
+    otlp_spans = rs["scopeSpans"][0]["spans"][0:]
+    assert len(otlp_spans) == 2
+    for o in otlp_spans:
+        assert len(o["traceId"]) == 32
+        assert len(o["spanId"]) == 16
+        assert int(o["endTimeUnixNano"]) >= int(o["startTimeUnixNano"])
+    child = next(o for o in otlp_spans if o["name"] == "child")
+    root = next(o for o in otlp_spans if o["name"] == "request")
+    assert child["parentSpanId"] == root["spanId"]
+    attrs = {a["key"]: a["value"] for a in child["attributes"]}
+    assert attrs["k"] == {"stringValue": "v"}
+    assert attrs["n"] == {"intValue": "3"}          # int64 as string
+    assert attrs["ratio"] == {"doubleValue": 1.5}
+    assert attrs["flag"] == {"boolValue": True}
+
+    back = tracing.from_otlp(doc)
+    assert [s.to_dict() for s in back] == [s.to_dict() for s in spans]
+
+    # file form (--trace_export's shutdown hook)
+    p = tmp_path / "spans.otlp.json"
+    n = tracing.export_otlp(str(p), spans)
+    assert n == 2
+    again = tracing.from_otlp(json.loads(p.read_text()))
+    assert [s.to_dict() for s in again] == [s.to_dict() for s in spans]
+
+
+def test_otlp_handles_non_hex_trace_ids():
+    """trace() accepts arbitrary trace_id strings (tests do) — export
+    must not crash on them and the raw id still round-trips via the
+    dgraph.trace_id attribute."""
+    with tracing.trace("t", trace_id="not-hex!"):
+        pass
+    spans = tracing.trace_spans("not-hex!")
+    doc = tracing.to_otlp(spans)
+    back = tracing.from_otlp(doc)
+    assert [s.trace_id for s in back] == ["not-hex!"] * len(spans)
+
+
 def test_disabled_tracing_records_nothing():
     tracing.set_enabled(False)
     with tracing.span("ghost") as sp:
