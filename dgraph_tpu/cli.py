@@ -16,6 +16,46 @@ from dgraph_tpu import __version__
 from dgraph_tpu.utils import logging as xlog
 from dgraph_tpu.utils.config import AlphaConfig, load_config
 
+# consecutive heartbeat failures before the loop escalates from a
+# debug-level note to an ERROR log: a dead Zero link must be VISIBLE
+# (a silent heartbeat failure eventually gets this alpha marked dead
+# by Zero's liveness sweep with no local trace of why)
+HEARTBEAT_ERROR_AFTER = 3
+
+
+def run_heartbeat_loop(kind: str, interval_s: float, step, log,
+                       stop=None) -> None:
+    """Drive one heartbeat `step()` every `interval_s`, surviving
+    failures — but never silently: every failure counts
+    `heartbeat_failures_total{kind=}`, and `HEARTBEAT_ERROR_AFTER`
+    consecutive failures escalate to an error-level log (once per
+    outage, re-armed by the next success). `stop` (threading.Event)
+    ends the loop — tests drive it; the CLI never sets it."""
+    import threading
+
+    from dgraph_tpu.utils.metrics import METRICS
+    stop = stop or threading.Event()
+    fails = 0
+    while not stop.wait(interval_s):
+        try:
+            step()
+            if fails >= HEARTBEAT_ERROR_AFTER:
+                log.info("%s heartbeat recovered after %d failures",
+                         kind, fails)
+            fails = 0
+        except Exception:  # noqa: BLE001 — the loop must outlive faults
+            fails += 1
+            METRICS.inc("heartbeat_failures_total", kind=kind)
+            if fails == HEARTBEAT_ERROR_AFTER:
+                log.error(
+                    "%s heartbeat failed %d times in a row — the zero "
+                    "link is likely dead (this node will be marked "
+                    "dead by zero's liveness sweep if this persists)",
+                    kind, fails, exc_info=True)
+            else:
+                log.debug("%s heartbeat failed (%d consecutive)",
+                          kind, fails, exc_info=True)
+
 
 def cmd_alpha(args) -> int:
     from dgraph_tpu.server.api import Alpha
@@ -36,7 +76,10 @@ def cmd_alpha(args) -> int:
         "maintenance_pacing_ms": args.maintenance_pacing_ms,
         "max_inflight": args.max_inflight,
         "queue_depth": args.queue_depth,
-        "default_deadline_ms": args.default_deadline_ms}
+        "default_deadline_ms": args.default_deadline_ms,
+        "rpc_retries": args.rpc_retries,
+        "breaker_threshold": args.breaker_threshold,
+        "breaker_cooldown_ms": args.breaker_cooldown_ms}
     if args.store:
         # grouped superflag (reference: z.SuperFlag, e.g.
         # --badger "compression=zstd; numgoroutines=8")
@@ -133,7 +176,10 @@ def cmd_alpha(args) -> int:
         alpha.groups = Groups(
             zero, f"{cfg.http_addr}:{grpc_port}", group=args.group,
             max_ts=max(alpha.mvcc.base_ts, replayed_ts),
-            max_uid=replayed_uid)
+            max_uid=replayed_uid,
+            breaker_threshold=cfg.breaker_threshold,
+            breaker_cooldown_ms=cfg.breaker_cooldown_ms,
+            rpc_retries=cfg.rpc_retries)
         log.info("joined cluster: node=%d group=%d",
                  alpha.groups.node_id, alpha.groups.gid)
         # rejoin catch-up: pull any WAL tail we missed while down, then
@@ -142,38 +188,28 @@ def cmd_alpha(args) -> int:
         if alpha.groups.other_addrs():
             alpha.resync_on_join()
 
-        def size_heartbeat():
-            # feed Zero's rebalance loop (reference: tablet-size report
-            # in the membership heartbeat)
-            import time as _time
-            while True:
-                _time.sleep(30.0)
-                try:
-                    alpha.report_tablet_sizes()
-                except Exception:  # noqa: BLE001 — heartbeat must survive
-                    log.debug("tablet size report failed", exc_info=True)
-
-        def liveness_heartbeat():
+        def liveness_step():
             # liveness ping + applied watermarks (reference: membership
             # heartbeat; the watermarks seed a promoted standby's lease
             # floor). Survives a zero failover via the client's
-            # multi-target rotation.
-            import time as _time
-            while True:
-                _time.sleep(args.heartbeat)
-                try:
-                    ts = max(alpha.mvcc.base_ts,
-                             max((l.commit_ts for l in alpha.mvcc.layers),
-                                 default=0))
-                    zero.heartbeat(alpha.groups.node_id,
-                                   group=alpha.groups.gid, max_ts=ts,
-                                   max_uid=alpha.mvcc.max_uid_seen)
-                except Exception:  # noqa: BLE001 — heartbeat must survive
-                    log.debug("zero heartbeat failed", exc_info=True)
+            # multi-target rotation + breaker-ordered dead marking.
+            ts = max(alpha.mvcc.base_ts,
+                     max((l.commit_ts for l in alpha.mvcc.layers),
+                         default=0))
+            zero.heartbeat(alpha.groups.node_id,
+                           group=alpha.groups.gid, max_ts=ts,
+                           max_uid=alpha.mvcc.max_uid_seen)
 
         import threading
-        threading.Thread(target=size_heartbeat, daemon=True).start()
-        threading.Thread(target=liveness_heartbeat, daemon=True).start()
+        # feed Zero's rebalance loop (reference: tablet-size report in
+        # the membership heartbeat); failures are metered + escalated
+        # by run_heartbeat_loop instead of dying silently at debug
+        threading.Thread(target=run_heartbeat_loop, daemon=True,
+                         args=("size", 30.0,
+                               alpha.report_tablet_sizes, log)).start()
+        threading.Thread(target=run_heartbeat_loop, daemon=True,
+                         args=("liveness", args.heartbeat,
+                               liveness_step, log)).start()
     # background maintenance: rollup-when-deep + periodic checkpoint +
     # admin-triggered backup/export, paced and budget-bounded
     # (store/maintenance.py; reference: Badger's background rollups,
@@ -451,6 +487,18 @@ def main(argv=None) -> int:
     p.add_argument("--default_deadline_ms", type=float, default=None,
                    help="budget for requests that carry no ?timeout=/"
                         "X-Deadline-Ms of their own (0 = unbounded)")
+    p.add_argument("--rpc_retries", type=int, default=None,
+                   help="re-attempts per retryable cluster RPC "
+                        "(UNAVAILABLE/connect failures only; backoff "
+                        "jittered + capped by the request budget)")
+    p.add_argument("--breaker_threshold", type=int, default=None,
+                   help="consecutive transport failures that open a "
+                        "peer's circuit breaker (then calls fail fast "
+                        "until a half-open probe succeeds)")
+    p.add_argument("--breaker_cooldown_ms", type=float, default=None,
+                   help="open-breaker cool-down before the single "
+                        "half-open probe (jittered; doubles per "
+                        "re-open, capped)")
     p.add_argument("--log_level", default="info")
     p.set_defaults(fn=cmd_alpha)
 

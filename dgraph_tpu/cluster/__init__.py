@@ -1,14 +1,19 @@
 
 def start_cluster_alpha(zero_target: str, base=None, group: int = 0,
                         device_threshold: int = 512,
-                        addr: str = "127.0.0.1:0", wal_dir: str | None = None):
+                        addr: str = "127.0.0.1:0",
+                        wal_dir: str | None = None,
+                        breaker_threshold: int = 5,
+                        breaker_cooldown_ms: float = 500.0,
+                        rpc_retries: int = 2):
     """Boot one cluster-mode Alpha: grpc server + Zero connect + Groups.
 
     Returns (alpha, grpc_server, bound_addr). Reference: alpha run() —
     serve pb.Worker, Connect to Zero for node id + group assignment, then
     keep membership fresh (SURVEY §3.4). `wal_dir` arms the fsync'd WAL —
     required for commit-quorum staging to be durable (reference: the
-    raft WAL under every Alpha)."""
+    raft WAL under every Alpha). The breaker/retry knobs parameterize
+    the node's resilience layer (cluster/resilience.py)."""
     from dgraph_tpu.cluster.groups import Groups
     from dgraph_tpu.cluster.zero import RemoteOracle, ZeroClient
     from dgraph_tpu.server.api import Alpha
@@ -34,5 +39,8 @@ def start_cluster_alpha(zero_target: str, base=None, group: int = 0,
     if base is not None and base.n_nodes:
         max_uid = max(max_uid, int(base.uids[-1]))
     alpha.groups = Groups(zero, bound, group=group, max_ts=max_ts,
-                          max_uid=max_uid)
+                          max_uid=max_uid,
+                          breaker_threshold=breaker_threshold,
+                          breaker_cooldown_ms=breaker_cooldown_ms,
+                          rpc_retries=rpc_retries)
     return alpha, server, bound

@@ -7,11 +7,13 @@ here. `FaultyGroups` wraps a node's `Groups` so individual DIRECTED links
 (A hears B while B cannot reach A) become one-line test setup, which
 server stops can never simulate.
 
-Injection point: `pool(addr)` — every outbound RPC of the wrapped node
-goes through it (broadcasts, decisions, FetchLog catch-up, ServeTask
-routing, read failover), so a blocked link fails exactly like an
-unreachable peer (grpc UNAVAILABLE), and a delayed link stalls like a
-congested one."""
+Injection point: the pooled client's `fault_check` hook — it fires
+before EVERY wire attempt of every outbound RPC of the wrapped node
+(broadcasts, decisions, FetchLog catch-up, ServeTask routing, read
+failover), INSIDE the resilience layer's retry loop
+(cluster/resilience.py), so a blocked link fails exactly like an
+unreachable peer (grpc UNAVAILABLE) — retried, breaker-counted — and a
+delayed link stalls like a congested one."""
 
 from __future__ import annotations
 
@@ -34,37 +36,33 @@ class LinkDown(grpc.RpcError):
         return self._msg
 
 
-class _FaultyClient:
-    """Per-call guard in front of a pooled worker client."""
-
-    def __init__(self, inner, groups: "FaultyGroups", addr: str):
-        self._inner = inner
-        self._groups = groups
-        self._addr = addr
-
-    def __getattr__(self, name):
-        attr = getattr(self._inner, name)
-        if not callable(attr):
-            return attr
-
-        def guarded(*a, **kw):
-            self._groups.check_link(self._addr)
-            return attr(*a, **kw)
-
-        return guarded
-
-
 class FaultyGroups:
     """Transparent `Groups` wrapper with per-directed-link drop/delay.
 
     Wraps an EXISTING Groups (attribute delegation keeps membership,
-    node id, tablet routing intact); only `pool()` is intercepted.
+    node id, tablet routing intact); only `pool()` is intercepted: the
+    pooled client's `fault_check` hook fires before EVERY wire attempt
+    (server/task.py Client._attempt), INSIDE the resilience layer's
+    retry loop — so an injected LinkDown exercises the same
+    retry/breaker machinery a real connect failure does.
     """
 
     def __init__(self, inner):
         self._inner = inner
         self._dropped: set[str] = set()       # peer addrs this node can't reach
         self._delay_s: dict[str, float] = {}  # peer addr → injected latency
+        # instrument the INNER pool too: methods reached through
+        # attribute delegation (call_group's read failover) bind the
+        # inner Groups as self, so only hooking FaultyGroups.pool would
+        # leave those legs fault-free
+        inner_pool = inner.pool
+
+        def hooked_pool(addr):
+            c = inner_pool(addr)
+            c.fault_check = lambda: self.check_link(addr)
+            return c
+
+        inner.pool = hooked_pool
 
     # -- fault control -------------------------------------------------------
     def drop_link(self, addr: str) -> None:
@@ -76,6 +74,12 @@ class FaultyGroups:
         self._delay_s.pop(addr, None)  # a healed link runs at full speed
         # the real pool may hold a channel poisoned by earlier failures
         self._inner.invalidate(addr)
+        # a circuit breaker opened by the injected fault would refuse
+        # the healed link until its cool-down expires — a heal restores
+        # full connectivity, exactly like a peer restart does
+        res = getattr(self._inner, "resilience", None)
+        if res is not None:
+            res.reset(addr)
 
     def heal_all(self) -> None:
         for a in list(self._dropped):
@@ -94,8 +98,7 @@ class FaultyGroups:
 
     # -- Groups surface ------------------------------------------------------
     def pool(self, addr: str):
-        self.check_link(addr)  # fail fast even before the first call
-        return _FaultyClient(self._inner.pool(addr), self, addr)
+        return self._inner.pool(addr)  # hooked in __init__
 
     def __getattr__(self, name):
         return getattr(self._inner, name)
@@ -131,41 +134,65 @@ class FaultSchedule:
     `deadline_cb(src, budget_s)` and asserts the lifecycle contract:
     the cancelled read raised retryably, leaked no pend, and a retry
     with a full budget serves or refuses CLEANLY. Also off by default
-    (same seed-stability rule); with both flags on, the extended slice
-    splits between them."""
+    (same seed-stability rule); armed extended families split the
+    extended slice equally, in a fixed order, so a given (flags, seed)
+    pair always regenerates identically — and historical seeds replay
+    byte-for-byte when the newer flags are off.
+
+    `crash=True` adds WHOLE-NODE CRASH faults: a `crash` event kills
+    node `src` outright — it refuses all RPCs in both directions and
+    loses every bit of volatile state (tablet caches, chain positions,
+    staged-pend bookkeeping) — and a later `restart` event for the same
+    node rebuilds it from its durable WAL/checkpoint (the torn-tail
+    restart machinery), after which it must catch up via
+    FetchLog/tablet_snapshot and serve again. The harness performs both
+    through `crash_cb(src, up)`; crash events count
+    `peer_crashes_total`. Generation pairs them: a crash on an
+    already-down node regenerates as its restart."""
 
     def __init__(self, seed: int, n_nodes: int, steps: int = 8,
                  max_delay_s: float = 0.03, wal_trunc: bool = False,
-                 deadline: bool = False):
+                 deadline: bool = False, crash: bool = False):
         import random
         self.seed = seed
         self.n_nodes = n_nodes
         self.dropped: set[tuple[int, int]] = set()
+        self.crashed: set[int] = set()  # nodes currently down (apply-time)
         rng = random.Random(seed)
         links = [(i, j) for i in range(n_nodes) for j in range(n_nodes)
                  if i != j]
         self.events: list[tuple[str, int, int, float]] = []
+        families = [f for f, on in (("wal_trunc", wal_trunc),
+                                    ("deadline", deadline),
+                                    ("crash", crash)) if on]
+        gen_down: set[int] = set()  # crash/restart pairing at generation
         for _ in range(steps):
             src, dst = rng.choice(links)
             r = rng.random()
             extended = None
-            if r >= 0.85:
-                # the extended slice: split between whichever extended
-                # fault families are armed (order fixed so a given
-                # (flags, seed) pair always regenerates identically)
-                if wal_trunc and deadline:
-                    extended = "wal_trunc" if r < 0.925 else "deadline"
-                elif wal_trunc:
-                    extended = "wal_trunc"
-                elif deadline:
-                    extended = "deadline"
+            if r >= 0.85 and families:
+                # the extended slice splits equally between the armed
+                # families, in the fixed order above (a given
+                # (flags, seed) pair always regenerates identically;
+                # with only the historical flags armed the cut points
+                # match the historical schedule exactly)
+                idx = int((r - 0.85) / (0.15 / len(families)))
+                extended = families[min(idx, len(families) - 1)]
             if extended == "wal_trunc":
                 # a crash-restart with a torn tail; dst/seconds unused
                 self.events.append(("wal_trunc", src, dst, 0.0))
+                gen_down.discard(src)  # the restart brings it back
             elif extended == "deadline":
                 # a read on src with this budget, under the live faults
                 self.events.append(("deadline", src, dst,
                                     round(rng.uniform(0.001, 0.05), 4)))
+            elif extended == "crash":
+                if src in gen_down:
+                    self.events.append(("restart", src, dst, 0.0))
+                    gen_down.discard(src)
+                else:
+                    self.events.append(("crash", src, dst, 0.0))
+                    gen_down.add(src)
             elif r < 0.40:
                 self.events.append(("drop", src, dst, 0.0))
             elif r < 0.70:
@@ -181,12 +208,15 @@ class FaultSchedule:
 
     def apply_event(self, ev: tuple[str, int, int, float],
                     faulty_groups, addrs, wal_trunc_cb=None,
-                    deadline_cb=None) -> None:
+                    deadline_cb=None, crash_cb=None) -> None:
         """Apply one event; `faulty_groups[i]` is node i's FaultyGroups
         wrapper, `addrs[i]` its address. `wal_trunc_cb(src)` performs a
         crash-restart-with-torn-tail of node src; `deadline_cb(src,
-        budget_s)` runs the harness's tight-budget read on node src
-        (either is skipped when the harness passes None)."""
+        budget_s)` runs the harness's tight-budget read on node src;
+        `crash_cb(src, up)` kills (up=False) or rebuilds-from-WAL
+        (up=True) node src (any callback is skipped when the harness
+        passes None)."""
+        from dgraph_tpu.utils.metrics import METRICS
         op, src, dst, secs = ev
         if op == "deadline":
             if deadline_cb is not None:
@@ -198,7 +228,23 @@ class FaultSchedule:
                 faulty_groups[src].heal_all()
                 self.dropped = {(s, d) for s, d in self.dropped
                                 if s != src}
+                self.crashed.discard(src)
                 wal_trunc_cb(src)
+            return
+        if op == "crash":
+            if crash_cb is not None and src not in self.crashed:
+                self.crashed.add(src)
+                METRICS.inc("peer_crashes_total")
+                crash_cb(src, False)
+            return
+        if op == "restart":
+            if crash_cb is not None and src in self.crashed:
+                # the restarted node's links come back clean
+                faulty_groups[src].heal_all()
+                self.dropped = {(s, d) for s, d in self.dropped
+                                if s != src}
+                self.crashed.discard(src)
+                crash_cb(src, True)
             return
         fg = faulty_groups[src]
         if op == "drop":
@@ -210,14 +256,21 @@ class FaultSchedule:
         else:
             fg.delay_link(addrs[dst], secs)
 
-    def heal_all(self, faulty_groups) -> None:
+    def heal_all(self, faulty_groups, crash_cb=None) -> None:
         for fg in faulty_groups:
             fg.heal_all()
         self.dropped.clear()
+        # crashed nodes restart as part of the global heal (the harness
+        # passes the same crash_cb apply_event used)
+        if crash_cb is not None:
+            for src in sorted(self.crashed):
+                crash_cb(src, True)
+            self.crashed.clear()
 
     def isolated(self, i: int) -> bool:
         """True when node i currently reaches NO peer: its commits must
         refuse with NoQuorum and its reads with ReadUnavailable (the
-        minority side of the partition)."""
-        return all((i, j) in self.dropped
+        minority side of the partition). A live node whose every peer
+        CRASHED is just as alone as one whose links all dropped."""
+        return all((i, j) in self.dropped or j in self.crashed
                    for j in range(self.n_nodes) if j != i)

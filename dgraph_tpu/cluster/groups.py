@@ -13,17 +13,28 @@ import threading
 
 import grpc
 
+from dgraph_tpu.cluster.resilience import PeerTable
 from dgraph_tpu.cluster.zero import ZeroClient
+from dgraph_tpu.utils.metrics import METRICS
 
 
 class Groups:
     def __init__(self, zero: ZeroClient, my_addr: str, group: int = 0,
-                 max_ts: int = 0, max_uid: int = 0):
+                 max_ts: int = 0, max_uid: int = 0,
+                 breaker_threshold: int = 5,
+                 breaker_cooldown_ms: float = 500.0,
+                 rpc_retries: int = 2):
         self.zero = zero
         self.my_addr = my_addr
         self.node_id, self.gid = zero.connect(my_addr, group,
                                               max_ts=max_ts,
                                               max_uid=max_uid)
+        # this node's view of every peer it dials: circuit breakers +
+        # retry policy shared by all pooled clients (--breaker_threshold,
+        # --breaker_cooldown_ms, --rpc_retries)
+        self.resilience = PeerTable(threshold=breaker_threshold,
+                                    cooldown_ms=breaker_cooldown_ms,
+                                    retries=rpc_retries)
         self._lock = threading.Lock()
         self._pools: dict[str, object] = {}
         self._tablets: dict[str, int] = {}
@@ -103,12 +114,15 @@ class Groups:
 
     # -- conn pooling ---------------------------------------------------------
     def pool(self, addr: str):
-        """Cached worker client per peer address (conn/pool.go)."""
+        """Cached worker client per peer address (conn/pool.go). Every
+        pooled client shares this node's PeerTable, so its calls run
+        under the per-peer breaker + retry policy."""
         from dgraph_tpu.server.task import Client
         with self._lock:
             c = self._pools.get(addr)
             if c is None:
-                c = self._pools[addr] = Client(addr)
+                c = self._pools[addr] = Client(
+                    addr, resilience=self.resilience, peer_addr=addr)
             return c
 
     def invalidate(self, addr: str) -> None:
@@ -124,22 +138,36 @@ class Groups:
             except Exception:  # noqa: BLE001 — already broken
                 pass
 
-    def call_group(self, gid: int, fn, exclude=()):
+    def call_group(self, gid: int, fn, exclude=(), rpc: str = ""):
         """Run `fn(client)` against any live node of a group, trying
         replicas in order — read failover (reference: reads served by any
         replica; pool pick + retry). `exclude` skips peers known to be
-        lagging (suspects from a failed broadcast); if every replica is
-        excluded they are retried anyway — a possibly-stale answer beats
-        none."""
+        lagging (suspects from a failed broadcast); peers whose circuit
+        breaker is OPEN are tried last (they fail instantly, but a
+        possibly-stale or known-dead answer beats none — when every
+        replica is exhausted the caller's refusal, ReadUnavailable,
+        stands). A call served by anyone but the preferred replica
+        counts `failover_total{rpc=}`."""
         last = None
         addrs = self.group_addrs(gid)
-        ordered = ([a for a in addrs if a not in exclude]
+        fresh = [a for a in addrs if a not in exclude]
+        ordered = ([a for a in fresh if self.resilience.available(a)]
+                   + [a for a in fresh
+                      if not self.resilience.available(a)]
                    + [a for a in addrs if a in exclude])
+        # the historical preference is the first non-excluded replica:
+        # serving from anyone else — because the preferred breaker is
+        # open OR its attempt failed — is a failover
+        preferred = fresh[0] if fresh else (ordered[0] if ordered
+                                            else None)
         for addr in ordered:
             try:
-                return fn(self.pool(addr))
+                out = fn(self.pool(addr))
             except grpc.RpcError as e:
                 last = e
                 continue
+            if addr != preferred and rpc:
+                METRICS.inc("failover_total", rpc=rpc)
+            return out
         raise last if last is not None else RuntimeError(
             f"group {gid} has no nodes")

@@ -17,8 +17,11 @@ reads at older timestamps fetch an as-of snapshot without caching.
 
 from __future__ import annotations
 
+import grpc
+
 from dgraph_tpu.store.store import Store
 from dgraph_tpu.utils import deadline
+from dgraph_tpu.utils.metrics import METRICS
 
 
 class _RoutedPreds(dict):
@@ -33,7 +36,20 @@ class _RoutedPreds(dict):
         # budget gate before faulting a whole foreign tablet over the
         # wire (the remaining budget rides the RPC as its gRPC timeout)
         deadline.checkpoint("tablet_fault")
-        pd = self.alpha._fetch_tablet(pred, self.read_ts)
+        try:
+            pd = self.alpha._fetch_tablet(pred, self.read_ts)
+        except grpc.RpcError as e:
+            # EVERY replica of the owning group was exhausted (failover
+            # + breaker + retries all refused): the refusal contract is
+            # ReadUnavailable — retryable, never a raw transport error
+            # leaking through the engine to the client
+            from dgraph_tpu.server.api import ReadUnavailable
+            METRICS.inc("read_unavailable_total",
+                        reason="replicas_exhausted")
+            raise ReadUnavailable(
+                f"tablet {pred!r}: every replica of its owning group "
+                f"is unreachable ({e.code() if hasattr(e, 'code') else e}"
+                f"); retry") from e
         if pd is not None:
             super().__setitem__(pred, pd)
         return pd

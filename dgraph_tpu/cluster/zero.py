@@ -16,6 +16,7 @@ streamed — same information, simpler transport.
 from __future__ import annotations
 
 import threading
+import time
 from concurrent import futures
 
 import grpc
@@ -849,12 +850,25 @@ class ZeroClient:
     holds). `target` may be a comma-separated failover list
     ("primary:5080,standby:5081"): connectivity errors and standby
     refusals rotate to the next address; semantic errors (txn aborts)
-    propagate."""
+    propagate.
+
+    Dead-target marking reuses the cluster breaker signals
+    (cluster/resilience.py): each zero target carries per-peer breaker
+    state, and the rotation starts at targets whose breaker is NOT
+    open — an alpha stops paying the full dial timeout to a dead
+    primary on every lease call once the breaker has seen it down.
+    Every target is still tried when all breakers are open (leases
+    must never be refused outright on client-side suspicion alone)."""
 
     def __init__(self, target: str):
+        from dgraph_tpu.cluster.resilience import PeerTable
         self.targets = [t.strip() for t in target.split(",") if t.strip()]
         self._chans: dict[str, grpc.Channel] = {}
         self._cur = 0
+        # retries=0: the target LIST is the retry policy here — the
+        # breaker only orders/skips known-dead zeros during cool-down
+        self.health = PeerTable(threshold=2, cooldown_ms=1000.0,
+                                retries=0)
 
     @property
     def channel(self) -> grpc.Channel:
@@ -866,15 +880,32 @@ class ZeroClient:
 
     def _call(self, method: str, req, resp_cls):
         last_err = None
-        for attempt in range(len(self.targets)):
+        # rotation order: current-first, but known-dead targets
+        # (breaker open inside cool-down) sink to the back
+        order = [(self._cur + i) % len(self.targets)
+                 for i in range(len(self.targets))]
+        if len(self.targets) > 1:
+            order = ([i for i in order
+                      if self.health.available(self.targets[i])]
+                     + [i for i in order
+                        if not self.health.available(self.targets[i])])
+        for idx in order:
+            self._cur = idx
+            target = self.targets[idx]
             rpc = self.channel.unary_unary(
                 f"/{SERVICE_ZERO}/{method}",
                 request_serializer=lambda m: m.SerializeToString(),
                 response_deserializer=resp_cls.FromString)
+            t0 = time.monotonic()
             try:
-                return rpc(req)
+                out = rpc(req)
             except grpc.RpcError as e:
                 code = e.code()
+                if code == grpc.StatusCode.UNAVAILABLE:
+                    # connectivity: breaker signal for dead-marking
+                    self.health.on_failure(target, e)
+                else:
+                    self.health.on_success(target, None)
                 if (code == grpc.StatusCode.ABORTED
                         or code == grpc.StatusCode.INVALID_ARGUMENT
                         or code == grpc.StatusCode.RESOURCE_EXHAUSTED
@@ -886,7 +917,9 @@ class ZeroClient:
                     raise
                 # connectivity / standby refusal: try the next zero
                 last_err = e
-                self._cur = (self._cur + 1) % len(self.targets)
+                continue
+            self.health.on_success(target, time.monotonic() - t0)
+            return out
         raise last_err
 
     def connect(self, addr: str, group: int = 0, max_ts: int = 0,

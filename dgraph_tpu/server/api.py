@@ -573,6 +573,10 @@ class Alpha:
                     healed = True
                 except _grpc.RpcError:
                     continue
+            if healed:
+                # the unreachable origin's tail was served by a
+                # DIFFERENT replica — the fetch_log failover leg
+                METRICS.inc("failover_total", rpc="fetch_log")
             if not healed:
                 METRICS.inc("read_unavailable_total",
                             reason="heal_failed")
@@ -1616,7 +1620,8 @@ class Alpha:
             t0 = time.perf_counter()
             blob, got_version = self.groups.call_group(
                 gid, lambda c: c.tablet_snapshot(pred, read_ts),
-                exclude=set(self._suspect_peers))
+                exclude=set(self._suspect_peers),
+                rpc="tablet_snapshot")
             METRICS.observe("rpc_latency_us",
                             (time.perf_counter() - t0) * 1e6,
                             rpc="tablet_snapshot")
@@ -1668,14 +1673,24 @@ class Alpha:
             return None
         uids = view.uid_of(np.asarray(frontier, np.int32)).astype(
             np.uint64)
+        import grpc as _grpc
         with tracing.span("rpc.serve_task", pred=pred,
                           frontier=int(len(uids))):
             t0 = time.perf_counter()
-            res = self.groups.call_group(
-                gid, lambda c: c.serve_task(
-                    attr=pred, reverse=reverse,
-                    frontier={"uids": uids.tolist()}, read_ts=read_ts),
-                exclude=set(self._suspect_peers))
+            try:
+                res = self.groups.call_group(
+                    gid, lambda c: c.serve_task(
+                        attr=pred, reverse=reverse,
+                        frontier={"uids": uids.tolist()},
+                        read_ts=read_ts),
+                    exclude=set(self._suspect_peers),
+                    rpc="serve_task")
+            except _grpc.RpcError:
+                # every replica of the owning group refused the per-hop
+                # leg: fall back to the whole-tablet pull (its own
+                # failover path; exhausted there → ReadUnavailable)
+                # instead of failing the query on a routing shortcut
+                return None
             METRICS.observe("rpc_latency_us",
                             (time.perf_counter() - t0) * 1e6,
                             rpc="serve_task")

@@ -9,7 +9,10 @@ reference serves 8080 (HTTP) beside 9080 (gRPC).
 
 from __future__ import annotations
 
+import contextlib
 import json
+import select
+import socket
 import threading
 import time
 import urllib.parse
@@ -19,10 +22,32 @@ from dgraph_tpu.dql.upsert import is_upsert as _is_upsert
 from dgraph_tpu.server.admission import ServerOverloaded
 from dgraph_tpu.server.api import (Alpha, NoQuorum, ReadUnavailable,
                                    TxnAborted)
+from dgraph_tpu.utils import deadline as dl
 from dgraph_tpu.utils import logging as xlog
 from dgraph_tpu.utils import tracing
 from dgraph_tpu.utils.deadline import Cancelled, DeadlineExceeded
 from dgraph_tpu.utils.metrics import METRICS
+
+# how often the per-request watcher peeks the client socket for a
+# mid-request disconnect (an abandoned request must release its
+# admission token early instead of computing into the void)
+DISCONNECT_POLL_S = 0.05
+
+
+def _socket_closed(conn) -> bool:
+    """Has the client closed its end? A zero-byte MSG_PEEK read on a
+    readable socket means EOF; pending request bytes (pipelining) mean
+    it is alive. Never consumes data, never blocks."""
+    try:
+        r, _w, _x = select.select([conn], [], [], 0)
+        if not r:
+            return False
+        flags = socket.MSG_PEEK | getattr(socket, "MSG_DONTWAIT", 0)
+        return conn.recv(1, flags) == b""
+    except (BlockingIOError, InterruptedError):
+        return False
+    except OSError:
+        return True  # the socket object itself is dead
 
 
 def _parse_timeout_ms(val: str) -> float:
@@ -81,6 +106,34 @@ def make_http_server(alpha: Alpha, addr: str = "127.0.0.1",
         def _body(self) -> bytes:
             n = int(self.headers.get("Content-Length") or 0)
             return self.rfile.read(n)
+
+        @contextlib.contextmanager
+        def _disconnect_watch(self):
+            """Cancel this request's context when the client hangs up
+            mid-flight (ROADMAP PR-4 follow-on: the cancel flag was
+            wired; this is the socket watcher). The handler thread's
+            ACTIVE context is looked up per poll — the context is
+            created later, inside Alpha._request, on that thread."""
+            stop = threading.Event()
+            ident = threading.get_ident()
+            conn = self.connection
+
+            def watch():
+                while not stop.wait(DISCONNECT_POLL_S):
+                    if _socket_closed(conn):
+                        ctx = dl.of_thread(ident)
+                        if ctx is not None and not ctx.cancelled:
+                            METRICS.inc("request_cancelled_total",
+                                        stage="disconnect")
+                            ctx.cancel()
+                        return
+
+            t = threading.Thread(target=watch, daemon=True)
+            t.start()
+            try:
+                yield
+            finally:
+                stop.set()
 
         def do_GET(self):
             if self.path == "/health":
@@ -159,6 +212,21 @@ def make_http_server(alpha: Alpha, addr: str = "127.0.0.1",
                 else:
                     self._send(200, {"enabled": True,
                                      **alpha.admission.status()})
+            elif self.path.startswith("/debug/peers"):
+                # per-peer resilience state: breaker state, EMA
+                # latency, consecutive failures, last error — the
+                # operator's answer to "which replica is dying on us"
+                # (cluster/resilience.py PeerTable.snapshot)
+                if alpha.groups is None:
+                    self._send(200, {"enabled": False})
+                else:
+                    res = getattr(alpha.groups, "resilience", None)
+                    doc = {"enabled": res is not None,
+                           "peers": res.snapshot() if res else {}}
+                    zh = getattr(alpha.groups.zero, "health", None)
+                    if zh is not None:
+                        doc["zero"] = zh.snapshot()
+                    self._send(200, doc)
             elif self.path.startswith("/admin/maintenance"):
                 # scheduler status: running/queued jobs, pause state,
                 # policy knobs (reference: /admin health of background
@@ -268,153 +336,8 @@ def make_http_server(alpha: Alpha, addr: str = "127.0.0.1",
         def do_POST(self):
             t0 = time.perf_counter()
             try:
-                if self.path.startswith("/login"):
-                    req = json.loads(self._body().decode())
-                    if alpha.acl is None:
-                        self._send(400, {"errors": [
-                            {"message": "ACL is not enabled"}]})
-                        return
-                    token = alpha.acl.login(req.get("userid", ""),
-                                            req.get("password", ""))
-                    self._send(200, {"data": {"accessJWT": token}})
-                    return
-                acl_user = self._acl_user()
-                deadline_ms = self._deadline_ms()
-                if self.path.startswith("/query/batch"):
-                    req = json.loads(self._body().decode())
-                    with tracing.trace("http.query_batch",
-                                       queries=len(req["queries"])) as tid:
-                        outs = alpha.query_batch(req["queries"],
-                                                 acl_user=acl_user,
-                                                 deadline_ms=deadline_ms)
-                    us = int((time.perf_counter() - t0) * 1e6)
-                    METRICS.observe("query_latency_us", us,
-                                    endpoint="query_batch")
-                    self._slow_query_check(us, tid,
-                                           f"<batch of "
-                                           f"{len(req['queries'])}>")
-                    self._send(200, {"data": outs,
-                                     "extensions": {"trace_id": tid}})
-                elif self.path.startswith("/query"):
-                    body = self._body().decode()
-                    if "application/json" in (
-                            self.headers.get("Content-Type") or ""):
-                        req = json.loads(body)
-                        q, variables = req["query"], req.get("variables")
-                    else:
-                        q, variables = body, None
-                    with tracing.trace("http.query") as tid:
-                        raw = alpha.query_raw(q, variables,
-                                              acl_user=acl_user,
-                                              deadline_ms=deadline_ms)
-                    us = int((time.perf_counter() - t0) * 1e6)
-                    METRICS.observe("query_latency_us", us,
-                                    endpoint="query")
-                    self._slow_query_check(us, tid, q)
-                    # splice the emitter's bytes into the envelope — the
-                    # response body is never re-parsed server-side
-                    self._send_bytes(200, b'{"data":' + raw +
-                                     b',"extensions":{"server_latency":'
-                                     b'{"total_us":%d},"trace_id":"%s"}}'
-                                     % (us, tid.encode()))
-                elif self.path.startswith("/mutate"):
-                    ctype = self.headers.get("Content-Type") or ""
-                    body = self._body().decode()
-                    qs = self.path.partition("?")[2]
-                    start_ts = None
-                    for part in qs.split("&"):
-                        if part.startswith("startTs="):
-                            start_ts = int(part.split("=", 1)[1])
-                    commit_now = "commitNow=true" in qs or \
-                        (self.headers.get("X-Dgraph-CommitNow") == "true")
-                    if "application/json" in ctype:
-                        req = json.loads(body)
-                        if req.get("query"):
-                            # upsert: set/delete may be JSON mutation
-                            # lists (upsert_json) or RDF strings (the
-                            # block form, via Alpha.upsert)
-                            cn = commit_now or req.get("commitNow", False)
-                            if any(isinstance(req.get(k), str)
-                                   for k in ("set", "delete")):
-                                parts = [
-                                    "%s { %s }" % (k if k != "delete"
-                                                   else "delete", req[k])
-                                    for k in ("set", "delete")
-                                    if isinstance(req.get(k), str)]
-                                src = ("upsert { query %s mutation %s "
-                                       "{ %s } }"
-                                       % (req["query"],
-                                          req.get("cond", ""),
-                                          "\n".join(parts)))
-                                res = alpha.upsert(
-                                    src, commit_now=cn,
-                                    start_ts=start_ts,
-                                    acl_user=acl_user,
-                                    deadline_ms=deadline_ms)
-                            else:
-                                res = alpha.upsert_json(
-                                    req["query"], req.get("cond", ""),
-                                    set_json=req.get("set"),
-                                    del_json=req.get("delete"),
-                                    commit_now=cn, start_ts=start_ts,
-                                    acl_user=acl_user,
-                                    deadline_ms=deadline_ms)
-                        else:
-                            res = alpha.mutate(
-                                set_json=req.get("set"),
-                                del_json=req.get("delete"),
-                                commit_now=(commit_now or
-                                            req.get("commitNow", False)),
-                                start_ts=start_ts, acl_user=acl_user,
-                                deadline_ms=deadline_ms)
-                    elif _is_upsert(body):
-                        res = alpha.upsert(body, commit_now=commit_now,
-                                           start_ts=start_ts,
-                                           acl_user=acl_user,
-                                           deadline_ms=deadline_ms)
-                    else:
-                        res = alpha.mutate(set_nquads=body,
-                                           commit_now=commit_now,
-                                           start_ts=start_ts,
-                                           acl_user=acl_user,
-                                           deadline_ms=deadline_ms)
-                    self._send(200, {"data": res})
-                elif self.path.startswith("/commit"):
-                    qs = self.path.partition("?")[2]
-                    start_ts = abort = None
-                    for part in qs.split("&"):
-                        if part.startswith("startTs="):
-                            start_ts = int(part.split("=", 1)[1])
-                        if part.startswith("abort="):
-                            abort = part.split("=", 1)[1] == "true"
-                    if start_ts is None:
-                        self._send(400, {"errors": [
-                            {"message": "startTs required"}]})
-                        return
-                    cts = alpha.commit_or_abort(start_ts,
-                                                abort=bool(abort),
-                                                deadline_ms=deadline_ms)
-                    self._send(200, {"data": {
-                        "code": "Success", "commit_ts": cts}})
-                elif self.path.startswith("/admin/"):
-                    self._admin(acl_user)
-                elif self.path.startswith("/alter"):
-                    if alpha.acl is not None:
-                        alpha.acl.check_alter(acl_user)
-                    body = self._body().decode()
-                    if body.strip().startswith("{"):
-                        op = json.loads(body)
-                        if op.get("drop_all"):
-                            alpha.drop_all()
-                        elif op.get("drop_attr"):
-                            alpha.drop_attr(op["drop_attr"])
-                        else:
-                            alpha.alter(op.get("schema", ""))
-                    else:
-                        alpha.alter(body)
-                    self._send(200, {"data": {"code": "Success"}})
-                else:
-                    self._send(404, {"errors": [{"message": "not found"}]})
+                with self._disconnect_watch():
+                    self._dispatch_post(t0)
             except TxnAborted as e:
                 self._send(409, {"errors": [{"message": str(e),
                                              "code": "Aborted"}]})
@@ -441,9 +364,13 @@ def make_http_server(alpha: Alpha, addr: str = "127.0.0.1",
                                              "stage": e.stage}]})
             except Cancelled as e:
                 # 499 (client-closed-request convention): the client
-                # cancelled; nothing to retry unless it wants to
-                self._send(499, {"errors": [{"message": str(e),
-                                             "code": "Cancelled"}]})
+                # cancelled; nothing to retry unless it wants to. On a
+                # DISCONNECT cancel the socket is gone — the write
+                # fails quietly; the point was releasing the request's
+                # admission token and compute early.
+                with contextlib.suppress(OSError):
+                    self._send(499, {"errors": [{"message": str(e),
+                                                 "code": "Cancelled"}]})
             except (NoQuorum, ReadUnavailable) as e:
                 # RETRYABLE partition refusals, not client errors: the
                 # minority side refuses writes (NoQuorum) and refuses
@@ -458,6 +385,157 @@ def make_http_server(alpha: Alpha, addr: str = "127.0.0.1",
                 # reference does: 200-with-errors JSON is api-breaking,
                 # use 400 + errors list
                 self._send(400, {"errors": [{"message": str(e)}]})
+
+        def _dispatch_post(self, t0):
+            """POST endpoint dispatch; raised errors map to
+            HTTP codes in do_POST's handler chain."""
+            if self.path.startswith("/login"):
+                req = json.loads(self._body().decode())
+                if alpha.acl is None:
+                    self._send(400, {"errors": [
+                        {"message": "ACL is not enabled"}]})
+                    return
+                token = alpha.acl.login(req.get("userid", ""),
+                                        req.get("password", ""))
+                self._send(200, {"data": {"accessJWT": token}})
+                return
+            acl_user = self._acl_user()
+            deadline_ms = self._deadline_ms()
+            if self.path.startswith("/query/batch"):
+                req = json.loads(self._body().decode())
+                with tracing.trace("http.query_batch",
+                                   queries=len(req["queries"])) as tid:
+                    outs = alpha.query_batch(req["queries"],
+                                             acl_user=acl_user,
+                                             deadline_ms=deadline_ms)
+                us = int((time.perf_counter() - t0) * 1e6)
+                METRICS.observe("query_latency_us", us,
+                                endpoint="query_batch")
+                self._slow_query_check(us, tid,
+                                       f"<batch of "
+                                       f"{len(req['queries'])}>")
+                self._send(200, {"data": outs,
+                                 "extensions": {"trace_id": tid}})
+            elif self.path.startswith("/query"):
+                body = self._body().decode()
+                if "application/json" in (
+                        self.headers.get("Content-Type") or ""):
+                    req = json.loads(body)
+                    q, variables = req["query"], req.get("variables")
+                else:
+                    q, variables = body, None
+                with tracing.trace("http.query") as tid:
+                    raw = alpha.query_raw(q, variables,
+                                          acl_user=acl_user,
+                                          deadline_ms=deadline_ms)
+                us = int((time.perf_counter() - t0) * 1e6)
+                METRICS.observe("query_latency_us", us,
+                                endpoint="query")
+                self._slow_query_check(us, tid, q)
+                # splice the emitter's bytes into the envelope — the
+                # response body is never re-parsed server-side
+                self._send_bytes(200, b'{"data":' + raw +
+                                 b',"extensions":{"server_latency":'
+                                 b'{"total_us":%d},"trace_id":"%s"}}'
+                                 % (us, tid.encode()))
+            elif self.path.startswith("/mutate"):
+                ctype = self.headers.get("Content-Type") or ""
+                body = self._body().decode()
+                qs = self.path.partition("?")[2]
+                start_ts = None
+                for part in qs.split("&"):
+                    if part.startswith("startTs="):
+                        start_ts = int(part.split("=", 1)[1])
+                commit_now = "commitNow=true" in qs or \
+                    (self.headers.get("X-Dgraph-CommitNow") == "true")
+                if "application/json" in ctype:
+                    req = json.loads(body)
+                    if req.get("query"):
+                        # upsert: set/delete may be JSON mutation
+                        # lists (upsert_json) or RDF strings (the
+                        # block form, via Alpha.upsert)
+                        cn = commit_now or req.get("commitNow", False)
+                        if any(isinstance(req.get(k), str)
+                               for k in ("set", "delete")):
+                            parts = [
+                                "%s { %s }" % (k if k != "delete"
+                                               else "delete", req[k])
+                                for k in ("set", "delete")
+                                if isinstance(req.get(k), str)]
+                            src = ("upsert { query %s mutation %s "
+                                   "{ %s } }"
+                                   % (req["query"],
+                                      req.get("cond", ""),
+                                      "\n".join(parts)))
+                            res = alpha.upsert(
+                                src, commit_now=cn,
+                                start_ts=start_ts,
+                                acl_user=acl_user,
+                                deadline_ms=deadline_ms)
+                        else:
+                            res = alpha.upsert_json(
+                                req["query"], req.get("cond", ""),
+                                set_json=req.get("set"),
+                                del_json=req.get("delete"),
+                                commit_now=cn, start_ts=start_ts,
+                                acl_user=acl_user,
+                                deadline_ms=deadline_ms)
+                    else:
+                        res = alpha.mutate(
+                            set_json=req.get("set"),
+                            del_json=req.get("delete"),
+                            commit_now=(commit_now or
+                                        req.get("commitNow", False)),
+                            start_ts=start_ts, acl_user=acl_user,
+                            deadline_ms=deadline_ms)
+                elif _is_upsert(body):
+                    res = alpha.upsert(body, commit_now=commit_now,
+                                       start_ts=start_ts,
+                                       acl_user=acl_user,
+                                       deadline_ms=deadline_ms)
+                else:
+                    res = alpha.mutate(set_nquads=body,
+                                       commit_now=commit_now,
+                                       start_ts=start_ts,
+                                       acl_user=acl_user,
+                                       deadline_ms=deadline_ms)
+                self._send(200, {"data": res})
+            elif self.path.startswith("/commit"):
+                qs = self.path.partition("?")[2]
+                start_ts = abort = None
+                for part in qs.split("&"):
+                    if part.startswith("startTs="):
+                        start_ts = int(part.split("=", 1)[1])
+                    if part.startswith("abort="):
+                        abort = part.split("=", 1)[1] == "true"
+                if start_ts is None:
+                    self._send(400, {"errors": [
+                        {"message": "startTs required"}]})
+                    return
+                cts = alpha.commit_or_abort(start_ts,
+                                            abort=bool(abort),
+                                            deadline_ms=deadline_ms)
+                self._send(200, {"data": {
+                    "code": "Success", "commit_ts": cts}})
+            elif self.path.startswith("/admin/"):
+                self._admin(acl_user)
+            elif self.path.startswith("/alter"):
+                if alpha.acl is not None:
+                    alpha.acl.check_alter(acl_user)
+                body = self._body().decode()
+                if body.strip().startswith("{"):
+                    op = json.loads(body)
+                    if op.get("drop_all"):
+                        alpha.drop_all()
+                    elif op.get("drop_attr"):
+                        alpha.drop_attr(op["drop_attr"])
+                    else:
+                        alpha.alter(op.get("schema", ""))
+                else:
+                    alpha.alter(body)
+                self._send(200, {"data": {"code": "Success"}})
+            else:
+                self._send(404, {"errors": [{"message": "not found"}]})
 
     srv = ThreadingHTTPServer((addr, port), Handler)
     port = srv.server_address[1]

@@ -39,6 +39,15 @@ SERVICE_WORKER = "dgraph_tpu.Worker"
 _BUDGET_FORWARDED = {"ServeTask", "FetchLog", "TabletSnapshot",
                      "ChainHead", "Query", "DebugTraces"}
 
+# worker RPCs the resilience layer may RE-ATTEMPT on a transport
+# failure (cluster/resilience.py). Every receive path is idempotent —
+# re-staging/re-applying a ts the peer already logged is a no-op — so
+# the whole worker surface is safe to retry; the retry policy itself
+# refuses non-transport failures (DEADLINE_EXCEEDED, app errors).
+_RETRYABLE_RPCS = {"ServeTask", "Ping", "ChainHead", "ApplyMutation",
+                   "ApplyDecision", "FetchLog", "DebugTraces",
+                   "PullTablet", "TabletSnapshot"}
+
 
 def _grpc_deadline_ms(ctx) -> float | None:
     """Re-establish a request budget from the inbound gRPC deadline
@@ -413,23 +422,48 @@ def make_server(alpha: Alpha, addr: str = "127.0.0.1:0",
 
 
 class Client:
-    """Minimal client over the same generic method paths (dgo analog)."""
+    """Minimal client over the same generic method paths (dgo analog).
 
-    def __init__(self, target: str):
+    Pooled cluster clients (cluster/groups.py) carry a shared
+    `resilience` PeerTable: every call then runs under that node's
+    per-peer circuit breaker + budget-aware retry policy
+    (cluster/resilience.py). Ad-hoc clients (tests, debug proxies,
+    PullTablet's one-shot source dial) keep the historical
+    single-attempt behavior. `fault_check` is the fault-injection
+    hook (cluster/fault.py) — invoked before EVERY wire attempt so an
+    injected LinkDown exercises the same retry/breaker path a real
+    connect failure does."""
+
+    def __init__(self, target: str, resilience=None,
+                 peer_addr: str | None = None):
         self.channel = grpc.insecure_channel(target)
+        self.resilience = resilience
+        self.peer_addr = peer_addr or target
+        self.fault_check = None
 
     def _call(self, service: str, method: str, req, resp_cls):
         rpc = self.channel.unary_unary(
             f"/{service}/{method}",
             request_serializer=lambda m: m.SerializeToString(),
             response_deserializer=resp_cls.FromString)
-        # budget forwarding: a read-shaped leg inside an active request
-        # context carries the REMAINING budget as its gRPC timeout, so
-        # a peer never works past what the client will wait for. An
-        # expired budget refuses before the wire; a deadline that fires
-        # mid-call surfaces as DeadlineExceeded (ours), NOT RpcError —
-        # the peer is alive, OUR budget died, and callers must not
-        # mistake that for an unreachable replica.
+        if self.resilience is not None:
+            return self.resilience.call(
+                self.peer_addr, method,
+                lambda: self._attempt(rpc, method, req),
+                retryable=method in _RETRYABLE_RPCS)
+        return self._attempt(rpc, method, req)
+
+    def _attempt(self, rpc, method: str, req):
+        """One wire attempt, with fault injection and budget
+        forwarding: a read-shaped leg inside an active request context
+        carries the REMAINING budget as its gRPC timeout, so a peer
+        never works past what the client will wait for. An expired
+        budget refuses before the wire; a deadline that fires mid-call
+        surfaces as DeadlineExceeded (ours), NOT RpcError — the peer
+        is alive, OUR budget died, and callers (and the retry policy)
+        must not mistake that for an unreachable replica."""
+        if self.fault_check is not None:
+            self.fault_check()
         if method in _BUDGET_FORWARDED:
             ctx = dl.current()
             if ctx is not None:

@@ -58,6 +58,15 @@ class AlphaConfig:
                                   # full queue sheds (ServerOverloaded)
     default_deadline_ms: float = 0.0  # budget for requests that bring
                                       # none (0 = unbounded)
+    # peer-failure resilience (cluster/resilience.py):
+    rpc_retries: int = 2          # re-attempts per retryable cluster RPC
+                                  # (transport failures only; backoff is
+                                  # capped by the request budget)
+    breaker_threshold: int = 5    # consecutive transport failures that
+                                  # open a peer's circuit breaker
+    breaker_cooldown_ms: float = 500.0  # open-breaker cool-down before
+                                        # the half-open probe (jittered,
+                                        # doubling per re-open)
     trace_export: str = ""        # write the span registry as
                                   # OTLP/JSON here on shutdown
     encryption_key_file: str = ""  # at-rest AES key (reference: ee enc)

@@ -122,6 +122,11 @@ class RequestContext:
 
 
 _TLS = threading.local()
+# thread ident → active context, for CROSS-thread cancellation (an HTTP
+# connection watcher noticing a closed socket must cancel the request
+# context its HANDLER thread will create/has created). Plain dict: a
+# single store+pop per request, CPython-atomic.
+_ACTIVE: dict[int, RequestContext] = {}
 
 
 def current() -> RequestContext | None:
@@ -129,15 +134,28 @@ def current() -> RequestContext | None:
     return getattr(_TLS, "ctx", None)
 
 
+def of_thread(ident: int) -> RequestContext | None:
+    """The ACTIVE RequestContext of another thread (None when that
+    thread is not inside a request) — the cross-thread cancellation
+    handle; `ctx.cancel()` is thread-safe."""
+    return _ACTIVE.get(ident)
+
+
 @contextlib.contextmanager
 def activate(ctx: RequestContext):
     """Install `ctx` as the thread's ambient request context."""
     prev = getattr(_TLS, "ctx", None)
+    ident = threading.get_ident()
     _TLS.ctx = ctx
+    _ACTIVE[ident] = ctx
     try:
         yield ctx
     finally:
         _TLS.ctx = prev
+        if prev is None:
+            _ACTIVE.pop(ident, None)
+        else:
+            _ACTIVE[ident] = prev
 
 
 def checkpoint(stage: str = "") -> None:

@@ -290,9 +290,14 @@ def test_replica_catchup_after_missed_broadcasts():
     zserver, zport, state = make_zero_server(ZeroState(replicas=3))
     zserver.start()
     ztarget = f"127.0.0.1:{zport}"
-    r1, sr1, addr1 = start_cluster_alpha(ztarget, device_threshold=10**9)
-    r2, sr2, addr2 = start_cluster_alpha(ztarget, device_threshold=10**9)
-    r3, sr3, addr3 = start_cluster_alpha(ztarget, device_threshold=10**9)
+    # snappy breaker: r1's breaker to the dead r2 opens during the
+    # missed broadcasts; after r2 returns the half-open probe (past the
+    # short cool-down) re-admits it on the healing broadcast below
+    kw = dict(device_threshold=10**9, breaker_cooldown_ms=50.0,
+              rpc_retries=1)
+    r1, sr1, addr1 = start_cluster_alpha(ztarget, **kw)
+    r2, sr2, addr2 = start_cluster_alpha(ztarget, **kw)
+    r3, sr3, addr3 = start_cluster_alpha(ztarget, **kw)
     assert r1.groups.gid == r2.groups.gid == r3.groups.gid
     for r in (r1, r2, r3):
         r.allow_volatile_stage = True  # explicit test-only opt-in
@@ -313,15 +318,22 @@ def test_replica_catchup_after_missed_broadcasts():
     for i in range(4):
         r1.mutate(set_nquads=f'_:m{i} <name> "m{i}" .')
     assert addr2 in r1._suspect_peers  # excluded from read failover
+    # the repeated transport failures opened r1's breaker to r2
+    assert r1.groups.resilience.state(addr2) == "open"
 
     # r2 comes back (new server object, same Alpha state = restart with
-    # its old disk state); the next chained broadcast from r1 carries
-    # prev_ts > what r2 last saw -> r2 pulls the gap before applying
+    # its old disk state); past the breaker cool-down, the next chained
+    # broadcast from r1 runs as the half-open probe, succeeds (closing
+    # the breaker), and carries prev_ts > what r2 last saw -> r2 pulls
+    # the gap before applying
     from dgraph_tpu.server.task import make_server
     sr2b, port2b = make_server(r2, addr2)
     sr2b.start()
+    import time
+    time.sleep(0.15)  # past the jittered 50 ms cool-down
     r1.mutate(set_nquads='_:z <name> "zoe" .')
     assert addr2 not in r1._suspect_peers  # ack implies converged
+    assert r1.groups.resilience.state(addr2) == "closed"
 
     want = sorted(["alice", "m0", "m1", "m2", "m3", "zoe"])
     for a in (r1, r2):
@@ -401,9 +413,13 @@ def test_missed_alter_recovered_via_chain():
     zserver, zport, state = make_zero_server(ZeroState(replicas=3))
     zserver.start()
     ztarget = f"127.0.0.1:{zport}"
-    r1, sr1, addr1 = start_cluster_alpha(ztarget, device_threshold=10**9)
-    r2, sr2, addr2 = start_cluster_alpha(ztarget, device_threshold=10**9)
-    r3, sr3, addr3 = start_cluster_alpha(ztarget, device_threshold=10**9)
+    # high threshold: r1's breaker to the dead r2 must NOT open here —
+    # this test is about chained-Alter recovery, not breaker recovery
+    kw = dict(device_threshold=10**9, breaker_threshold=100,
+              rpc_retries=0)
+    r1, sr1, addr1 = start_cluster_alpha(ztarget, **kw)
+    r2, sr2, addr2 = start_cluster_alpha(ztarget, **kw)
+    r3, sr3, addr3 = start_cluster_alpha(ztarget, **kw)
     for r in (r1, r2, r3):
         r.allow_volatile_stage = True  # explicit test-only opt-in
     r1.wal = WAL(os.path.join(tempfile.mkdtemp(), "wal.log"), sync=False)

@@ -1,0 +1,527 @@
+"""Peer-failure resilience: circuit breakers, budget-aware retries,
+replica failover, and the crash-failover acceptance (ISSUE 5).
+
+Reference parity model: the reference leans on grpc-go backoff + raft
+re-election to route around dead peers; our any-coordinator legs get
+the same property from cluster/resilience.py — this file proves the
+breaker lifecycle (closed → open after threshold, half-open single
+probe, re-open with backoff), the retry contract (UNAVAILABLE/LinkDown
+retried, DEADLINE_EXCEEDED and app errors never, backoff capped by the
+request budget), the retry-storm bound, the heartbeat-failure
+visibility satellite, the <5% no-fault overhead guard, and the
+end-to-end crash-failover acceptance criterion.
+"""
+
+import threading
+import time
+
+import grpc
+import pytest
+
+from dgraph_tpu.cluster.fault import LinkDown
+from dgraph_tpu.cluster.resilience import BreakerOpen, PeerTable
+from dgraph_tpu.utils import deadline as dl
+from dgraph_tpu.utils.metrics import METRICS
+
+PEER = "10.0.0.9:7080"
+
+
+class _AppError(grpc.RpcError):
+    """FAILED_PRECONDITION-shaped error: the peer ANSWERED."""
+
+    def code(self):
+        return grpc.StatusCode.FAILED_PRECONDITION
+
+
+class _DeadlineError(grpc.RpcError):
+    def code(self):
+        return grpc.StatusCode.DEADLINE_EXCEEDED
+
+
+def _down():
+    raise LinkDown("me", PEER)
+
+
+# ---------------------------------------------------------------------------
+# breaker lifecycle
+
+
+@pytest.fixture()
+def fresh_metrics(monkeypatch):
+    """A fresh registry swapped into the resilience module: the global
+    registry's label-cardinality guard may already have collapsed the
+    `peer=` label space by this point in the suite (ephemeral test
+    ports), which would hide the exact gauge series these tests
+    assert."""
+    from dgraph_tpu.cluster import resilience as rmod
+    from dgraph_tpu.utils.metrics import Registry
+
+    reg = Registry()
+    monkeypatch.setattr(rmod, "METRICS", reg)
+    return reg
+
+
+def test_breaker_opens_after_threshold_consecutive_failures(
+        fresh_metrics):
+    t = PeerTable(threshold=3, cooldown_ms=10_000, retries=0)
+    for i in range(2):
+        with pytest.raises(LinkDown):
+            t.call(PEER, "Ping", _down)
+        assert t.state(PEER) == "closed", f"opened early at {i + 1}"
+    with pytest.raises(LinkDown):
+        t.call(PEER, "Ping", _down)
+    assert t.state(PEER) == "open"
+    # while open: instant BreakerOpen, ZERO wire attempts
+    attempts = []
+    with pytest.raises(BreakerOpen):
+        t.call(PEER, "Ping", lambda: attempts.append(1))
+    assert not attempts
+    snap = t.snapshot()[PEER]
+    assert snap["state"] == "open" and snap["failures_total"] == 3
+    assert "LinkDown" in snap["last_error"]
+    assert fresh_metrics.snapshot()["gauges"][
+        f'breaker_state{{peer="{PEER}"}}'] == 1.0
+
+
+def test_success_resets_consecutive_failure_count():
+    t = PeerTable(threshold=3, cooldown_ms=10_000, retries=0)
+    for _round in range(4):  # 2 failures + success, repeatedly: never opens
+        for _ in range(2):
+            with pytest.raises(LinkDown):
+                t.call(PEER, "Ping", _down)
+        assert t.call(PEER, "Ping", lambda: "pong") == "pong"
+        assert t.state(PEER) == "closed"
+    assert t.snapshot()[PEER]["ema_latency_us"] > 0
+
+
+def test_half_open_probe_success_closes(fresh_metrics):
+    t = PeerTable(threshold=2, cooldown_ms=20, retries=0)
+    for _ in range(2):
+        with pytest.raises(LinkDown):
+            t.call(PEER, "Ping", _down)
+    assert t.state(PEER) == "open"
+    time.sleep(0.05)  # past the jittered 20 ms cool-down
+    assert t.call(PEER, "Ping", lambda: "pong") == "pong"
+    assert t.state(PEER) == "closed"
+    assert fresh_metrics.snapshot()["gauges"][
+        f'breaker_state{{peer="{PEER}"}}'] == 0.0
+
+
+def test_half_open_probe_failure_reopens_with_longer_cooldown():
+    t = PeerTable(threshold=2, cooldown_ms=20, retries=0,
+                  max_cooldown_ms=10_000)
+    for _ in range(2):
+        with pytest.raises(LinkDown):
+            t.call(PEER, "Ping", _down)
+    time.sleep(0.05)
+    with pytest.raises(LinkDown):
+        t.call(PEER, "Ping", _down)  # the half-open probe fails
+    snap = t.snapshot()[PEER]
+    assert snap["state"] == "open"
+    # re-open doubles the cool-down (jitter ≤ 1.5×): 40–60 ms remain,
+    # clearly past the base 20 ms
+    assert snap["cooldown_remaining_s"] > 0.03
+    # and while the re-opened cool-down runs, calls stay instant-fail
+    with pytest.raises(BreakerOpen):
+        t.call(PEER, "Ping", lambda: "pong")
+
+
+def test_half_open_admits_exactly_one_probe():
+    t = PeerTable(threshold=1, cooldown_ms=10, retries=0)
+    with pytest.raises(LinkDown):
+        t.call(PEER, "Ping", _down)
+    time.sleep(0.03)
+    entered = threading.Event()
+    release = threading.Event()
+    results = []
+
+    def probe():
+        entered.set()
+        release.wait(5)
+        return "pong"
+
+    th = threading.Thread(
+        target=lambda: results.append(t.call(PEER, "Ping", probe)))
+    th.start()
+    assert entered.wait(5)
+    # the probe is in flight: a concurrent caller must NOT get a second
+    # wire attempt
+    with pytest.raises(BreakerOpen):
+        t.call(PEER, "Ping", lambda: "second")
+    release.set()
+    th.join(5)
+    assert results == ["pong"] and t.state(PEER) == "closed"
+
+
+def test_reset_forgets_history():
+    t = PeerTable(threshold=1, cooldown_ms=60_000, retries=0)
+    with pytest.raises(LinkDown):
+        t.call(PEER, "Ping", _down)
+    assert t.state(PEER) == "open"
+    t.reset(PEER)
+    assert t.state(PEER) == "closed"
+    assert t.call(PEER, "Ping", lambda: "pong") == "pong"
+
+
+# ---------------------------------------------------------------------------
+# retry policy
+
+
+def test_retries_unavailable_then_succeeds():
+    t = PeerTable(threshold=10, cooldown_ms=1000, retries=2,
+                  backoff_ms=1.0)
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            _down()
+        return "ok"
+
+    before = METRICS.get("rpc_retries_total", rpc="Ping",
+                         outcome="success")
+    assert t.call(PEER, "Ping", flaky) == "ok"
+    assert len(calls) == 3
+    assert METRICS.get("rpc_retries_total", rpc="Ping",
+                       outcome="success") == before + 1
+
+
+def test_never_retries_deadline_exceeded_or_app_errors():
+    t = PeerTable(threshold=10, cooldown_ms=1000, retries=3,
+                  backoff_ms=1.0)
+    calls = []
+
+    def dead():
+        calls.append(1)
+        raise _DeadlineError()
+
+    with pytest.raises(_DeadlineError):
+        t.call(PEER, "Ping", dead)
+    assert len(calls) == 1  # DEADLINE_EXCEEDED: exactly one attempt
+
+    calls.clear()
+
+    def refused():
+        calls.append(1)
+        raise _AppError()
+
+    with pytest.raises(_AppError):
+        t.call(PEER, "Ping", refused)
+    assert len(calls) == 1  # app error: the peer answered — no retry
+    # and an app error counts as peer-alive: breaker state untouched
+    assert t.state(PEER) == "closed"
+    assert t.snapshot()[PEER]["consecutive_failures"] == 0
+
+
+def test_retry_backoff_capped_by_request_budget():
+    """retries=8 with 50 ms backoff would sleep ~400+ ms unbounded; a
+    60 ms budget must bound the WHOLE call, and the raised error is the
+    real transport failure (retryable), not a synthetic timeout."""
+    t = PeerTable(threshold=100, cooldown_ms=1000, retries=8,
+                  backoff_ms=50.0)
+    calls = []
+
+    def down():
+        calls.append(1)
+        _down()
+
+    ctx = dl.RequestContext(deadline_ms=60)
+    t0 = time.perf_counter()
+    with dl.activate(ctx):
+        with pytest.raises(LinkDown):
+            t.call(PEER, "Ping", down)
+    elapsed = time.perf_counter() - t0
+    assert elapsed < 0.25, f"retries outlived the budget: {elapsed:.3f}s"
+    assert 1 <= len(calls) <= 4  # a few attempts, nowhere near 9
+
+
+def test_retry_storm_bounded_attempts_against_dead_peer():
+    """The ISSUE's storm guard: many concurrent callers against a dead
+    peer produce a BOUNDED number of wire attempts — the breaker
+    absorbs the storm, it never amplifies it."""
+    threshold, retries, n_threads, calls_each = 3, 2, 8, 5
+    t = PeerTable(threshold=threshold, cooldown_ms=60_000,
+                  retries=retries, backoff_ms=0.5)
+    lock = threading.Lock()
+    attempts = [0]
+
+    def attempt():
+        with lock:
+            attempts[0] += 1
+        _down()
+
+    def hammer():
+        for _ in range(calls_each):
+            try:
+                t.call(PEER, "Ping", attempt)
+            except grpc.RpcError:
+                pass
+
+    threads = [threading.Thread(target=hammer) for _ in range(n_threads)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(10)
+    naive = n_threads * calls_each * (retries + 1)  # 120 unguarded
+    # bound: the threshold opens the breaker; each already-in-flight
+    # call finishes at most its current attempt sequence
+    bound = threshold + n_threads * (retries + 1)
+    assert attempts[0] <= bound, (
+        f"{attempts[0]} wire attempts against a dead peer "
+        f"(bound {bound}, naive {naive})")
+    # and once open, further calls add ZERO attempts
+    before = attempts[0]
+    for _ in range(10):
+        with pytest.raises(BreakerOpen):
+            t.call(PEER, "Ping", attempt)
+    assert attempts[0] == before
+
+
+# ---------------------------------------------------------------------------
+# heartbeat satellite: silent failure made visible
+
+
+def test_heartbeat_failures_metered_and_escalated(caplog):
+    import logging
+
+    from dgraph_tpu.cli import HEARTBEAT_ERROR_AFTER, run_heartbeat_loop
+    from dgraph_tpu.utils import logging as xlog
+
+    stop = threading.Event()
+    calls = []
+
+    def step():
+        calls.append(1)
+        if len(calls) >= HEARTBEAT_ERROR_AFTER + 1:
+            stop.set()
+        raise RuntimeError("zero is dark")
+
+    before = METRICS.get("heartbeat_failures_total", kind="hb-test")
+    with caplog.at_level(logging.DEBUG, logger="dgraph_tpu.hb-test"):
+        run_heartbeat_loop("hb-test", 0.005, step, xlog.get("hb-test"),
+                           stop=stop)
+    delta = METRICS.get("heartbeat_failures_total",
+                        kind="hb-test") - before
+    assert delta >= HEARTBEAT_ERROR_AFTER
+    errors = [r for r in caplog.records if r.levelname == "ERROR"
+              and "heartbeat failed" in r.message]
+    assert errors, "no error-level escalation after N consecutive fails"
+    assert "zero link is likely dead" in errors[0].getMessage()
+
+
+# ---------------------------------------------------------------------------
+# tier-1 guard: the resilience wrapper must stay invisible on the
+# no-fault path (<5%, mirroring the tracing/admission guards' method)
+
+
+def test_resilience_wrapper_overhead_under_5_percent():
+    from dgraph_tpu.server.api import Alpha
+    from dgraph_tpu.server.task import Client, make_server
+
+    alpha = Alpha(device_threshold=10**9)
+    server, port = make_server(alpha)
+    server.start()
+    try:
+        addr = f"127.0.0.1:{port}"
+        plain = Client(addr)
+        wrapped = Client(addr, resilience=PeerTable(), peer_addr=addr)
+        for c in (plain, wrapped):  # warm channels
+            for _ in range(20):
+                c.ping()
+
+        def best_of(c, reps=5, n=200):
+            best = float("inf")
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                for _i in range(n):
+                    c.ping()
+                best = min(best, time.perf_counter() - t0)
+            return best
+
+        best_ratio = float("inf")
+        for _attempt in range(3):
+            off = best_of(plain)
+            on = best_of(wrapped)
+            best_ratio = min(best_ratio, on / off)
+            if best_ratio <= 1.05:
+                break
+        assert best_ratio <= 1.05, (
+            f"resilience wrapper overhead {best_ratio:.3f}x exceeds "
+            f"the 5% budget on the no-fault path")
+        plain.close()
+        wrapped.close()
+    finally:
+        server.stop(0)
+
+
+# ---------------------------------------------------------------------------
+# crash-failover acceptance (the ISSUE's acceptance criterion)
+
+
+def _counter_sum(prefix: str) -> float:
+    return sum(v for k, v in METRICS.snapshot()["counters"].items()
+               if k == prefix or k.startswith(prefix + "{"))
+
+
+def test_crash_failover_acceptance(tmp_path):
+    """With 3 replicas serving reads, crashing one peer mid-load yields
+    ZERO failed client reads (every leg fails over inside its deadline
+    budget), the breaker opens within breaker_threshold attempts, and
+    after restart the node heals via FetchLog and the breaker closes
+    via its half-open probe — asserted end-to-end against /debug/peers
+    and the rpc_retries_total / failover_total / peer_crashes_total
+    metrics, under a fixed fuzz seed."""
+    import json
+    import os
+    import urllib.request
+
+    from dgraph_tpu.cluster import start_cluster_alpha
+    from dgraph_tpu.cluster.fault import FaultSchedule, FaultyGroups
+    from dgraph_tpu.cluster.zero import (ZeroClient, ZeroState,
+                                         make_zero_server)
+    from dgraph_tpu.server.http import make_http_server, serve_background
+
+    THRESHOLD, RETRIES, COOLDOWN_MS = 2, 1, 100.0
+    kw = dict(device_threshold=10**9, breaker_threshold=THRESHOLD,
+              breaker_cooldown_ms=COOLDOWN_MS, rpc_retries=RETRIES)
+    zserver, zport, _zs = make_zero_server(ZeroState(replicas=3))
+    zserver.start()
+    ztarget = f"127.0.0.1:{zport}"
+    nodes, addrs = [], []
+    for i in range(3):  # group 1: the 3-replica data group
+        d = tmp_path / f"n{i}"
+        d.mkdir()
+        a, s, addr = start_cluster_alpha(ztarget, wal_dir=str(d), **kw)
+        a.groups = FaultyGroups(a.groups)
+        nodes.append((a, s))
+        addrs.append(addr)
+    # a 4th node opens group 2: the remote READ coordinator whose
+    # tablet_snapshot/serve_task legs must fail over
+    dc = tmp_path / "c"
+    dc.mkdir()
+    c, sc, caddr = start_cluster_alpha(ztarget, wal_dir=str(dc), **kw)
+    assert c.groups.gid != nodes[0][0].groups.gid
+
+    zc = ZeroClient(ztarget)
+    for pred in ("name",):
+        zc.should_serve(pred, nodes[0][0].groups.gid)
+    nodes[0][0].alter("name: string @index(exact) .")
+    for a, _s in nodes + [(c, sc)]:
+        a.groups.refresh()
+    for i in range(6):
+        nodes[0][0].mutate(set_nquads=f'_:a <name> "seed{i}" .')
+
+    # crash the replica whose address every failover leg PREFERS
+    # (sorted-first), so the failover metric is deterministic
+    g_addrs = sorted(addrs)
+    crash_idx = addrs.index(g_addrs[0])
+    survivors = [i for i in range(3) if i != crash_idx]
+    srv_a = nodes[survivors[0]][0]
+    http = make_http_server(srv_a)
+    serve_background(http)
+    hport = http.server_address[1]
+
+    def peers_doc():
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{hport}/debug/peers", timeout=10) as r:
+            return json.loads(r.read())
+
+    def read_names(a, want_n):
+        out = a.query('{ q(func: has(name)) { name } }',
+                      deadline_ms=5_000)
+        assert len(out["q"]) == want_n
+        return out
+
+    crashes0 = _counter_sum("peer_crashes_total")
+    retries0 = _counter_sum("rpc_retries_total")
+    failover0 = _counter_sum("failover_total")
+    heals0 = _counter_sum("fetchlog_heals_total")
+
+    sched = FaultSchedule(61007, 3, crash=True)  # fixed-seed machinery
+    groups = [a.groups for a, _s in nodes]
+
+    # -- crash the preferred replica mid-load ------------------------------
+    def kill(src, up):
+        assert not up
+        a, s = nodes[src]
+        s.stop(None)
+        a.wal.close()
+
+    sched.apply_event(("crash", crash_idx, 0, 0.0), groups, addrs,
+                      crash_cb=kill)
+    assert _counter_sum("peer_crashes_total") == crashes0 + 1
+
+    n_before = 6
+    failed_reads = 0
+    for i in range(6):  # mid-load: writes + reads interleaved
+        try:
+            read_names(srv_a, n_before + i)       # replica-local leg
+            c._tablet_cache.clear()               # force the wire leg
+            c._stale_preds.add("name")
+            read_names(c, n_before + i)           # cross-group leg
+        except Exception:  # noqa: BLE001 — the acceptance counts these
+            failed_reads += 1
+        srv_a.mutate(set_nquads=f'_:m <name> "mid{i}" .')
+    assert failed_reads == 0, (
+        f"{failed_reads} client reads failed during the crash window")
+
+    # breaker opened within threshold attempts, on BOTH reader nodes
+    crash_addr = addrs[crash_idx]
+    for table in (srv_a.groups.resilience, c.groups.resilience):
+        snap = table.snapshot()[crash_addr]
+        assert snap["state"] == "open"
+        assert snap["consecutive_failures"] >= THRESHOLD
+    doc = peers_doc()
+    assert doc["enabled"] and doc["peers"][crash_addr]["state"] == "open"
+    # the legs retried before failing over, and failover is metered
+    assert _counter_sum("rpc_retries_total") > retries0
+    assert _counter_sum("failover_total") > failover0
+    assert METRICS.get("failover_total", rpc="tablet_snapshot") >= 1
+
+    # -- restart: heal via FetchLog, breaker closes via half-open probe ----
+    wal_dir = os.path.dirname(nodes[crash_idx][0].wal.path)
+    last_err = None
+    for _ in range(30):
+        try:
+            a2, s2, addr2 = start_cluster_alpha(
+                ztarget, wal_dir=wal_dir, addr=crash_addr, **kw)
+            break
+        except Exception as e:  # noqa: BLE001 — port rebind race
+            last_err = e
+            time.sleep(0.1)
+    else:
+        raise last_err
+    assert addr2 == crash_addr
+    a2.groups = FaultyGroups(a2.groups)
+    nodes[crash_idx] = (a2, s2)
+    sched.crashed.discard(crash_idx)
+    if a2.groups.other_addrs():
+        a2.resync_on_join()  # the rejoin leg Alpha boot runs (cli.py)
+    assert _counter_sum("fetchlog_heals_total") > heals0, (
+        "the restarted node did not heal via FetchLog")
+
+    # failed half-open probes during the crash window escalated the
+    # cool-down (re-open backoff); keep reading — every read keeps
+    # succeeding via failover — until the probe fires and closes the
+    # breaker on both reader nodes
+    deadline_t = time.monotonic() + 20
+    while time.monotonic() < deadline_t:
+        read_names(srv_a, n_before + 6)
+        c._tablet_cache.clear()
+        c._stale_preds.add("name")
+        read_names(c, n_before + 6)
+        if (srv_a.groups.resilience.state(crash_addr) == "closed"
+                and c.groups.resilience.state(crash_addr) == "closed"):
+            break
+        time.sleep(0.15)
+    assert srv_a.groups.resilience.state(crash_addr) == "closed"
+    assert c.groups.resilience.state(crash_addr) == "closed"
+    assert peers_doc()["peers"][crash_addr]["state"] == "closed"
+    # the healed node serves its own store correctly too
+    read_names(a2, n_before + 6)
+
+    for _a, s in nodes:
+        s.stop(None)
+    sc.stop(None)
+    http.shutdown()
+    zserver.stop(None)

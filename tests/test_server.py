@@ -239,3 +239,66 @@ def test_cli_mesh_flag(tmp_path, capsys):
     a.mutate(set_nquads='_:x <name> "x" .')
     assert a.query('{ q(func: has(name)) { name } }') == {
         "q": [{"name": "x"}]}
+
+
+def test_client_disconnect_cancels_request_and_frees_token(alpha):
+    """ISSUE 5 satellite (ROADMAP PR-4 follow-on): a client that hangs
+    up mid-query gets its request CANCELLED — the socket watcher calls
+    ctx.cancel(), counted as request_cancelled_total{stage="disconnect"}
+    — and the abandoned request releases its admission token early
+    instead of computing into the void."""
+    import socket
+    import threading
+    import time
+
+    from dgraph_tpu.utils import deadline as dl
+    from dgraph_tpu.utils.metrics import METRICS
+
+    started = threading.Event()
+    outcome = []
+
+    def slow_query_raw(dql, variables=None, read_ts=None, acl_user=None,
+                       deadline_ms=None):
+        # a long-running query stub that cooperatively checkpoints —
+        # exactly what a real engine hot loop does, without flakiness
+        with alpha._request("read", deadline_ms):
+            started.set()
+            try:
+                while True:
+                    dl.checkpoint("slow_stub")
+                    time.sleep(0.005)
+            except BaseException:
+                outcome.append("cancelled")
+                raise
+
+    alpha.query_raw = slow_query_raw
+    alpha.attach_admission(max_inflight=2, queue_depth=2)
+    srv = make_http_server(alpha)
+    serve_background(srv)
+    port = srv.server_address[1]
+    c0 = METRICS.get("request_cancelled_total", stage="disconnect")
+
+    s = socket.create_connection(("127.0.0.1", port), timeout=10)
+    body = b"{ q(func: has(name)) { name } }"
+    s.sendall(b"POST /query HTTP/1.1\r\nHost: t\r\n"
+              b"Content-Length: %d\r\n\r\n%s" % (len(body), body))
+    assert started.wait(10), "the handler never started the query"
+    s.close()  # the client walks away mid-query
+
+    deadline_t = time.monotonic() + 10
+    while time.monotonic() < deadline_t:
+        if METRICS.get("request_cancelled_total",
+                       stage="disconnect") > c0:
+            break
+        time.sleep(0.02)
+    assert METRICS.get("request_cancelled_total",
+                       stage="disconnect") == c0 + 1, (
+        "the disconnect was never noticed")
+    # the admission token drains (the request really ended)
+    while time.monotonic() < deadline_t:
+        if alpha.admission.status()["lanes"]["read"]["inflight"] == 0:
+            break
+        time.sleep(0.02)
+    assert alpha.admission.status()["lanes"]["read"]["inflight"] == 0
+    assert outcome == ["cancelled"]
+    srv.shutdown()
