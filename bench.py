@@ -268,6 +268,19 @@ def child_main(platform: str, expect_path: str) -> None:
     os._exit(0)
 
 
+def lint_stage() -> dict:
+    """graftlint finding/waiver counts per rule + facts totals —
+    the static-analysis debt tracked alongside throughput (ISSUE 6),
+    and the kernel/span inventory the cost-model item consumes.
+    Equivalent CLI: python -m dgraph_tpu.analysis --format=json."""
+    try:
+        from dgraph_tpu.analysis import run as lint_run
+        a = lint_run()
+        return {**a.counts(), "facts": a.facts["totals"]}
+    except Exception as e:  # noqa: BLE001 — bench must not die on lint
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
 def maintenance_stage() -> dict:
     """Pause-impact telemetry (ISSUE 3): serve a query mix against an
     out-of-core store while the background scheduler streams rollups +
@@ -525,6 +538,7 @@ def main() -> None:
         out.update(value=0, platform=platform, vs_baseline=0.0, error=err)
     if err and "error" not in out:
         out["error"] = err
+    out["lint"] = lint_stage()
     emit(out)
     watchdog.cancel()
     sys.stdout.flush()

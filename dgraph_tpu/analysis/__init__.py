@@ -1,0 +1,279 @@
+"""graftlint: AST invariant checker for the dgraph_tpu stack.
+
+Reference parity: the reference keeps a heavily-threaded distributed
+system honest with Go's toolchain — `go vet`, custom analyzers, and the
+race detector wired into CI. Our Python/JAX port re-established the
+same invariants PR by PR (deadline checkpoints in every hot loop, one
+resilience wrapper for every outbound RPC, monotonic clocks in budget
+arithmetic, retry policies that never re-spend an expired deadline,
+bounded metric label spaces, jit-path purity) — but only as convention.
+This package is the `go vet` analog: a pluggable AST lint framework
+with codebase-specific rules (see `rules.py`, R1–R6), run by tier-1
+(`tests/test_lint.py`) over the whole package so a perf refactor that
+silently drops an invariant fails the build, not the next incident.
+
+Waivers: a finding is suppressed by an inline comment on the offending
+line or the line directly above it::
+
+    # graftlint: allow(<rule>[, <rule>...]): <reason>
+
+The reason string is MANDATORY — a reasonless waiver is itself a
+finding (rule `waiver-syntax`). Waivers are the escape hatch for
+intentional exceptions (a wall-clock timestamp that must cross process
+boundaries, an O(log n) arithmetic loop); the reason is the review
+record of WHY the invariant doesn't apply.
+
+The analyzer also extracts a FACTS inventory (kernel shapes, span
+sites, metric names, lock order classes — `facts.py`): the static half
+of the ROADMAP's TpuGraphs-style cost-model item, and the input
+`bench.py` folds into BENCH JSON so the perf trajectory tracks lint
+debt alongside throughput.
+
+Run standalone::
+
+    python -m dgraph_tpu.analysis [--format=text|json] [paths...]
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import pathlib
+import re
+
+__all__ = ["Finding", "FileContext", "Rule", "Analyzer", "run",
+           "WAIVER_RE", "WAIVER_SYNTAX"]
+
+WAIVER_RE = re.compile(
+    r"#\s*graftlint:\s*allow\(\s*(?P<rules>[a-z0-9_,\s\-]+?)\s*\)"
+    r"(?:\s*:\s*(?P<reason>\S.*))?")
+WAIVER_SYNTAX = "waiver-syntax"
+
+
+@dataclasses.dataclass
+class Finding:
+    """One rule violation at one site. `waived` findings are kept (the
+    CLI can show them; bench counts them) but never fail the build."""
+
+    rule: str
+    path: str          # repo-relative, "/"-separated
+    line: int
+    msg: str
+    waived: bool = False
+    reason: str = ""   # the waiver's reason when waived
+
+    def format(self) -> str:
+        tag = f"  [waived: {self.reason}]" if self.waived else ""
+        return f"{self.path}:{self.line}: [{self.rule}] {self.msg}{tag}"
+
+
+class FileContext:
+    """One scanned file: source, parsed tree, and its waiver map."""
+
+    def __init__(self, rel: str, source: str):
+        self.rel = rel
+        self.source = source
+        self.tree = ast.parse(source, filename=rel)
+        self.lines = source.splitlines()
+        # line number → (set of waived rules, reason, has_reason)
+        self.waivers: dict[int, tuple[set[str], str, bool]] = {}
+        for i, ln in enumerate(self.lines, start=1):
+            m = WAIVER_RE.search(ln)
+            if not m:
+                continue
+            rules = {r.strip() for r in m.group("rules").split(",")
+                     if r.strip()}
+            reason = (m.group("reason") or "").strip()
+            self.waivers[i] = (rules, reason, bool(reason))
+        self._effective = dict(self.waivers)
+        for line, w in self.waivers.items():
+            for ln in self._reach(line):
+                self._effective.setdefault(ln, w)
+
+    def _reach(self, line: int):
+        """Lines a waiver at `line` covers beyond itself. A waiver on a
+        comment-only line flows DOWN through the rest of its comment
+        block to the next statement: the full span of a simple
+        statement (a multi-line call keeps its finding on a
+        continuation line), the header only of a compound one (a
+        waiver above a `while` must not silence findings in its
+        body). A trailing waiver on a code line covers that line."""
+        if not self.lines[line - 1].lstrip().startswith("#"):
+            return
+        c = line + 1
+        while c <= len(self.lines) and (
+                not self.lines[c - 1].strip()
+                or self.lines[c - 1].lstrip().startswith("#")):
+            c += 1
+        if c > len(self.lines):
+            return
+        best = None  # smallest statement span containing line c
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.stmt):
+                continue
+            end = getattr(node, "end_lineno", node.lineno)
+            if node.lineno <= c <= end:
+                if best is None or (end - node.lineno
+                                    < best[1] - best[0].lineno):
+                    body = getattr(node, "body", None)
+                    hdr_end = (body[0].lineno - 1
+                               if isinstance(body, list) and body
+                               and isinstance(body[0], ast.stmt)
+                               else end)
+                    best = (node, end, hdr_end)
+        if best is None:
+            yield c
+            return
+        node, end, hdr_end = best
+        lo = max(c, node.lineno)
+        hi = hdr_end if hdr_end >= lo else end
+        for ln in range(lo, hi + 1):
+            yield ln
+
+    def waiver_for(self, rule: str, line: int) -> str | None:
+        """The reason string if `rule` is waived at `line` (same line,
+        the line directly above, or within reach of a comment-block
+        waiver), else None. A reasonless waiver does NOT waive — it
+        surfaces as a `waiver-syntax` finding."""
+        for ln in (line, line - 1):
+            w = self._effective.get(ln)
+            if w and rule in w[0] and w[2]:
+                return w[1]
+        return None
+
+
+class Rule:
+    """Base class: subclasses set `name`/`doc`, implement `check_file`,
+    and may implement `finalize` for repo-level findings (rules that
+    aggregate across files, like the metric-docs README pass)."""
+
+    name = "base"
+    doc = ""
+
+    def applies(self, rel: str) -> bool:
+        return rel.startswith("dgraph_tpu/")
+
+    def check_file(self, ctx: FileContext) -> list[Finding]:
+        return []
+
+    def finalize(self, analyzer: "Analyzer") -> list[Finding]:
+        return []
+
+
+class Analyzer:
+    """Drives a rule set over a file set; applies waivers; collects
+    the facts inventory. `readme_text` is injectable for tests."""
+
+    def __init__(self, rules: list[Rule] | None = None,
+                 repo_root: pathlib.Path | None = None,
+                 readme_text: str | None = None):
+        if rules is None:
+            from dgraph_tpu.analysis.rules import default_rules
+            rules = default_rules()
+        self.rules = rules
+        self.repo_root = repo_root
+        self._readme_text = readme_text
+        self.contexts: list[FileContext] = []
+        self.findings: list[Finding] = []
+        self.facts: dict = {}
+
+    @property
+    def readme_text(self) -> str:
+        if self._readme_text is None:
+            p = ((self.repo_root or pathlib.Path(".")) / "README.md")
+            self._readme_text = p.read_text() if p.exists() else ""
+        return self._readme_text
+
+    # -- scanning ------------------------------------------------------------
+    def add_source(self, rel: str, source: str) -> None:
+        ctx = FileContext(rel, source)
+        self.contexts.append(ctx)
+        for line, (rules, _reason, has_reason) in ctx.waivers.items():
+            if not has_reason:
+                self.findings.append(Finding(
+                    WAIVER_SYNTAX, rel, line,
+                    f"waiver for {sorted(rules)} carries no reason "
+                    f"string — write `# graftlint: allow(rule): why`"))
+        for rule in self.rules:
+            if not rule.applies(rel):
+                continue
+            for f in rule.check_file(ctx):
+                reason = ctx.waiver_for(f.rule, f.line)
+                if reason is not None:
+                    f.waived, f.reason = True, reason
+                self.findings.append(f)
+
+    def run(self, paths: list[pathlib.Path],
+            repo_root: pathlib.Path | None = None) -> list[Finding]:
+        """Scan files/trees under `paths`; then run repo-level
+        finalizers and extract facts. Returns ALL findings (filter on
+        `.waived` for the failing set)."""
+        if repo_root is not None:
+            self.repo_root = repo_root
+        root = self.repo_root or pathlib.Path(".")
+        files: list[pathlib.Path] = []
+        for p in paths:
+            if p.is_dir():
+                files.extend(sorted(p.rglob("*.py")))
+            elif p.suffix == ".py":
+                files.append(p)
+        for f in files:
+            if "__pycache__" in f.parts:
+                continue
+            try:
+                rel = f.resolve().relative_to(root.resolve()).as_posix()
+            except ValueError:
+                rel = f.as_posix()
+            self.add_source(rel, f.read_text())
+        self.finish()
+        return self.findings
+
+    def finish(self) -> None:
+        """Repo-level passes: rule finalizers + the facts inventory."""
+        for rule in self.rules:
+            self.findings.extend(rule.finalize(self))
+        from dgraph_tpu.analysis.facts import extract_facts
+        self.facts = extract_facts(self.contexts)
+
+    # -- reporting -----------------------------------------------------------
+    def unwaived(self) -> list[Finding]:
+        return [f for f in self.findings if not f.waived]
+
+    def counts(self) -> dict[str, dict[str, int]]:
+        """{"findings": {rule: unwaived}, "waived": {rule: waived}} —
+        the shape bench.py embeds into BENCH JSON."""
+        out = {"findings": {}, "waived": {}}
+        for f in self.findings:
+            bucket = "waived" if f.waived else "findings"
+            out[bucket][f.rule] = out[bucket].get(f.rule, 0) + 1
+        return out
+
+    def to_json(self) -> dict:
+        return {
+            "findings": [dataclasses.asdict(f) for f in self.findings
+                         if not f.waived],
+            "waived": [dataclasses.asdict(f) for f in self.findings
+                       if f.waived],
+            "counts": self.counts(),
+            "facts": self.facts,
+        }
+
+
+def default_paths(repo_root: pathlib.Path) -> list[pathlib.Path]:
+    """What `python -m dgraph_tpu.analysis` (and tier-1) scans: the
+    whole package, plus bench.py for the metric-docs pass."""
+    paths = [repo_root / "dgraph_tpu"]
+    bench = repo_root / "bench.py"
+    if bench.exists():
+        paths.append(bench)
+    return paths
+
+
+def run(repo_root: pathlib.Path | None = None) -> Analyzer:
+    """One-call entry: scan the default file set with the default
+    rules. Used by tests/test_lint.py and bench.py."""
+    if repo_root is None:
+        repo_root = pathlib.Path(__file__).resolve().parents[2]
+    a = Analyzer(repo_root=repo_root)
+    a.run(default_paths(repo_root), repo_root=repo_root)
+    return a

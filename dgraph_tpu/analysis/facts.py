@@ -1,0 +1,91 @@
+"""Facts inventory: the static half of the cost-model direction.
+
+ROADMAP's TpuGraphs-style item needs per-query-shape cost priors built
+from recorded compile/execute spans; matching a recorded span back to
+the kernel that produced it needs a ground-truth inventory of what the
+codebase can launch and measure. graftlint already parses every file,
+so the same pass extracts:
+
+* **kernels** — every function handed to `jax.jit` (with its
+  static_argnames: the retrace axes, i.e. the cost-model's categorical
+  features) and every `jit_call("<kernel>", key)` launch site (the
+  names `jit_compile_us{kernel=}` series carry).
+* **spans** — every `tracing.span("<name>", ...)` site: the vocabulary
+  of the trace/OTLP streams the predictor trains on.
+* **metrics** — every literal registration (name, kind, site).
+* **locks** — every `make_lock/make_rlock/make_condition` order class,
+  the static side of the lock sanitizer's graph.
+
+Emitted under `"facts"` in `--format=json` output.
+"""
+
+from __future__ import annotations
+
+import ast
+
+__all__ = ["extract_facts"]
+
+_LOCK_FNS = {"make_lock": "lock", "make_rlock": "rlock",
+             "make_condition": "condition"}
+
+
+def _dotted(node: ast.AST) -> str:
+    from dgraph_tpu.analysis.rules import _dotted as d
+    return d(node)
+
+
+def extract_facts(contexts) -> dict:
+    from dgraph_tpu.analysis.rules import JitPurity
+
+    kernels, launches, spans, locks = [], [], [], []
+    metrics: list[dict] = []
+    jit_rule = JitPurity()
+    for ctx in contexts:
+        if not (ctx.rel.startswith("dgraph_tpu/")
+                or ctx.rel == "bench.py"):
+            continue
+        for fn, statics in jit_rule._jitted_functions(ctx.tree):
+            kernels.append({
+                "name": fn.name, "file": ctx.rel, "line": fn.lineno,
+                "static_argnames": sorted(statics)})
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call):
+                d = _dotted(node.func)
+                leaf = d.rsplit(".", 1)[-1]
+                arg0 = (node.args[0].value
+                        if node.args
+                        and isinstance(node.args[0], ast.Constant)
+                        and isinstance(node.args[0].value, str)
+                        else None)
+                if leaf == "jit_call" and arg0:
+                    launches.append({"kernel": arg0, "file": ctx.rel,
+                                     "line": node.lineno})
+                elif leaf == "span" and arg0:
+                    spans.append({"name": arg0, "file": ctx.rel,
+                                  "line": node.lineno})
+                elif leaf in _LOCK_FNS and arg0:
+                    locks.append({"name": arg0,
+                                  "kind": _LOCK_FNS[leaf],
+                                  "file": ctx.rel,
+                                  "line": node.lineno})
+                elif (leaf in ("inc", "observe", "set_gauge") and arg0
+                      and isinstance(node.func, ast.Attribute)
+                      and isinstance(node.func.value, ast.Name)
+                      and node.func.value.id == "METRICS"):
+                    metrics.append({"name": arg0, "kind": leaf,
+                                    "file": ctx.rel,
+                                    "line": node.lineno})
+    return {
+        "kernels": kernels,
+        "kernel_launch_sites": launches,
+        "span_sites": spans,
+        "metric_sites": metrics,
+        "lock_classes": locks,
+        "totals": {
+            "kernels": len(kernels),
+            "kernel_launch_sites": len(launches),
+            "span_names": len({s["name"] for s in spans}),
+            "metric_names": len({m["name"] for m in metrics}),
+            "lock_classes": len({x["name"] for x in locks}),
+        },
+    }

@@ -298,6 +298,8 @@ def cmd_zero(args) -> int:
 
     def maintenance():
         import time
+        # graftlint: allow(retry-deadline): daemon scheduler — the sleep
+        # is the tick cadence, not a backoff; no request budget exists
         while True:
             time.sleep(max(args.txn_timeout / 2, 1.0)
                        if args.txn_timeout else 10.0)

@@ -9,11 +9,10 @@ exactly as the reference's first-asker rule.
 
 from __future__ import annotations
 
-import threading
-
 import grpc
 
 from dgraph_tpu.cluster.resilience import PeerTable
+from dgraph_tpu.utils import locks
 from dgraph_tpu.cluster.zero import ZeroClient
 from dgraph_tpu.utils.metrics import METRICS
 
@@ -35,7 +34,7 @@ class Groups:
         self.resilience = PeerTable(threshold=breaker_threshold,
                                     cooldown_ms=breaker_cooldown_ms,
                                     retries=rpc_retries)
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("groups.pool")
         self._pools: dict[str, object] = {}
         self._tablets: dict[str, int] = {}
         self._groups: dict[int, dict[int, str]] = {}

@@ -20,9 +20,11 @@ Transaction model (snapshot isolation, first-committer-wins):
 from __future__ import annotations
 
 import hashlib
-import threading
 import time
+
 from dataclasses import dataclass, field
+
+from dgraph_tpu.utils import locks
 
 
 class TxnAborted(Exception):
@@ -48,7 +50,7 @@ class Oracle:
     """Timestamp + uid authority with commit conflict detection."""
 
     def __init__(self, first_ts: int = 1, first_uid: int = 1):
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("oracle.state")
         self._next_ts = first_ts
         self._next_uid = first_uid
         self._pending: dict[int, TxnStatus] = {}

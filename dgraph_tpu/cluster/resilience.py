@@ -48,6 +48,7 @@ import time
 import grpc
 
 from dgraph_tpu.utils import deadline as dl
+from dgraph_tpu.utils import locks
 from dgraph_tpu.utils import tracing
 from dgraph_tpu.utils.metrics import METRICS
 
@@ -124,7 +125,7 @@ class PeerTable:
         self.backoff_s = max(backoff_ms, 0.1) / 1e3
         self.max_backoff_s = max(max_backoff_ms, backoff_ms) / 1e3
         self.max_cooldown_s = max(max_cooldown_ms, cooldown_ms) / 1e3
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("resilience.peers")
         self._peers: dict[str, _Peer] = {}
         self._rng = random.Random(0xD6B2E55)  # jitter only, never schedules
 

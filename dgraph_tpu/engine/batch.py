@@ -17,15 +17,13 @@ first-visit masks.
 
 from __future__ import annotations
 
-import threading
-
 import numpy as np
 
 from dgraph_tpu.engine.execute import Executor, LevelNode
 from dgraph_tpu.engine.ir import SubGraph
 from dgraph_tpu.engine.outputnode import to_json
 from dgraph_tpu.engine.recurse import RecurseData, _bind_recurse_vars
-from dgraph_tpu.utils import deadline, tracing
+from dgraph_tpu.utils import deadline, locks, tracing
 from dgraph_tpu.utils.jitcache import jit_call
 from dgraph_tpu.utils.metrics import METRICS
 
@@ -243,7 +241,7 @@ def _rebuild_recurse_data(store, g, rel, hops, q: int, sg: SubGraph,
 # one lock guards cache init/population on every snapshot: concurrent
 # batch requests under ThreadingHTTPServer must not both build/upload the
 # same ELL arrays (double HBM) or clobber each other's cache dicts
-_cache_lock = threading.Lock()
+_cache_lock = locks.make_lock("batch.plan_cache")
 
 
 def _cache_host(store, attr: str, reverse: bool):

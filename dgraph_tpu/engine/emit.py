@@ -24,6 +24,7 @@ from dgraph_tpu import native
 from dgraph_tpu.engine.execute import LevelNode
 from dgraph_tpu.engine.outputnode import _Renderer, _json_val, to_json
 from dgraph_tpu.store.types import Kind
+from dgraph_tpu.utils import deadline
 
 _SEP = (",", ":")
 
@@ -165,6 +166,7 @@ def _lower_recurse(ex, node: LevelNode, keep: list, levels: list):
     seen: set[int] = {int(r) for r in node.nodes}
     level_doms = [np.asarray(node.nodes, np.int32)]
     while True:
+        deadline.checkpoint("emit")
         parts = [_edges_for(ps, cs, level_doms[-1])[1]
                  for ps, cs in grouped.values()]
         parts = [p for p in parts if len(p)]

@@ -104,6 +104,8 @@ def _shortest_path(ex, sg) -> PathData:
             # path length must cancel via the deadline checkpoints
             # above, never crash the walk
             rev, cur = [], int(dst)
+            # graftlint: allow(hot-loop-checkpoint): walk-back length is
+            # bounded by the BFS depth the checkpointed loop above built
             while True:
                 plist = parents[cur]
                 if not plist:
@@ -359,6 +361,7 @@ def _weighted_shortest(ex, sg, data: PathData, src: int,
     kept = sum(1 for c, _p, _pc in A if in_range(c))
     iters = 0
     while kept < k and iters < MAX_YEN_ITERS:
+        deadline.checkpoint("yen")
         iters += 1
         _pc, prev, prev_costs = A[-1]
         for i in range(len(prev) - 1):

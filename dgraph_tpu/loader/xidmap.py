@@ -8,9 +8,8 @@ leases, like the reference's lease chunking).
 
 from __future__ import annotations
 
-import threading
-
 from dgraph_tpu.cluster.oracle import Oracle
+from dgraph_tpu.utils import locks
 
 LEASE_CHUNK = 1024
 
@@ -19,8 +18,8 @@ class XidMap:
     def __init__(self, oracle: Oracle, shards: int = 16):
         self._oracle = oracle
         self._shards = [
-            (threading.Lock(), {}) for _ in range(shards)]
-        self._pool_lock = threading.Lock()
+            (locks.make_lock("xidmap.shard"), {}) for _ in range(shards)]
+        self._pool_lock = locks.make_lock("xidmap.pool")
         self._pool: list[int] = []
 
     def _lease(self) -> int:

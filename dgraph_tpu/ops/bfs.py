@@ -152,6 +152,7 @@ def build_ell(indptr, indices, bucket_base: int = 4) -> EllGraph:
 
     max_indeg = max(int(indeg.max()), 1) if n else 1
     ks, k = [], 1
+    # graftlint: allow(hot-loop-checkpoint): O(log max_indeg) ladder
     while k < max_indeg:
         ks.append(k)
         k *= bucket_base

@@ -33,7 +33,7 @@ import threading
 import time
 from collections import deque
 
-from dgraph_tpu.utils import tracing
+from dgraph_tpu.utils import locks, tracing
 from dgraph_tpu.utils.metrics import METRICS
 
 __all__ = ["AdmissionController", "ServerOverloaded", "LANES"]
@@ -75,7 +75,7 @@ class _Lane:
         self.name = name
         self.max_inflight = max(1, int(max_inflight))
         self.queue_depth = max(0, int(queue_depth))
-        self.lock = threading.Lock()
+        self.lock = locks.make_lock(f"admission.{name}")
         self.inflight = 0
         self.waiters: deque[_Waiter] = deque()
         self.admitted_total = 0

@@ -23,6 +23,7 @@ import time
 from dataclasses import dataclass, field
 
 from dgraph_tpu.cluster.oracle import Oracle, TxnAborted
+from dgraph_tpu.utils import locks
 from dgraph_tpu.engine import Engine
 from dgraph_tpu.loader.chunker import NQuad, parse_json, parse_rdf
 from dgraph_tpu.loader.xidmap import XidMap
@@ -127,8 +128,8 @@ class Alpha:
         # budget (0 = unbounded, the historical behavior)
         self.admission = None
         self.default_deadline_ms = 0.0
-        self._apply_lock = threading.Lock()
-        self._state_lock = threading.Lock()
+        self._apply_lock = locks.make_lock("alpha.apply")
+        self._state_lock = locks.make_lock("alpha.state")
         self._open_txns: dict[int, Txn] = {}
         self._active_reads: dict[int, int] = {}
         self._gc_tick = 0

@@ -69,7 +69,7 @@ def _parse_timeout_ms(val: str) -> float:
 
 def make_http_server(alpha: Alpha, addr: str = "127.0.0.1",
                      port: int = 0) -> ThreadingHTTPServer:
-    start_time = time.time()
+    start_time = dl.monotonic_s()
 
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, *a):  # quiet (x.Logger role is utils.logging)
@@ -138,7 +138,7 @@ def make_http_server(alpha: Alpha, addr: str = "127.0.0.1",
         def do_GET(self):
             if self.path == "/health":
                 self._send(200, [{"status": "healthy",
-                                  "uptime": int(time.time() - start_time)}])
+                                  "uptime": int(dl.monotonic_s() - start_time)}])
             elif self.path == "/state":
                 if alpha.groups is not None:
                     # cluster mode: real topology from Zero, including
@@ -212,6 +212,13 @@ def make_http_server(alpha: Alpha, addr: str = "127.0.0.1",
                 else:
                     self._send(200, {"enabled": True,
                                      **alpha.admission.status()})
+            elif self.path.startswith("/debug/locks"):
+                # lock-order sanitizer state: acquisition-graph
+                # edges, detected cycles (each with both stacks),
+                # long holds (utils/locks.py; enabled under
+                # DGRAPH_TPU_LOCK_SANITIZER=1, else a stub)
+                from dgraph_tpu.utils import locks
+                self._send(200, locks.GRAPH.snapshot())
             elif self.path.startswith("/debug/peers"):
                 # per-peer resilience state: breaker state, EMA
                 # latency, consecutive failures, last error — the

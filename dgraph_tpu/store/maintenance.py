@@ -36,6 +36,7 @@ import threading
 import time
 from dataclasses import dataclass, field
 
+from dgraph_tpu.utils import locks
 from dgraph_tpu.utils import logging as xlog
 from dgraph_tpu.utils import tracing
 from dgraph_tpu.utils.metrics import METRICS
@@ -87,7 +88,7 @@ class MaintenanceScheduler:
         self._log = xlog.get("maintenance")
         self._queue: list[Job] = []
         self._seq = 0
-        self._cv = threading.Condition()
+        self._cv = locks.make_condition("maintenance.cv")
         self._resume = threading.Event()
         self._resume.set()              # not paused
         self._stop = False

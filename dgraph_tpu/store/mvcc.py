@@ -18,10 +18,10 @@ never the source of truth (SURVEY §5 checkpoint model).
 
 from __future__ import annotations
 
-import threading
 from dataclasses import dataclass, field
 
 from dgraph_tpu.store.schema import Schema
+from dgraph_tpu.utils import locks
 from dgraph_tpu.store.store import TYPE_PRED, Store, StoreBuilder
 from dgraph_tpu.store.types import Kind
 
@@ -127,7 +127,7 @@ class MVCCStore:
     """Versioned posting store: fold-point snapshots + delta layers."""
 
     def __init__(self, base: Store | None = None, base_ts: int = 0):
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("mvcc.store")
         base = base if base is not None else StoreBuilder().finalize()
         # history of fold points, ascending by ts; first entry is the
         # oldest snapshot still reachable by an open reader

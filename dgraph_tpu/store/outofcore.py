@@ -37,6 +37,7 @@ from collections import OrderedDict
 import numpy as np
 
 from dgraph_tpu.store import checkpoint
+from dgraph_tpu.utils import locks
 from dgraph_tpu.store.schema import parse_schema
 from dgraph_tpu.store.store import PredicateData, Store, build_indexes
 
@@ -84,7 +85,7 @@ class LazyPreds:
         self.budget_bytes = budget_bytes
         self._resident: OrderedDict[str, PredicateData] = OrderedDict()
         self._sizes: dict[str, int] = {}
-        self._lock = threading.RLock()
+        self._lock = locks.make_rlock("outofcore.residency")
         self._inflight: dict[str, threading.Event] = {}
         self.resident_bytes = 0
         self.peak_resident_bytes = 0  # high-water mark of resident_bytes

@@ -23,6 +23,8 @@ import os
 import struct
 import threading
 import zlib
+
+from dgraph_tpu.utils import locks
 from typing import Iterator
 
 import numpy as np
@@ -125,7 +127,7 @@ class Journal:
                     f.truncate(valid_end)
                     f.flush()
                     os.fsync(f.fileno())
-        self._wlock = threading.Lock()
+        self._wlock = locks.make_lock("wal.write")
         self._f = open(path, "ab")
         if needs_reseal:
             self._reseal_legacy()

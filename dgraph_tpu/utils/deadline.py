@@ -40,7 +40,17 @@ import time
 from dgraph_tpu.utils.metrics import METRICS
 
 __all__ = ["RequestContext", "DeadlineExceeded", "Cancelled",
-           "current", "activate", "checkpoint", "remaining_s"]
+           "current", "activate", "checkpoint", "remaining_s",
+           "monotonic_s"]
+
+
+def monotonic_s() -> float:
+    """The package's one blessed clock for deadline/backoff/elapsed
+    arithmetic (graftlint R3 wall-clock): NTP steps and DST never move
+    a budget. Wall clock (`time.time`) is reserved for timestamps that
+    leave the process (span epochs, cross-process token expiry) and
+    every such site carries a reasoned waiver."""
+    return time.monotonic()
 
 
 class DeadlineExceeded(Exception):

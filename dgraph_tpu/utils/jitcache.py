@@ -17,14 +17,13 @@ internals; steady-state calls are classified exactly.
 from __future__ import annotations
 
 import contextlib
-import threading
 import time
 
-from dgraph_tpu.utils import tracing
+from dgraph_tpu.utils import locks, tracing
 from dgraph_tpu.utils.metrics import METRICS
 
 _seen: set = set()
-_lock = threading.Lock()
+_lock = locks.make_lock("jitcache.seen")
 
 # compile times ladder: 10ms … 100s in µs
 COMPILE_BUCKETS_US = (10_000, 100_000, 500_000, 1_000_000, 5_000_000,

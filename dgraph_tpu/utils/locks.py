@@ -1,0 +1,301 @@
+"""Lock-order sanitizer: instrumented locks for the whole stack.
+
+Reference parity: the reference leans on `go test -race` to keep its
+heavily-threaded worker/zero/posting layers honest; Python has no race
+detector, but the failure mode our ~17 lock sites can actually produce
+is a lock-ORDER inversion (thread 1 takes A then B, thread 2 takes B
+then A — a deadlock that only fires under the right interleaving, i.e.
+in production). This module is the dynamic half of graftlint
+(dgraph_tpu/analysis): every lock site in cluster/, store/, server/ and
+utils/ creates its lock through `make_lock(name)` /`make_rlock` /
+`make_condition`, which return plain `threading` primitives in
+production and instrumented wrappers when `DGRAPH_TPU_LOCK_SANITIZER=1`
+(tests/conftest.py arms it for the whole tier-1 suite and the partition
+fuzzer).
+
+What the instrumented wrappers record, per thread, at acquire time:
+
+* **Acquisition-order edges** — when a thread acquires lock B while
+  holding lock A, the edge A→B enters a process-global graph, keyed by
+  lock NAME (every instance created at one site shares a name, so the
+  graph captures the site's order discipline, not object identities).
+  The first sighting of an edge captures the full acquisition stack;
+  `LockGraph.cycles()` then reports every order cycle with the stack of
+  EACH participating edge — both sides of an inversion, not just the
+  one that happened to deadlock.
+* **Hold times** — a lock held longer than `DGRAPH_TPU_LOCK_HOLD_MS`
+  (default 250) is recorded with its release-site stack; long holds are
+  surfaced (`/debug/locks`, `snapshot()`), never failed on — a WAL
+  fsync under io pressure is information, not a bug.
+
+Design constraints: this module imports NOTHING from dgraph_tpu
+(metrics/tracing create their registries' locks through it — any
+upward import would cycle), and the instrumented fast path never calls
+back into metrics (releasing the metrics registry's own traced lock
+must not recurse into the registry). Reentrant acquisition of the same
+instance (RLock) records no self-edge; same-name edges between distinct
+instances are skipped too — instances of one site form one order class.
+
+Caveat (documented, accepted): `threading.Lock` allows releasing from a
+different thread than the acquirer; the sanitizer pops by identity and
+ignores an unmatched release, so cross-thread hand-offs degrade to
+unrecorded holds instead of corrupting the graph.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+import traceback
+
+__all__ = ["enabled", "make_lock", "make_rlock", "make_condition",
+           "GRAPH", "LockGraph", "TracedLock", "TracedRLock",
+           "set_enabled"]
+
+ENV_SWITCH = "DGRAPH_TPU_LOCK_SANITIZER"
+ENV_HOLD_MS = "DGRAPH_TPU_LOCK_HOLD_MS"
+MAX_LONG_HOLDS = 64          # bounded report ring — newest wins
+_STACK_SKIP = 2              # drop the sanitizer's own frames
+
+
+def enabled() -> bool:
+    """Is the sanitizer armed for NEW locks? (Checked at lock-creation
+    time: flipping the env var mid-process affects locks made after.)"""
+    return os.environ.get(ENV_SWITCH, "") not in ("", "0")
+
+
+def _stack() -> str:
+    return "".join(traceback.format_stack()[:-_STACK_SKIP])
+
+
+class LockGraph:
+    """Process-global acquisition-order graph + long-hold ring.
+
+    Thread-held stacks live in a `threading.local`; the graph structure
+    is guarded by a PLAIN lock (never a traced one — the sanitizer must
+    not sanitize itself) that is only taken on the slow paths: first
+    sighting of an edge, a long hold, a snapshot."""
+
+    def __init__(self, hold_threshold_ms: float | None = None):
+        self._glock = threading.Lock()
+        self._tls = threading.local()
+        if hold_threshold_ms is None:
+            hold_threshold_ms = float(
+                os.environ.get(ENV_HOLD_MS, "") or 250.0)
+        self.hold_threshold_s = hold_threshold_ms / 1e3
+        # (held_name, acquired_name) → {"count", "stack"} — stack is the
+        # first-sighting acquisition stack of the SECOND lock
+        self.edges: dict[tuple[str, str], dict] = {}
+        self.long_holds: list[dict] = []
+        self.acquires = 0            # total instrumented acquisitions
+        self.recording = True
+
+    def set_enabled(self, flag: bool) -> None:
+        """Disarm recording (the <5% overhead guard's off switch).
+        Already-held entries release tolerantly while disarmed."""
+        self.recording = bool(flag)
+
+    # -- hot path ------------------------------------------------------------
+    def _held(self) -> list:
+        h = getattr(self._tls, "held", None)
+        if h is None:
+            h = self._tls.held = []
+        return h
+
+    def note_acquire(self, lock) -> None:
+        """Called AFTER the inner primitive was acquired."""
+        if not self.recording:
+            return
+        held = self._held()
+        self.acquires += 1
+        reentrant = any(e[0] is lock for e in held)
+        if not reentrant and held:
+            seen_names = set()
+            for entry in held:
+                a = entry[0].name
+                b = lock.name
+                if a == b or a in seen_names:
+                    continue
+                seen_names.add(a)
+                key = (a, b)
+                e = self.edges.get(key)   # racy read: fine, edge keys
+                if e is not None:         # are write-once + count bump
+                    e["count"] += 1
+                else:
+                    with self._glock:
+                        if key not in self.edges:
+                            self.edges[key] = {"count": 1,
+                                               "stack": _stack()}
+                        else:
+                            self.edges[key]["count"] += 1
+        held.append((lock, time.monotonic(), reentrant))
+
+    def note_release(self, lock) -> None:
+        held = getattr(self._tls, "held", None)
+        if not held:
+            return
+        for i in range(len(held) - 1, -1, -1):
+            if held[i][0] is lock:
+                _, t0, _reent = held.pop(i)
+                if not self.recording:
+                    return
+                dt = time.monotonic() - t0
+                if dt >= self.hold_threshold_s:
+                    with self._glock:
+                        if len(self.long_holds) >= MAX_LONG_HOLDS:
+                            self.long_holds.pop(0)
+                        self.long_holds.append(
+                            {"lock": lock.name,
+                             "held_ms": round(dt * 1e3, 3),
+                             "stack": _stack()})
+                return
+        # unmatched release (cross-thread hand-off, or recording was
+        # off at acquire time): tolerated, see module docstring
+
+    # -- reporting -----------------------------------------------------------
+    def cycles(self) -> list[dict]:
+        """Every distinct lock-order cycle in the recorded graph, each
+        with the acquisition stack of EVERY participating edge. Empty
+        list == no inversion was ever observed."""
+        with self._glock:
+            edges = {k: dict(v) for k, v in self.edges.items()}
+        adj: dict[str, list[str]] = {}
+        for a, b in edges:
+            adj.setdefault(a, []).append(b)
+        out, seen_cycles = [], set()
+
+        def dfs(node: str, path: list[str], on_path: set):
+            for nxt in adj.get(node, ()):
+                if nxt in on_path:
+                    cyc = path[path.index(nxt):]
+                    key = frozenset(cyc)
+                    if key in seen_cycles:
+                        continue
+                    seen_cycles.add(key)
+                    ring = cyc + [nxt]
+                    out.append({
+                        "cycle": cyc,
+                        "edges": [
+                            {"from": ring[i], "to": ring[i + 1],
+                             "count": edges[(ring[i],
+                                             ring[i + 1])]["count"],
+                             "stack": edges[(ring[i],
+                                             ring[i + 1])]["stack"]}
+                            for i in range(len(cyc))],
+                    })
+                elif nxt not in visited:
+                    visited.add(nxt)
+                    on_path.add(nxt)
+                    dfs(nxt, path + [nxt], on_path)
+                    on_path.discard(nxt)
+
+        visited: set[str] = set()
+        for start in sorted(adj):
+            if start not in visited:
+                visited.add(start)
+                dfs(start, [start], {start})
+        return out
+
+    def snapshot(self) -> dict:
+        """Graph + long-hold state for `/debug/locks` (stacks trimmed
+        to their last line for the edge table; cycles keep full ones)."""
+        with self._glock:
+            edges = [{"from": a, "to": b, "count": e["count"]}
+                     for (a, b), e in sorted(self.edges.items())]
+            holds = list(self.long_holds)
+        return {
+            "enabled": enabled(),
+            "recording": self.recording,
+            "acquires_total": self.acquires,
+            "edges": edges,
+            "cycles": self.cycles(),
+            "long_holds": [{k: v for k, v in h.items() if k != "stack"}
+                           for h in holds],
+            "hold_threshold_ms": self.hold_threshold_s * 1e3,
+        }
+
+    def reset(self) -> None:
+        """Test hook: forget edges and holds (held stacks survive — a
+        reset under live threads must not orphan their releases)."""
+        with self._glock:
+            self.edges.clear()
+            self.long_holds.clear()
+            self.acquires = 0
+
+
+GRAPH = LockGraph()
+
+
+def set_enabled(flag: bool) -> None:
+    GRAPH.set_enabled(flag)
+
+
+class TracedLock:
+    """`threading.Lock` plus order/hold recording. Supports the full
+    acquire signature so `threading.Condition` can wrap it."""
+
+    __slots__ = ("_inner", "name", "_graph")
+    _factory = staticmethod(threading.Lock)
+
+    def __init__(self, name: str, graph: LockGraph | None = None):
+        self._inner = self._factory()
+        self.name = name
+        self._graph = graph if graph is not None else GRAPH
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            self._graph.note_acquire(self)
+        return ok
+
+    def release(self) -> None:
+        self._graph.note_release(self)
+        self._inner.release()
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __repr__(self) -> str:
+        return f"<{type(self).__name__} {self.name!r} {self._inner!r}>"
+
+
+class TracedRLock(TracedLock):
+    """Reentrant flavor: nested acquisition by the owner records no
+    self-edge (note_acquire detects the instance already on the held
+    stack) and hold time measures the OUTERMOST span."""
+
+    __slots__ = ()
+    _factory = staticmethod(threading.RLock)
+
+    def locked(self) -> bool:  # RLock has no locked() before 3.12
+        if self._inner.acquire(False):
+            self._inner.release()
+            return False
+        return True
+
+
+def make_lock(name: str) -> "threading.Lock | TracedLock":
+    """The one lock constructor every subsystem uses: a plain
+    `threading.Lock` in production, a `TracedLock` under the sanitizer.
+    `name` is the site's order-class (e.g. "mvcc.store")."""
+    return TracedLock(name) if enabled() else threading.Lock()
+
+
+def make_rlock(name: str) -> "threading.RLock | TracedRLock":
+    return TracedRLock(name) if enabled() else threading.RLock()
+
+
+def make_condition(name: str) -> threading.Condition:
+    """A Condition whose underlying lock participates in the order
+    graph (wait() releases/reacquires through the traced wrapper)."""
+    if enabled():
+        return threading.Condition(TracedLock(name))
+    return threading.Condition()

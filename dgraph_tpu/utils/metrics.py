@@ -26,7 +26,7 @@ overflow.
 
 from __future__ import annotations
 
-import threading
+from dgraph_tpu.utils import locks
 
 # standard µs latency ladder: 100µs … 10s, then +Inf
 BUCKETS_US = (100, 1_000, 10_000, 100_000, 1_000_000, 10_000_000)
@@ -58,7 +58,7 @@ def _series(name: str, lk: tuple, extra: str = "") -> str:
 
 class Registry:
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("metrics.registry")
         self._counters: dict[tuple[str, tuple], float] = {}
         self._gauges: dict[tuple[str, tuple], float] = {}
         self._hists: dict[tuple[str, tuple], list] = {}

@@ -31,12 +31,14 @@ import uuid
 from collections import OrderedDict, deque
 from dataclasses import dataclass, field
 
+from dgraph_tpu.utils import locks
+
 _TRACE_DIR: str | None = None
 _BUF: deque = deque(maxlen=4096)
 _TRACES: "OrderedDict[str, list]" = OrderedDict()
 _MAX_TRACES = 256          # retained per-trace span lists
 _MAX_TRACE_SPANS = 4096    # spans retained per trace
-_LOCK = threading.Lock()
+_LOCK = locks.make_lock("tracing.registry")
 _TLS = threading.local()
 _IDS = itertools.count(1)  # CPython: count.__next__ is atomic
 _ENABLED = True
@@ -125,6 +127,8 @@ def span(name: str, device: bool = False, **attrs):
     s = Span(name=name, span_id=sid,
              parent_id=stack[-1] if stack else 0,
              trace_id=getattr(_TLS, "trace_id", ""),
+             # graftlint: allow(wall-clock): span start is an EPOCH timestamp —
+             # Perfetto/OTLP exports align traces across processes by wall clock
              start_us=int(time.time() * 1e6),
              tid=threading.get_ident(), attrs=attrs)
     stack.append(sid)

@@ -7,6 +7,15 @@ anywhere in the test process.
 
 import os
 
+import pytest
+
+# Arm the lock-order sanitizer (utils/locks.py) for the WHOLE suite —
+# the `go test -race` analog: every subsystem lock created after this
+# point is instrumented, and the session gate below fails the run if
+# any lock-order cycle was observed anywhere. Must be set before any
+# dgraph_tpu module creates its registry locks at import time.
+os.environ.setdefault("DGRAPH_TPU_LOCK_SANITIZER", "1")
+
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
@@ -26,3 +35,20 @@ assert jax.device_count() >= 8, "virtual device mesh failed to initialise"
 if os.environ.get("DGRAPH_TPU_DEBUG_CHECKS") == "1":
     jax.config.update("jax_debug_nans", True)
     jax.config.update("jax_enable_checks", True)
+
+
+@pytest.fixture(autouse=True, scope="session")
+def _lock_order_session_gate():
+    """Session-wide lock-order gate: after the LAST test, the global
+    acquisition graph must be acyclic. A cycle here means two real
+    subsystem locks were taken in opposite orders somewhere in the
+    suite — a deadlock waiting for the right interleaving."""
+    yield
+    from dgraph_tpu.utils import locks
+    cycles = locks.GRAPH.cycles()
+    assert not cycles, (
+        "lock-order cycle(s) observed during the test session:\n"
+        + "\n".join(
+            " -> ".join(c["cycle"] + [c["cycle"][0]])
+            + "\n" + "\n".join(e["stack"] for e in c["edges"])
+            for c in cycles))

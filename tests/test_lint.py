@@ -1,0 +1,339 @@
+"""graftlint acceptance: the analyzer itself, and the package under it.
+
+Reference parity: the reference wires `go vet` + custom analyzers into
+CI so invariant drift fails the build. Tier-1 here runs graftlint
+(dgraph_tpu/analysis) over the WHOLE package: any unwaived finding —
+a hot loop that dropped its deadline checkpoint, a bare gRPC channel, a
+wall-clock deadline, a retry loop that re-spends expired budgets, an
+undocumented metric, an impure jit function — fails this file. The
+synthetic-fixture tests pin each rule's detection and the waiver
+grammar so a refactor of the analyzer can't silently blind a rule.
+"""
+
+import functools
+import json
+import pathlib
+import subprocess
+import sys
+
+from dgraph_tpu.analysis import Analyzer
+from dgraph_tpu.analysis import run as _run
+from dgraph_tpu.analysis.rules import default_rules
+
+ROOT = pathlib.Path(__file__).resolve().parents[1]
+
+
+@functools.lru_cache(maxsize=1)
+def _package_run():
+    return _run(ROOT)
+
+
+def run(_root=None):  # one shared scan for the whole module
+    return _package_run()
+
+
+def scan(rel: str, source: str, readme: str = "") -> Analyzer:
+    """Run the full rule set over one in-memory file."""
+    a = Analyzer(rules=default_rules(), repo_root=ROOT,
+                 readme_text=readme)
+    a.add_source(rel, source)
+    a.finish()
+    return a
+
+
+def rules_of(a: Analyzer, waived: bool = False) -> set[str]:
+    return {f.rule for f in a.findings if f.waived == waived}
+
+
+# ---------------------------------------------------------------------------
+# the acceptance gate: the real package is clean
+
+def test_package_has_zero_unwaived_findings():
+    """THE build gate: `python -m dgraph_tpu.analysis` over the whole
+    package + bench.py must be clean. Fix the finding or waive it with
+    `# graftlint: allow(<rule>): <reason>` — the failure message below
+    is exactly the analyzer's own report."""
+    a = run(ROOT)
+    bad = a.unwaived()
+    assert not bad, "graftlint findings:\n" + "\n".join(
+        f.format() for f in bad)
+
+
+def test_every_waiver_carries_a_reason():
+    """A waiver without a reason is itself a finding (waiver-syntax),
+    so this is implied by the gate above — asserted separately so the
+    contract survives a refactor of the gate test."""
+    a = run(ROOT)
+    naked = [f for f in a.findings if f.rule == "waiver-syntax"]
+    assert not naked, "\n".join(f.format() for f in naked)
+    # and the waivers that do exist were actually consumed with reasons
+    waived = [f for f in a.findings if f.waived]
+    assert all(f.reason for f in waived)
+    assert waived, "expected the package's documented waivers to exist"
+
+
+def test_metric_scan_not_blind():
+    """Migrated from test_metrics.py's doc-lint: the R5 name scan must
+    keep seeing the registry traffic — a refactor that breaks the AST
+    match would silently pass an empty README check."""
+    a = run(ROOT)
+    names = {m["name"] for m in a.facts["metric_sites"]}
+    assert len(names) > 30, "metric scan went blind — check the rule"
+
+
+def test_facts_inventory_shapes():
+    """The cost-model feedstock: kernels with their static (retrace)
+    axes, launch sites, span vocabulary, lock order classes."""
+    a = run(ROOT)
+    t = a.facts["totals"]
+    assert t["kernels"] >= 10
+    assert t["span_names"] >= 15
+    assert t["lock_classes"] >= 15
+    names = {k["name"] for k in a.facts["kernels"]}
+    assert {"bitmap_hop", "bitmap_recurse"} <= names
+    ladder = {x["name"] for x in a.facts["lock_classes"]}
+    assert {"metrics.registry", "mvcc.store", "wal.write"} <= ladder
+
+
+def test_cli_json_runs_clean():
+    out = subprocess.run(
+        [sys.executable, "-m", "dgraph_tpu.analysis", "--format=json"],
+        capture_output=True, text=True, cwd=ROOT)
+    assert out.returncode == 0, out.stdout + out.stderr
+    doc = json.loads(out.stdout)
+    assert doc["findings"] == []
+    assert sum(doc["counts"]["waived"].values()) >= 10
+    assert doc["facts"]["totals"]["kernels"] >= 10
+
+
+# ---------------------------------------------------------------------------
+# R1 hot-loop-checkpoint
+
+R1_HOT = """\
+def pump(frontier):
+    while frontier:
+        frontier = expand(frontier)
+"""
+
+R1_OK = """\
+from dgraph_tpu.utils import deadline
+def pump(frontier):
+    while frontier:
+        deadline.checkpoint("hop")
+        frontier = expand(frontier)
+"""
+
+
+def test_r1_fires_on_uncheckpointed_while_in_engine():
+    a = scan("dgraph_tpu/engine/fake.py", R1_HOT)
+    assert "hot-loop-checkpoint" in rules_of(a)
+
+
+def test_r1_satisfied_by_checkpoint_call():
+    a = scan("dgraph_tpu/engine/fake.py", R1_OK)
+    assert "hot-loop-checkpoint" not in rules_of(a)
+
+
+def test_r1_scoped_to_hot_dirs():
+    a = scan("dgraph_tpu/store/fake.py", R1_HOT)
+    assert "hot-loop-checkpoint" not in rules_of(a)
+
+
+def test_r1_waiver_suppresses_and_is_reported_waived():
+    src = ("def pump(f):\n"
+           "    # graftlint: allow(hot-loop-checkpoint): bounded by f\n"
+           "    while f:\n"
+           "        f = step(f)\n")
+    a = scan("dgraph_tpu/ops/fake.py", src)
+    assert "hot-loop-checkpoint" not in rules_of(a)
+    assert "hot-loop-checkpoint" in rules_of(a, waived=True)
+    (w,) = [f for f in a.findings if f.waived]
+    assert w.reason == "bounded by f"
+
+
+def test_reasonless_waiver_is_a_finding_and_does_not_waive():
+    src = ("def pump(f):\n"
+           "    while f:  # graftlint: allow(hot-loop-checkpoint)\n"
+           "        f = step(f)\n")
+    a = scan("dgraph_tpu/engine/fake.py", src)
+    assert "hot-loop-checkpoint" in rules_of(a)       # NOT waived
+    assert "waiver-syntax" in rules_of(a)             # and flagged
+
+
+# ---------------------------------------------------------------------------
+# R2 direct-io
+
+def test_r2_flags_bare_channel_and_socket():
+    src = ("import grpc, socket\n"
+           "ch = grpc.insecure_channel('h:1')\n"
+           "s = socket.create_connection(('h', 1))\n")
+    a = scan("dgraph_tpu/cluster/fake.py", src)
+    assert sum(1 for f in a.findings
+               if f.rule == "direct-io" and not f.waived) == 2
+
+
+def test_r2_allows_the_wrapper_module():
+    src = "import grpc\nch = grpc.insecure_channel('h:1')\n"
+    a = scan("dgraph_tpu/server/task.py", src)
+    assert "direct-io" not in rules_of(a)
+
+
+# ---------------------------------------------------------------------------
+# R3 wall-clock
+
+def test_r3_flags_time_time_and_waiver_reaches_multiline_stmt():
+    src = ("import time\n"
+           "def exp():\n"
+           "    return time.time() + 60\n")
+    a = scan("dgraph_tpu/server/fake.py", src)
+    assert "wall-clock" in rules_of(a)
+    src_waived = ("import time\n"
+                  "def exp():\n"
+                  "    # graftlint: allow(wall-clock): crosses procs\n"
+                  "    return dict(a=1,\n"
+                  "                b=time.time() + 60)\n")
+    a = scan("dgraph_tpu/server/fake.py", src_waived)
+    assert "wall-clock" not in rules_of(a)
+    assert "wall-clock" in rules_of(a, waived=True)
+
+
+def test_r3_does_not_flag_monotonic():
+    src = "import time\nt0 = time.monotonic()\n"
+    a = scan("dgraph_tpu/server/fake.py", src)
+    assert "wall-clock" not in rules_of(a)
+
+
+# ---------------------------------------------------------------------------
+# R4 retry-deadline
+
+R4_BAD = """\
+import time, grpc
+def call(fn):
+    for i in range(3):
+        try:
+            return fn()
+        except grpc.RpcError:
+            time.sleep(0.1)
+"""
+
+R4_GOOD = """\
+import time, grpc
+def call(fn):
+    for i in range(3):
+        try:
+            return fn()
+        except grpc.RpcError as e:
+            if e.code() == grpc.StatusCode.DEADLINE_EXCEEDED:
+                raise
+            time.sleep(0.1)
+"""
+
+R4_SPECIFIC = """\
+import time
+def call(fn):
+    for i in range(3):
+        try:
+            return fn()
+        except TxnAborted:
+            time.sleep(0.1)
+"""
+
+
+def test_r4_flags_broad_retry_without_deadline_exclusion():
+    a = scan("dgraph_tpu/cluster/fake.py", R4_BAD)
+    assert "retry-deadline" in rules_of(a)
+
+
+def test_r4_passes_with_deadline_exclusion():
+    a = scan("dgraph_tpu/cluster/fake.py", R4_GOOD)
+    assert "retry-deadline" not in rules_of(a)
+
+
+def test_r4_ignores_specific_exception_retries():
+    a = scan("dgraph_tpu/cluster/fake.py", R4_SPECIFIC)
+    assert "retry-deadline" not in rules_of(a)
+
+
+# ---------------------------------------------------------------------------
+# R5 metric-docs (the migrated doc-lint)
+
+def test_r5_requires_readme_row_with_original_message():
+    src = 'METRICS.inc("brand_new_total", lane="read")\n'
+    a = scan("dgraph_tpu/server/fake.py", src, readme="nothing here")
+    (f,) = [x for x in a.findings if x.rule == "metric-docs"
+            and x.path == "README.md"]
+    # the PR-4 doc-lint's exact message shape, preserved
+    assert "emitted but undocumented in README" in f.msg
+    assert "brand_new_total" in f.msg
+
+
+def test_r5_satisfied_by_backticked_row():
+    src = 'METRICS.inc("brand_new_total")\n'
+    readme = ("| `brand_new_total` | counts new things |\n"
+              "| `metrics_series_dropped_total` | overflow |\n")
+    a = scan("dgraph_tpu/server/fake.py", src, readme=readme)
+    assert not [x for x in a.findings if x.path == "README.md"]
+
+
+def test_r5_flags_dynamic_name_and_label_splat():
+    src = ('name = "x_total"\n'
+           'METRICS.inc(name)\n'
+           'METRICS.observe("lat_us", 1.0, **labels)\n')
+    a = scan("dgraph_tpu/server/fake.py", src,
+             readme="`lat_us` `metrics_series_dropped_total`")
+    msgs = [f.msg for f in a.findings if f.rule == "metric-docs"]
+    assert any("string literal" in m for m in msgs)
+    assert any("**label" in m for m in msgs)
+
+
+# ---------------------------------------------------------------------------
+# R6 jit-purity
+
+def test_r6_flags_item_and_numpy_in_decorated_jit():
+    src = ("import jax, numpy as np\n"
+           "@jax.jit\n"
+           "def k(x):\n"
+           "    n = x.sum().item()\n"
+           "    return np.asarray(x) + n\n")
+    a = scan("dgraph_tpu/ops/fake.py", src)
+    msgs = [f.msg for f in a.findings if f.rule == "jit-purity"]
+    assert any(".item()" in m for m in msgs)
+    assert any("np.asarray" in m for m in msgs)
+
+
+def test_r6_flags_branch_on_tracer_but_not_static_or_none():
+    src = ("import functools, jax\n"
+           "@functools.partial(jax.jit, static_argnames=('depth',))\n"
+           "def k(x, depth, mask=None):\n"
+           "    if depth > 2:\n"
+           "        x = x + 1\n"
+           "    if mask is None:\n"
+           "        mask = x\n"
+           "    if x > 0:\n"
+           "        return mask\n"
+           "    return x\n")
+    a = scan("dgraph_tpu/ops/fake.py", src)
+    finds = [f for f in a.findings if f.rule == "jit-purity"]
+    assert len(finds) == 1 and "'x'" in finds[0].msg
+
+
+def test_r6_covers_closure_passed_to_jax_jit():
+    src = ("import jax\n"
+           "def build(cap):\n"
+           "    def fn(x):\n"
+           "        return x.tolist()\n"
+           "    return jax.jit(fn)\n")
+    a = scan("dgraph_tpu/parallel/fake.py", src)
+    assert any(".tolist()" in f.msg for f in a.findings
+               if f.rule == "jit-purity")
+
+
+def test_r6_shape_and_len_branches_are_static():
+    src = ("import jax\n"
+           "@jax.jit\n"
+           "def k(x):\n"
+           "    if x.shape[0] > 4 and len(x) > 4:\n"
+           "        return x + 1\n"
+           "    return x\n")
+    a = scan("dgraph_tpu/ops/fake.py", src)
+    assert "jit-purity" not in rules_of(a)

@@ -1,0 +1,252 @@
+"""Lock-order sanitizer acceptance (utils/locks.py).
+
+Reference parity: the role `go test -race` plays in the reference's CI
+— tier-1 runs the whole suite with every subsystem lock instrumented
+(conftest.py arms DGRAPH_TPU_LOCK_SANITIZER), and the session-level
+gate plus the fuzz smokes assert the acquisition graph stays acyclic.
+This file pins the detector itself: a synthetic two-lock inversion is
+reported with BOTH acquisition stacks, clean nesting is not flagged,
+and the instrumentation stays inside the same <5% hot-query-path
+budget the tracing/metrics layers are held to.
+"""
+
+import threading
+import time
+
+import numpy as np
+
+from dgraph_tpu.utils import locks
+from dgraph_tpu.utils.locks import (GRAPH, LockGraph, TracedLock,
+                                    TracedRLock)
+
+
+def _own(hold_ms: float = 10_000.0) -> LockGraph:
+    """A private graph so synthetic inversions never pollute the
+    process-global one the session gate asserts on."""
+    return LockGraph(hold_threshold_ms=hold_ms)
+
+
+# ---------------------------------------------------------------------------
+# detection
+
+def test_two_lock_inversion_detected_with_both_stacks():
+    g = _own()
+    a, b = TracedLock("A", g), TracedLock("B", g)
+
+    with a:
+        with b:
+            pass
+
+    def inverted():
+        with b:
+            with a:
+                pass
+
+    t = threading.Thread(target=inverted)
+    t.start()
+    t.join()
+
+    (cyc,) = g.cycles()
+    assert sorted(cyc["cycle"]) == ["A", "B"]
+    assert len(cyc["edges"]) == 2
+    froms = {e["from"] for e in cyc["edges"]}
+    assert froms == {"A", "B"}
+    for e in cyc["edges"]:
+        # each side of the inversion carries ITS acquisition stack
+        assert "test_locks.py" in e["stack"]
+    by_from = {e["from"]: e["stack"] for e in cyc["edges"]}
+    assert "inverted" in by_from["B"]        # B→A taken in the thread
+    assert "inverted" not in by_from["A"]    # A→B taken on the main one
+
+
+def test_clean_nested_acquisition_not_flagged():
+    g = _own()
+    a, b, c = (TracedLock(n, g) for n in "abc")
+    for _ in range(50):
+        with a:
+            with b:
+                with c:
+                    pass
+        with a:
+            pass
+        with c:  # c alone after a→b→c: order still consistent
+            pass
+    assert g.cycles() == []
+    assert {("a", "b"), ("b", "c"), ("a", "c")} == set(g.edges)
+
+
+def test_transitive_cycle_across_three_threads():
+    g = _own()
+    a, b, c = (TracedLock(n, g) for n in "abc")
+    legs = [(a, b), (b, c), (c, a)]
+
+    def leg(outer, inner):
+        with outer:
+            with inner:
+                pass
+
+    for outer, inner in legs:
+        t = threading.Thread(target=leg, args=(outer, inner))
+        t.start()
+        t.join()
+    (cyc,) = g.cycles()
+    assert sorted(cyc["cycle"]) == ["a", "b", "c"]
+    assert len(cyc["edges"]) == 3
+
+
+def test_rlock_reentrancy_records_no_self_edge():
+    g = _own()
+    r = TracedRLock("R", g)
+    with r:
+        with r:
+            with r:
+                pass
+    assert g.edges == {} and g.cycles() == []
+
+
+def test_same_name_instances_form_one_order_class():
+    """Two instances created at one site (e.g. xidmap's 16 shard
+    locks) share a name; nesting them records no self-edge."""
+    g = _own()
+    s1, s2 = TracedLock("xid.shard", g), TracedLock("xid.shard", g)
+    with s1:
+        with s2:
+            pass
+    assert g.edges == {} and g.cycles() == []
+
+
+def test_condition_wait_participates_in_order_graph():
+    g = _own()
+    outer = TracedLock("outer", g)
+    cv = threading.Condition(TracedLock("cv", g))
+    fired = []
+
+    def waiter():
+        with cv:
+            while not fired:
+                cv.wait(timeout=5.0)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.05)
+    with outer:
+        with cv:
+            fired.append(1)
+            cv.notify()
+    t.join(timeout=5.0)
+    assert not t.is_alive()
+    assert ("outer", "cv") in g.edges
+    assert g.cycles() == []
+
+
+def test_long_hold_recorded_with_stack():
+    g = _own(hold_ms=20.0)
+    slow = TracedLock("slow", g)
+    with slow:
+        time.sleep(0.05)
+    (h,) = g.long_holds
+    assert h["lock"] == "slow" and h["held_ms"] >= 20.0
+    assert "test_locks.py" in h["stack"]
+    assert g.snapshot()["long_holds"][0]["lock"] == "slow"
+
+
+def test_unmatched_release_tolerated():
+    """Recording toggled off at acquire time must not corrupt the
+    graph when the release comes after it is back on."""
+    g = _own()
+    a = TracedLock("a", g)
+    g.set_enabled(False)
+    a.acquire()
+    g.set_enabled(True)
+    a.release()          # no held entry: ignored, no exception
+    assert g.edges == {}
+
+
+# ---------------------------------------------------------------------------
+# wiring
+
+def test_factories_return_plain_primitives_when_disabled(monkeypatch):
+    monkeypatch.delenv(locks.ENV_SWITCH, raising=False)
+    assert not locks.enabled()
+    assert isinstance(locks.make_lock("x"), type(threading.Lock()))
+    assert isinstance(locks.make_condition("x"), threading.Condition)
+    monkeypatch.setenv(locks.ENV_SWITCH, "1")
+    assert isinstance(locks.make_lock("x"), TracedLock)
+    assert isinstance(locks.make_rlock("x"), TracedRLock)
+
+
+def test_tier1_runs_instrumented_and_acyclic():
+    """The acceptance contract: conftest arms the sanitizer for the
+    whole suite, the subsystem locks flow through it, and no
+    lock-order cycle was observed anywhere so far."""
+    assert locks.enabled(), "conftest must arm DGRAPH_TPU_LOCK_SANITIZER"
+    from dgraph_tpu.utils.metrics import METRICS
+    METRICS.render()  # touches the (instrumented) registry lock
+    assert GRAPH.acquires > 0, "subsystem locks are not instrumented"
+    assert isinstance(METRICS._lock, TracedLock)
+    cyc = GRAPH.cycles()
+    assert not cyc, f"lock-order cycles in the live system: {cyc}"
+
+
+def test_debug_snapshot_shape():
+    snap = GRAPH.snapshot()
+    assert snap["enabled"] and "edges" in snap and "cycles" in snap
+    assert snap["acquires_total"] == GRAPH.acquires
+
+
+# ---------------------------------------------------------------------------
+# overhead: same bar, same method as test_tracing.py's guard
+
+def _hot_loop_secs(engine, queries, reps):
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        for q in queries:
+            engine.query(q)
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def test_query_path_overhead_under_5_percent():
+    """Instrumented locks (the tier-1 default) must stay within 5% of
+    the same query hot loop with graph recording disarmed — mirrors
+    test_tracing.py's observability guard: interleaved best-of ratios
+    so one noisy scheduling quantum can't fail tier-1."""
+    from dgraph_tpu.engine import Engine
+    from dgraph_tpu.store import StoreBuilder, parse_schema
+
+    rng = np.random.default_rng(13)
+    n = 512
+    b = StoreBuilder(parse_schema(
+        "name: string @index(exact) .\n"
+        "score: int @index(int) .\nfriend: [uid] @reverse ."))
+    for i in range(1, n + 1):
+        b.add_value(i, "name", f"p{i}")
+        b.add_value(i, "score", i % 17)
+        for j in rng.integers(1, n + 1, 4):
+            b.add_edge(i, "friend", int(j))
+    store = b.finalize()
+    engine = Engine(store, device_threshold=10**9)
+    queries = [
+        '{ q(func: ge(score, 8)) { name friend { name score } } }',
+        '{ q(func: has(friend), first: 20) { name friend { friend '
+        '{ name } } } }',
+    ]
+    for q in queries:
+        engine.query(q)
+
+    best_ratio = float("inf")
+    try:
+        for _attempt in range(3):
+            locks.set_enabled(False)
+            off = _hot_loop_secs(engine, queries, reps=5)
+            locks.set_enabled(True)
+            on = _hot_loop_secs(engine, queries, reps=5)
+            best_ratio = min(best_ratio, on / off)
+            if best_ratio <= 1.05:
+                break
+    finally:
+        locks.set_enabled(True)
+    assert best_ratio <= 1.05, (
+        f"lock sanitizer overhead {best_ratio:.3f}x exceeds the 5% "
+        f"budget on the hot query path")

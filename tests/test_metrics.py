@@ -236,24 +236,19 @@ def test_every_emitted_metric_name_is_documented():
     """Every metric NAME the source emits through the registry must
     appear (backticked) in README's observability table — a new
     counter nobody documented is invisible to operators until an
-    incident. Scans every `METRICS.inc/observe/set_gauge("name"...)`
-    literal under dgraph_tpu/ and bench.py plus the registry's own
-    DROPPED_SERIES constant."""
+    incident. MIGRATED: the scan is now graftlint's R5 metric-docs
+    rule (dgraph_tpu/analysis/rules.py) — one AST pass shared with
+    `python -m dgraph_tpu.analysis` and tests/test_lint.py; this test
+    keeps the historical failure message and the blind-scan guard."""
     import pathlib
 
-    from dgraph_tpu.utils.metrics import DROPPED_SERIES
+    from dgraph_tpu.analysis import run
 
     root = pathlib.Path(__file__).resolve().parents[1]
-    call = re.compile(
-        r'METRICS\.(?:inc|observe|set_gauge)\(\s*"([a-z][a-z0-9_]*)"')
-    names = {DROPPED_SERIES}
-    sources = list((root / "dgraph_tpu").rglob("*.py"))
-    sources.append(root / "bench.py")
-    for p in sources:
-        names |= set(call.findall(p.read_text()))
-    assert len(names) > 30, "metric scan went blind — check the regex"
-    readme = (root / "README.md").read_text()
-    missing = sorted(n for n in names if f"`{n}" not in readme)
-    assert not missing, (
-        f"metric name(s) emitted but undocumented in README's "
-        f"observability table: {missing}")
+    a = run(root)
+    names = {m["name"] for m in a.facts["metric_sites"]}
+    assert len(names) > 30, "metric scan went blind — check the rule"
+    missing = [f for f in a.findings
+               if f.rule == "metric-docs" and f.path == "README.md"
+               and not f.waived]
+    assert not missing, missing[0].msg

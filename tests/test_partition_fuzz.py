@@ -188,8 +188,15 @@ def _run_fuzz(bank_trio, iters, base_seed):
 
 
 def test_partition_fuzz_smoke(bank_trio):
-    """Tier-1 smoke: 10 seeded iterations."""
+    """Tier-1 smoke: 10 seeded iterations. The instrumented-lock graph
+    (conftest arms the sanitizer) must stay acyclic across every
+    historical seed — partitions/heals exercise the cluster legs'
+    lock nesting harder than any directed test."""
+    from dgraph_tpu.utils import locks
     _run_fuzz(bank_trio, 10, base_seed=1000)
+    assert locks.enabled(), "fuzz smoke must run instrumented"
+    cycles = locks.GRAPH.cycles()
+    assert not cycles, f"lock-order cycle(s) under partition fuzz: {cycles}"
 
 
 def test_election_counters_visible():
@@ -538,6 +545,10 @@ def test_crash_restart_fuzz_schedule(bank_trio):
                                                wal_trunc=True,
                                                deadline=True).events)
     _run_crash_fuzz(bank_trio, seeds)
+    # crash/restart churn must not surface a lock-order inversion either
+    from dgraph_tpu.utils import locks
+    cycles = locks.GRAPH.cycles()
+    assert not cycles, f"lock-order cycle(s) under crash fuzz: {cycles}"
 
 
 @pytest.mark.slow
