@@ -12,6 +12,16 @@ edges weigh 1 for these bounds.
 The hop loop is the same batched CSR expansion as everything else; parent
 pointers are kept host-side (path reconstruction is inherently sequential
 and tiny).
+
+Batch serving: UNWEIGHTED shortest blocks (the IC13/IC14 shapes) also
+ride the lane-BFS kernel path — engine/batch.py packs compatible
+queries into mask lanes, runs the staged first-visit (or level-DAG, for
+numpaths > 1) kernel, and reconstructs each lane's paths by walking the
+found levels backward over the reverse CSR. That reconstruction pins
+THIS module's semantics bit-for-bit (parent-list order = ascending
+frontier rank, level-order path enumeration, simple-path exclusion,
+min/maxweight counting) — tests/test_batch.py asserts the two paths
+byte-identical, so behavior changes here must update both.
 """
 
 from __future__ import annotations

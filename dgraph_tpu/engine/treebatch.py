@@ -408,16 +408,18 @@ def _pack_global(n: int, rank_lists, lanes: int) -> np.ndarray:
 
 def _tree_kernel_for(store, plan: TreePlan, rels, n: int, W: int):
     """Compiled tree kernel per (snapshot, signature, lane width); device
-    ELL blocks and permutation vectors shared across signatures."""
+    ELL blocks (DeviceEll, via the shared batch cache) and permutation
+    vectors shared across signatures."""
     import jax
 
-    from dgraph_tpu.engine.batch import _cache_host, _cache_lock
-    from dgraph_tpu.ops.bfs import _prepare_buckets, make_ell_tree
+    from dgraph_tpu.engine.batch import _cache_host, _cache_lock, _dev_for
+    from dgraph_tpu.ops.bfs import make_ell_tree, prepare_parts
     from dgraph_tpu.ops.pallas_hop import pallas_enabled
 
     hosts = {_cache_host(store, a, r) for a, r in rels}
     host = hosts.pop() if len(hosts) == 1 else store
     key = (plan.sig, W, pallas_enabled())
+    devells = {rkey: _dev_for(store, *rkey)[1] for rkey in rels}
     with _cache_lock:
         fns = getattr(host, "_tree_fns", None)
         if fns is None:
@@ -436,21 +438,18 @@ def _tree_kernel_for(store, plan: TreePlan, rels, n: int, W: int):
                     [g.perm_order, [n]]).astype(np.int32)
                 out_idx = np.concatenate(
                     [g.new_of_old, [n]]).astype(np.int32)
-                devs[rkey] = ([jax.device_put(e) for e in g.ells],
-                              jax.device_put(perm_in),
+                devs[rkey] = (jax.device_put(perm_in),
                               jax.device_put(out_idx))
-            # XLA chunking depends on lane width; the pallas row padding
-            # does not — one prepped copy serves every W under the flag
-            pkey = ((rkey, "pallas") if pallas_enabled()
-                    else (rkey, W))
+            # prepare_parts is width-independent on the XLA path and the
+            # pallas row padding is too — one prepped copy per flag state
+            pkey = (rkey, pallas_enabled())
             if pkey not in prep:
-                prep[pkey] = _prepare_buckets(devs[rkey][0], g.n, W)
+                prep[pkey] = prepare_parts(devells[rkey], W)
         stage_descs = []
         for s in plan.stages:
             rkey_s = (s.attr, s.reverse)
-            _ells, perm_in, out_idx = devs[rkey_s]
-            prepared = prep[(rkey_s, "pallas") if pallas_enabled()
-                            else (rkey_s, W)]
+            perm_in, out_idx = devs[rkey_s]
+            prepared = prep[(rkey_s, pallas_enabled())]
             stage_descs.append({
                 "kind": s.kind, "prepared": prepared, "perm_in": perm_in,
                 "out_idx": out_idx, "parent": s.parent,

@@ -18,11 +18,6 @@ access count, not bytes (measured ~8 ns/access on v5e regardless of row
 width), so widening each access to a B-byte lane row amortises the
 irregular-memory tax across B queries — the same shape the reference can't
 reach because its per-query goroutines share nothing.
-
-Per-query edges-traversed counts (the north-star metric) fall out of a
-`deg · mask` matmul on the MXU. Counts are exact while a single hop
-traverses < 2^24 edges per query (f32 mantissa); the int32 accumulator is
-exact to 2^31 total.
 """
 
 from __future__ import annotations
@@ -36,7 +31,9 @@ from jax import lax
 
 __all__ = ["ranks_to_bitmap", "bitmap_to_ranks", "bitmap_hop",
            "bitmap_recurse", "EllGraph", "build_ell", "ell_recurse",
-           "make_ell_tree", "pack_seed_masks", "unpack_masks"]
+           "DeviceEll", "device_ell", "prepare_parts", "make_ell_recurse",
+           "make_ell_step", "make_ell_count", "make_ell_tree",
+           "pack_seed_masks", "unpack_masks"]
 
 
 def ranks_to_bitmap(rank_lists, n_nodes: int) -> jnp.ndarray:
@@ -99,32 +96,55 @@ def bitmap_recurse(src: jax.Array, dst: jax.Array, deg: jax.Array,
 #
 # The push kernel above pays one random row-gather AND one random
 # row-scatter per edge. Measured on v5e, random row access costs ~10 ns
-# REGARDLESS of row width (32 B or 256 B rows: 149 ms vs 181 ms for 16.5M
-# accesses), so the winning shape is: (1) eliminate the scatter entirely by
-# pulling over in-neighbor lists, and (2) amortise each access over as many
-# concurrent queries as fit in the row (bit-packed lanes: W uint32 words =
-# 32·W queries per access). One hop is then pure gathers + bitwise ORs —
-# no scatter, no sort, fully static shapes.
+# REGARDLESS of row width, so the winning shape is: (1) eliminate the
+# scatter entirely by pulling over in-neighbor lists, and (2) amortise each
+# access over as many concurrent queries as fit in the row (bit-packed
+# lanes: W words = word_bits·W queries per access). One hop is then pure
+# gathers + bitwise ORs — no scatter, no sort, fully static shapes.
 #
-# Layout: nodes are RENUMBERED by in-degree bucket (K = 1, 4, 16, ... —
-# first power-of-4 ≥ indeg) so each bucket's output is a contiguous slice
-# and the next-frontier mask is rebuilt by concatenation, not scatter.
-# nbr[b] is [n_b, K_b] int32 of in-neighbors in the permuted space, padded
-# with n (a sentinel all-zero mask row). Reference: this plays codec/'s
-# role of making posting data compact AND the UidPack role of block
-# iteration — but shaped for the MXU/VPU instead of varint decode.
+# Layout (PR 7, FeatGraph-style degree buckets): nodes are RENUMBERED by
+# in-degree class so each class's output is a contiguous slice and the
+# next-frontier mask is rebuilt by concatenation, not scatter. Two kernel
+# templates:
+#   * dense-lane ELL for the low-degree body (indeg ≤ SEG_MIN_DEG): one
+#     [n_b, K] int32 block per EXACT degree K — zero padding — evaluated
+#     as an unrolled gather-OR chain (fuses into one pass on CPU, one
+#     VMEM-resident loop on TPU);
+#   * segment-CSR for the heavy tail (indeg > SEG_MIN_DEG): neighbor
+#     lists split into SEG_TILE-wide tiles ([M, SEG_TILE] int32, padded
+#     only in each row's LAST tile), tile partials OR-reduced, then a
+#     tiny second-level gather combines each heavy row's tiles (rows
+#     bucketed by power-of-two tile count).
+# Padding is bounded by SEG_TILE-1 slots per heavy row instead of the old
+# power-of-4 ladder's up-to-4x blowup (BENCH r05: 58% of device edges
+# were ELL padding; this layout measures <5% on the same graph).
+# Reference: this plays codec/'s role of making posting data compact AND
+# the UidPack role of block iteration — shaped for the MXU/VPU.
+
+SEG_MIN_DEG = 32      # dense-lane ELL up to this in-degree; heavier → tiles
+SEG_TILE = 8          # segment-CSR tile width (max padding per heavy row)
+CHAIN_MAX = 32        # widest unrolled gather-OR chain; beyond → reduce
 
 
 @dataclass
 class EllGraph:
-    """In-neighbor ELL blocks over a degree-bucket permuted rank space."""
+    """Degree-bucketed in-neighbor blocks over a permuted rank space.
+
+    `parts` lists the dense-lane blocks in permuted row order:
+    ("zero", None, rows) for the indeg-0 class, ("ell", [rows, K] int32,
+    rows) per present degree K ≤ seg_min. `tiles`/`lvl2` hold the heavy
+    tail's segment-CSR (tile matrix + per-tile-count combine indices);
+    heavy rows sit after all dense rows in the permutation."""
 
     n: int                                  # node count
-    ells: list                              # per-bucket [n_b, K_b] int32
+    parts: list                             # dense blocks, permuted order
+    tiles: object                           # [M, seg_tile] int32 | None
+    lvl2: list                              # [h_b, K2] int32 tile combines
+    seg_rows: int                           # heavy (tail) row count
     outdeg: object                          # [n] f32, permuted space
     perm_order: object                      # new rank -> old rank
     new_of_old: object                      # old rank -> new rank
-    ks: list = field(default_factory=list)  # bucket widths
+    ks: list = field(default_factory=list)  # dense widths present
 
     @property
     def nnz(self) -> int:
@@ -132,136 +152,220 @@ class EllGraph:
 
     @property
     def padded_edges(self) -> int:
-        return sum(int(e.size) for e in self.ells)
+        """Total level-1 gather slots (real edges + padding) — the device
+        edge traffic per hop; `ell_padding_ratio` derives from it."""
+        dense = sum(int(e.size) for kind, e, _ in self.parts
+                    if kind == "ell")
+        return dense + (int(self.tiles.size) if self.tiles is not None
+                        else 0)
 
 
-def build_ell(indptr, indices, bucket_base: int = 4) -> EllGraph:
-    """Build pull-side ELL blocks from a CSR relation (host-side, once per
-    snapshot). `bucket_base` trades padding (lower) against program count
-    (higher): base 4 measured ~2.1x padding on powerlaw graphs."""
+def build_ell(indptr, indices, seg_min: int = SEG_MIN_DEG,
+              seg_tile: int = SEG_TILE) -> EllGraph:
+    """Build the bucketed ELL + segment-CSR blocks from a CSR relation.
+
+    Host-side, once per (snapshot, predicate, direction) — every array is
+    produced by whole-graph vectorized passes (one stable argsort for the
+    CSR transpose plus O(E) fills), not per-node Python loops: the PR-7
+    rewrite took the 1M-node bench build from ~9 s to ~4 s, and the
+    amortization story (engine/batch plan + ELL caches) makes even that a
+    once-per-snapshot cost."""
     import numpy as np
 
     n = indptr.shape[0] - 1
     deg_out = np.diff(indptr).astype(np.int64)
     src = np.repeat(np.arange(n, dtype=np.int32), deg_out)
+    # CSR transpose: in-neighbors grouped by destination, sources
+    # ascending within each group (stable sort keeps src order)
     order = np.argsort(indices, kind="stable")
-    csrc = src[order]                       # in-neighbors grouped by dst
-    cdst = indices[order]
-    cindptr = np.searchsorted(cdst, np.arange(n + 1)).astype(np.int64)
-    indeg = np.diff(cindptr)
+    csrc = src[order]
+    indeg = (np.bincount(indices, minlength=n).astype(np.int64) if n
+             else np.zeros(0, np.int64))
+    cindptr = np.concatenate([[0], np.cumsum(indeg)])
 
-    max_indeg = max(int(indeg.max()), 1) if n else 1
-    ks, k = [], 1
-    # graftlint: allow(hot-loop-checkpoint): O(log max_indeg) ladder
-    while k < max_indeg:
-        ks.append(k)
-        k *= bucket_base
-    ks.append(max(k, 1))
-    ks = sorted(set(ks))
-    bucket_of = np.searchsorted(np.array(ks), indeg)
-    perm_order = np.argsort(bucket_of, kind="stable")
+    small = indeg <= seg_min
+    ks = sorted(int(k) for k in np.unique(indeg[small])) if n else [0]
+    bucket = np.full(n, len(ks), np.int64)
+    bucket[small] = np.searchsorted(np.array(ks), indeg[small])
+    heavy = ~small
+    ntiles = np.zeros(n, np.int64)
+    ntiles[heavy] = -(-indeg[heavy] // seg_tile)
+    # permutation: dense degree classes ascending, then the heavy tail by
+    # tile count; first-neighbor secondary order gives consecutive rows
+    # nearby gather targets (cache-line sharing on CPU, DMA locality on
+    # TPU) at zero extra cost
+    first_nbr = np.full(n, n, np.int64)
+    nz = indeg > 0
+    first_nbr[nz] = csrc[cindptr[:-1][nz]]
+    sort_key = np.where(heavy, len(ks) + ntiles, bucket)
+    perm_order = np.lexsort((first_nbr, sort_key))
     new_of_old = np.empty(n, np.int64)
     new_of_old[perm_order] = np.arange(n)
-    counts = np.bincount(bucket_of, minlength=len(ks))
-    offs = np.concatenate([[0], np.cumsum(counts)])
+    cnew = new_of_old[csrc] if len(csrc) else csrc.astype(np.int64)
 
-    ells = []
-    for bi, K in enumerate(ks):
-        nodes = perm_order[offs[bi]:offs[bi + 1]]
-        nb = np.full((len(nodes), K), n, np.int32)   # n = sentinel row
-        if len(nodes):
-            deg = indeg[nodes]
-            flat = np.concatenate(
-                [np.arange(cindptr[v], cindptr[v] + deg[i])
-                 for i, v in enumerate(nodes)]) if deg.sum() else \
-                np.empty(0, np.int64)
-            rowpos = np.repeat(np.arange(len(nodes)), deg)
-            colpos = (np.arange(len(rowpos))
-                      - np.repeat(np.cumsum(deg) - deg, deg))
-            nb[rowpos, colpos] = new_of_old[csrc[flat]]
-        ells.append(nb)
-    return EllGraph(n=n, ells=ells,
+    def fill_rows(nodes, K):
+        """[len(nodes), K] in-neighbor block (pad=n), one vector pass."""
+        nb = np.full((len(nodes), K), n, np.int32)
+        deg = indeg[nodes]
+        total = int(deg.sum())
+        if total:
+            cum = np.cumsum(deg)
+            base = np.repeat(cum - deg, deg)
+            ar = np.arange(total)
+            flat = np.repeat(cindptr[nodes], deg) + ar - base
+            nb[np.repeat(np.arange(len(nodes)), deg), ar - base] = \
+                cnew[flat]
+        return nb
+
+    counts = np.bincount(bucket, minlength=len(ks) + 1)
+    parts = []
+    off = 0
+    for i, K in enumerate(ks):
+        nodes = perm_order[off:off + counts[i]]
+        off += counts[i]
+        if K == 0:
+            parts.append(("zero", None, len(nodes)))
+        else:
+            parts.append(("ell", fill_rows(nodes, K), len(nodes)))
+    heavy_nodes = perm_order[off:]
+    seg_rows = len(heavy_nodes)
+    tiles = None
+    lvl2 = []
+    if seg_rows:
+        hdeg = indeg[heavy_nodes]
+        hnt = -(-hdeg // seg_tile)
+        M = int(hnt.sum())
+        tiles = np.full((M, seg_tile), n, np.int32)
+        total = int(hdeg.sum())
+        cum = np.cumsum(hdeg)
+        base = np.repeat(cum - hdeg, hdeg)
+        ar = np.arange(total)
+        within = ar - base
+        tile_start = np.concatenate([[0], np.cumsum(hnt)])[:-1]
+        flat = np.repeat(cindptr[heavy_nodes], hdeg) + within
+        slot = np.repeat(tile_start * seg_tile, hdeg) + within
+        tiles[slot // seg_tile, slot % seg_tile] = cnew[flat]
+        # second level: combine each heavy row's tile partials; rows are
+        # already ntile-sorted, so power-of-two buckets are contiguous
+        k2s = sorted(set(int(1 << max(int(t - 1).bit_length(), 0))
+                         for t in np.unique(hnt)))
+        b2 = np.searchsorted(np.array(k2s), hnt)
+        c2 = np.bincount(b2, minlength=len(k2s))
+        off2 = 0
+        for i, K2 in enumerate(k2s):
+            rows = np.arange(off2, off2 + c2[i])
+            off2 += c2[i]
+            t2 = np.full((len(rows), K2), M, np.int32)  # M = zero partial
+            d2 = hnt[rows]
+            tot2 = int(d2.sum())
+            if tot2:
+                cum2 = np.cumsum(d2)
+                base2 = np.repeat(cum2 - d2, d2)
+                ar2 = np.arange(tot2)
+                t2[np.repeat(np.arange(len(rows)), d2), ar2 - base2] = \
+                    np.repeat(tile_start[rows], d2) + ar2 - base2
+            lvl2.append(t2)
+    return EllGraph(n=n, parts=parts, tiles=tiles, lvl2=lvl2,
+                    seg_rows=seg_rows,
                     outdeg=deg_out[perm_order].astype(np.float32),
                     perm_order=perm_order, new_of_old=new_of_old, ks=ks)
 
 
-def pack_seed_masks(g: EllGraph, rank_lists) -> "jnp.ndarray":
-    """B seed rank lists (OLD rank space) → [n+1, B/32] packed uint32 mask
+def pack_seed_masks(g: EllGraph, rank_lists,
+                    word_bits: int = 32) -> "jnp.ndarray":
+    """B seed rank lists (OLD rank space) → [n+1, B/word_bits] packed mask
     in the permuted space, sentinel zero row last. B must be a multiple of
-    32."""
+    `word_bits` (32 for the serving default, 64 for the x64 bench path)."""
     import numpy as np
     B = len(rank_lists)
-    assert B % 32 == 0, "lane count must pack into uint32 words"
-    m = np.zeros((g.n + 1, B // 32), np.uint32)
+    assert B % word_bits == 0, "lane count must pack into mask words"
+    dt = np.uint32 if word_bits == 32 else np.uint64
+    m = np.zeros((g.n + 1, B // word_bits), dt)
     for q, ranks in enumerate(rank_lists):
         r = g.new_of_old[np.asarray(ranks, np.int64)]
-        m[r, q // 32] |= np.uint32(1 << (q % 32))
+        m[r, q // word_bits] |= dt(1 << (q % word_bits))
     return m
 
 
-def unpack_masks(g: EllGraph, mask) -> list:
+def unpack_masks(g: EllGraph, mask, word_bits: int = 32) -> list:
     """[n+1, W] packed mask → list of B sorted OLD-rank arrays."""
     import numpy as np
     m = np.asarray(mask)[:g.n]
+    dt = m.dtype.type
     out = []
-    for q in range(m.shape[1] * 32):
-        rows = np.nonzero((m[:, q // 32] >> np.uint32(q % 32)) & 1)[0]
+    for q in range(m.shape[1] * word_bits):
+        rows = np.nonzero(
+            (m[:, q // word_bits] >> dt(q % word_bits)) & dt(1))[0]
         out.append(np.sort(g.perm_order[rows]).astype(np.int32))
     return out
 
 
-# bytes a single bucket gather may NOMINALLY materialise before
-# row-chunking. XLA usually fuses the gather into the OR-reduce without
-# materialising, so this is NOT a real memory model — it exists solely to
-# break up shapes XLA's fusion gives up on (observed: ~20G at B=8192),
-# because the chunked form (lax.map) serialises and costs ~35% throughput
-# wherever fusion would have worked
+# bytes a reduce-form gather may NOMINALLY materialise before row-chunking
+# ([rows, K, W] for chains wider than CHAIN_MAX — only the widest lvl2
+# combine buckets take this path, and their row counts shrink as K2 grows,
+# so chunking is a guard rail for adversarial degree distributions, not a
+# tuned path)
 GATHER_BUDGET = 12 << 30
 
 
-def _prepare_buckets(ells, n: int, W: int):
-    """Pre-shape each ELL bucket for the hop at lane width W: buckets
-    whose nominal gather intermediate fits GATHER_BUDGET stay flat;
-    larger ones are padded + reshaped to [nch, ch, K] ONCE, eagerly (one
-    device array — the jitted program must not carry both the original
-    and a padded copy as constants). Under DGRAPH_TPU_PALLAS=1, every
-    bucket is instead row-padded for the Pallas DMA-ring hop
-    (ops/pallas_hop.py) — which streams rows through VMEM and has no
-    gather intermediate to budget."""
+@dataclass
+class DeviceEll:
+    """EllGraph's index arrays resident on device, word-dtype independent
+    (indices are int32 whatever the mask word width)."""
+
+    n: int
+    parts: list            # ("zero", None, rows) | ("ell", dev, rows)
+    tiles: object          # device [M, seg_tile] | None
+    lvl2: list             # device [h_b, K2] blocks
+    seg_rows: int
+
+
+def device_ell(g: EllGraph) -> DeviceEll:
+    parts = [(kind, jax.device_put(e) if e is not None else None, rows)
+             for kind, e, rows in g.parts]
+    return DeviceEll(
+        n=g.n, parts=parts,
+        tiles=jax.device_put(g.tiles) if g.tiles is not None else None,
+        lvl2=[jax.device_put(t) for t in g.lvl2], seg_rows=g.seg_rows)
+
+
+def prepare_parts(dev: DeviceEll, W: int):
+    """Pre-shape the device blocks for a hop at lane width W. The XLA
+    path uses the blocks as-is (the gather-OR chain never materialises a
+    [rows, K, W] intermediate); under DGRAPH_TPU_PALLAS=1 dense blocks
+    and the tile matrix are row-padded for the Pallas DMA-ring hop
+    (ops/pallas_hop.py) instead."""
     import os
     use_pallas = os.environ.get("DGRAPH_TPU_PALLAS", "") == "1"
     if use_pallas:
         # import only under the flag: the default XLA path must not
         # couple to the experimental pallas namespace
         from dgraph_tpu.ops.pallas_hop import BLOCK_ROWS
-    prepared = []
-    for e in ells:
-        n_b, K = e.shape
-        if use_pallas:
-            if n_b == 0:
-                # empty degree bucket: zero rows, zero work (the padded
-                # sentinel block would DMA-loop for nothing every hop)
-                prepared.append(("pallas", None, 0))
-                continue
-            padded = -(-n_b // BLOCK_ROWS) * BLOCK_ROWS
-            if padded == n_b:
-                e_p = jnp.asarray(e, jnp.int32)   # no copy when aligned
-            else:
-                pad = jnp.full((padded - n_b, K), n, jnp.int32)
-                e_p = jnp.concatenate([jnp.asarray(e, jnp.int32), pad])
-            prepared.append(("pallas", e_p, n_b))
-            continue
-        row_bytes = max(K * W * 4, 1)
-        if n_b * row_bytes <= GATHER_BUDGET:
-            prepared.append(("flat", jnp.asarray(e), n_b))
-            continue
-        ch = max(1, min(GATHER_BUDGET // row_bytes, n_b))
-        nch = -(-n_b // ch)
-        pad = jnp.full((nch * ch - n_b, K), n, jnp.int32)  # zero mask row
-        e3 = jnp.concatenate([jnp.asarray(e, jnp.int32), pad]
-                             ).reshape(nch, ch, K)
-        prepared.append(("chunked", e3, n_b))
-    return prepared
+
+    def pad_rows(e):
+        n_b = e.shape[0]
+        padded = -(-n_b // BLOCK_ROWS) * BLOCK_ROWS
+        if padded == n_b:
+            return jnp.asarray(e, jnp.int32), n_b
+        pad = jnp.full((padded - n_b, e.shape[1]), dev.n, jnp.int32)
+        return jnp.concatenate([jnp.asarray(e, jnp.int32), pad]), n_b
+
+    parts = []
+    for kind, e, rows in dev.parts:
+        if kind == "zero" or rows == 0:
+            parts.append(("zero", None, rows))
+        elif use_pallas:
+            parts.append(("pallas", *pad_rows(e)))
+        else:
+            parts.append(("chain", e, rows))
+    tiles = None
+    if dev.tiles is not None and dev.seg_rows:
+        if use_pallas and dev.tiles.shape[0]:
+            tiles = ("pallas", *pad_rows(dev.tiles))
+        else:
+            tiles = ("chain", dev.tiles, dev.tiles.shape[0])
+    return {"parts": parts, "tiles": tiles, "lvl2": list(dev.lvl2),
+            "seg_rows": dev.seg_rows, "n": dev.n}
 
 
 # Sticky fail-safe: the first bucket_hop_pallas that fails to trace or
@@ -271,11 +375,36 @@ def _prepare_buckets(ells, n: int, W: int):
 _pallas_failed = False
 
 
+def _chain_or(frontier, e, dtype):
+    """out[i] = OR_k frontier[e[i, k]] as an unrolled gather chain —
+    XLA fuses the K gathers into one output pass (no [rows, K, W]
+    intermediate; measured ~3x the lax.reduce form on the CPU backend).
+    Chains wider than CHAIN_MAX fall back to the reduce form, chunked
+    when the nominal intermediate would blow GATHER_BUDGET."""
+    rows, K = e.shape
+    W = frontier.shape[1]
+    if K <= CHAIN_MAX:
+        acc = frontier[e[:, 0]]
+        for k in range(1, K):
+            acc = acc | frontier[e[:, k]]
+        return acc
+    row_bytes = K * W * frontier.dtype.itemsize
+    if rows * row_bytes <= GATHER_BUDGET:
+        return lax.reduce(frontier[e], dtype(0), lax.bitwise_or, (1,))
+    ch = max(1, min(GATHER_BUDGET // row_bytes, rows))
+    nch = -(-rows // ch)
+    pad = jnp.full((nch * ch - rows, K), frontier.shape[0] - 1, jnp.int32)
+    e3 = jnp.concatenate([e, pad]).reshape(nch, ch, K)
+    out = lax.map(
+        lambda c: lax.reduce(frontier[c], dtype(0), lax.bitwise_or, (1,)),
+        e3)
+    return out.reshape(-1, W)[:rows]
+
+
 def _pallas_bucket_part(e, n_b, frontier):
-    """One pallas bucket's hop with XLA-gather fallback. The padded rows
+    """One pallas block's hop with XLA-gather fallback. The padded rows
     index frontier's all-zero sentinel row, so the gather form is exact
-    on the same padded input; the fallback skips the chunked-budget
-    shape (this is a failure path, not the tuned one)."""
+    on the same padded input."""
     global _pallas_failed
     from dgraph_tpu.utils.metrics import METRICS
     if not _pallas_failed:
@@ -295,86 +424,118 @@ def _pallas_bucket_part(e, n_b, frontier):
     # degradation stays visible in /debug/prometheus_metrics instead of
     # one log line scrolling away
     METRICS.inc("pallas_fallback_total")
-    return lax.reduce(frontier[e], jnp.uint32(0),
+    return lax.reduce(frontier[e], frontier.dtype.type(0),
                       lax.bitwise_or, (1,))[:n_b]
 
 
-def _ell_hop(prepared, frontier, W):
+def _ell_hop(prepared, frontier, W, dtype=jnp.uint32):
     """next[v] = OR of frontier[u] over in-neighbors u — gathers only.
-    Chunked buckets reduce row-slabs sequentially (lax.map) to bound the
-    intermediate where XLA's gather+reduce fusion gives up (~20G);
-    "pallas" buckets ride the explicit DMA-ring kernel instead of the
-    XLA gather (ops/pallas_hop.py), falling back to the gather if the
-    kernel fails to trace/compile (_pallas_bucket_part)."""
-    parts = []
-    for kind, e, n_b in prepared:
-        if kind == "pallas":
-            if n_b == 0:
-                parts.append(jnp.zeros((0, W), jnp.uint32))
-                continue
-            parts.append(_pallas_bucket_part(e, n_b, frontier))
-        elif kind == "flat":
-            parts.append(lax.reduce(frontier[e], jnp.uint32(0),
-                                    lax.bitwise_or, (1,)))
+    Dense degree classes run as gather-OR chains; the heavy tail runs
+    tile partials + the tiny second-level combine; "pallas" blocks ride
+    the explicit DMA-ring kernel (ops/pallas_hop.py), falling back to
+    the gather if it fails to trace/compile (_pallas_bucket_part)."""
+    outs = []
+    for kind, e, rows in prepared["parts"]:
+        if kind == "zero":
+            outs.append(jnp.zeros((rows, W), dtype))
+        elif kind == "pallas":
+            outs.append(_pallas_bucket_part(e, rows, frontier))
         else:
-            out = lax.map(
-                lambda c: lax.reduce(frontier[c], jnp.uint32(0),
-                                     lax.bitwise_or, (1,)), e)
-            parts.append(out.reshape(-1, W)[:n_b])
-    parts.append(jnp.zeros((1, W), jnp.uint32))       # sentinel row
-    return jnp.concatenate(parts, axis=0)
+            outs.append(_chain_or(frontier, e, dtype))
+    tiles = prepared["tiles"]
+    if tiles is not None:
+        tkind, te, trows = tiles
+        if tkind == "pallas":
+            acc = _pallas_bucket_part(te, trows, frontier)
+        else:
+            acc = _chain_or(frontier, te, dtype)
+        partials = jnp.concatenate([acc, jnp.zeros((1, W), dtype)])
+        for t2 in prepared["lvl2"]:
+            outs.append(_chain_or(partials, t2, dtype))
+    outs.append(jnp.zeros((1, W), dtype))       # sentinel row
+    return jnp.concatenate(outs, axis=0)
 
 
 COUNT_BLK = 1 << 15   # edge-counter node-block rows (bounds unpack memory)
 
 
-def make_ell_recurse(ells, outdeg, n: int, W: int, count_edges: bool = True):
-    """Compile a depth-parameterised loop=false @recurse over an EllGraph
-    already resident on device. Returns fn(mask0, depth) →
-    (last[n+1,W], seen[n+1,W], edges[B] int32)."""
-    nblk = -(-n // COUNT_BLK)
-    n_pad = nblk * COUNT_BLK
-    prepared = _prepare_buckets(ells, n, W)
+def _count_mask(mask, outdeg_pad, n, W, word_bits):
+    """Per-lane out-degree mass of a packed mask: unpack lane bits and
+    matvec on the MXU (f32 exact while each lane's TOTAL stays under
+    2^24 — the per-run analog of the old per-hop bound; int32 out).
+    Blocked over node rows so the unpack never materialises n·B floats."""
+    n_pad = outdeg_pad.shape[0]
+    nblk = n_pad // COUNT_BLK
+    fpad = jnp.concatenate(
+        [mask[:n], jnp.zeros((n_pad - n, W), mask.dtype)])
+    shifts = jnp.arange(word_bits, dtype=mask.dtype)
+
+    def body(i, acc):
+        sl = lax.dynamic_slice_in_dim(fpad, i * COUNT_BLK, COUNT_BLK, 0)
+        od = lax.dynamic_slice_in_dim(outdeg_pad, i * COUNT_BLK,
+                                      COUNT_BLK, 0)
+        bits = ((sl[:, :, None] >> shifts) & mask.dtype.type(1)
+                ).astype(jnp.float32).reshape(COUNT_BLK, W * word_bits)
+        return acc + od @ bits
+
+    out = lax.fori_loop(0, nblk, body,
+                        jnp.zeros((W * word_bits,), jnp.float32))
+    return out.astype(jnp.int32)
+
+
+def make_ell_count(outdeg, n: int, W: int, word_bits: int = 32):
+    """Compile the exact per-query edge counter over final masks:
+    edges[q] = Σ outdeg[v]·[v ∈ seen \\ last] — every frontier the run
+    expanded is exactly `seen` minus the never-expanded last fresh set,
+    so ONE matvec replaces the old per-hop accumulation (same integers,
+    depth× less unpack traffic)."""
+    nblk = -(-max(n, 1) // COUNT_BLK)
+    outdeg_pad = jnp.concatenate(
+        [jnp.asarray(outdeg),
+         jnp.zeros((nblk * COUNT_BLK - n,), jnp.float32)])
+
+    @jax.jit
+    def count(last, seen):
+        return _count_mask(seen & ~last, outdeg_pad, n, W, word_bits)
+
+    return count
+
+
+def make_ell_recurse(dev: DeviceEll, outdeg, n: int, W: int,
+                     count_edges: bool = True, word_bits: int = 32):
+    """Compile a depth-parameterised loop=false @recurse over a DeviceEll
+    already resident on device. Returns fn(mask0, depth[, keep_hops]) →
+    (last[n+1,W], seen[n+1,W], edges[B] int32[, hops]). The seed mask is
+    DONATED: the scan reuses its buffer for the frontier carry instead of
+    holding seed + frontier + seen live (callers re-put per launch)."""
+    prepared = prepare_parts(dev, W)
+    dtype = jnp.uint32 if word_bits == 32 else jnp.uint64
     if count_edges:
+        nblk = -(-max(n, 1) // COUNT_BLK)
         outdeg_pad = jnp.concatenate(
             [jnp.asarray(outdeg),
-             jnp.zeros((n_pad - n,), jnp.float32)])
+             jnp.zeros((nblk * COUNT_BLK - n,), jnp.float32)])
 
-    def _count(frontier, edges):
-        # per-query frontier out-degree mass: unpack the packed lanes and
-        # matvec on the MXU (f32 exact to 2^24 per hop per query; int32
-        # accumulator exact to 2^31). Blocked over node rows — a whole-
-        # array unpack materialises n*W*32 floats and blows HBM at wide B.
-        fpad = jnp.concatenate(
-            [frontier[:n], jnp.zeros((n_pad - n, W), jnp.uint32)])
-
-        def body(i, acc):
-            sl = lax.dynamic_slice_in_dim(fpad, i * COUNT_BLK,
-                                          COUNT_BLK, 0)
-            od = lax.dynamic_slice_in_dim(outdeg_pad, i * COUNT_BLK,
-                                          COUNT_BLK, 0)
-            bits = ((sl[:, :, None] >> jnp.arange(32, dtype=jnp.uint32))
-                    & 1).astype(jnp.float32).reshape(COUNT_BLK, W * 32)
-            return acc + od @ bits
-
-        hop_edges = lax.fori_loop(
-            0, nblk, body, jnp.zeros((W * 32,), jnp.float32))
-        return edges + hop_edges.astype(jnp.int32)
-
-    @functools.partial(jax.jit, static_argnames=("depth", "keep_hops"))
+    @functools.partial(jax.jit, donate_argnums=(0,),
+                       static_argnames=("depth", "keep_hops"))
     def recurse(mask0, depth: int, keep_hops: bool = False):
         def hop(carry, _):
-            frontier, seen, edges = carry
-            if count_edges:
-                edges = _count(frontier, edges)
-            nxt = _ell_hop(prepared, frontier, W)
+            frontier, seen = carry
+            nxt = _ell_hop(prepared, frontier, W, dtype)
             fresh = nxt & ~seen
             seen = seen | fresh
-            return (fresh, seen, edges), (fresh if keep_hops else None)
+            return (fresh, seen), (fresh if keep_hops else None)
 
-        (last, seen, edges), hops = lax.scan(
-            hop, (mask0, mask0, jnp.zeros((W * 32,), jnp.int32)), None,
-            length=depth)
+        (last, seen), hops = lax.scan(
+            hop, (mask0, mask0), None, length=depth)
+        if count_edges:
+            # exact per-lane counters from the final masks (one matvec;
+            # see make_ell_count) — identical integers to the per-hop
+            # accumulation because first-visit frontiers partition
+            # seen \ last
+            edges = _count_mask(seen & ~last, outdeg_pad, n, W, word_bits)
+        else:
+            edges = jnp.zeros((W * word_bits,), jnp.int32)
         if keep_hops:
             # hops[h] = the FRESH mask after hop h+1 (first-visit sets) —
             # what tree reconstruction needs (engine batch path)
@@ -384,10 +545,43 @@ def make_ell_recurse(ells, outdeg, n: int, W: int, count_edges: bool = True):
     return recurse
 
 
-def make_ell_tree(stages, n: int, W: int):
+def make_ell_step(dev: DeviceEll, n: int, W: int, word_bits: int = 32,
+                  first_visit: bool = True):
+    """Compile a RESUMABLE hop block: fn(frontier, seen, depth) →
+    (frontier', seen', hops[depth, n+1, W]). Both mask carries are
+    DONATED — successive blocks of a staged traversal (engine/batch.py's
+    shortest groups) hand their buffers forward instead of re-allocating
+    per stage, the donation contract the README documents.
+
+    `first_visit=False` drops the seen-masking: hops[h] is then the FULL
+    set reachable in exactly h+1 hops (the level-DAG the k-shortest
+    enumeration consumes), with `seen` passed through untouched."""
+    prepared = prepare_parts(dev, W)
+    dtype = jnp.uint32 if word_bits == 32 else jnp.uint64
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1),
+                       static_argnames=("depth",))
+    def step(frontier, seen, depth: int):
+        def hop(carry, _):
+            f, s = carry
+            nxt = _ell_hop(prepared, f, W, dtype)
+            if first_visit:
+                fresh = nxt & ~s
+                s = s | fresh
+            else:
+                fresh = nxt
+            return (fresh, s), fresh
+
+        (f, s), hops = lax.scan(hop, (frontier, seen), None, length=depth)
+        return f, s, hops
+
+    return step
+
+
+def make_ell_tree(stages, n: int, W: int, word_bits: int = 32):
     """Compile a level-TREE pipeline over lane-packed masks: the batched
     form of a whole nested query (engine/treebatch.py), one fused XLA
-    program for B = 32·W concurrent queries.
+    program for B = word_bits·W concurrent queries.
 
     Reference parity: query/query.go ProcessGraph descends a SubGraph
     tree level by level, one task per child per goroutine; here every
@@ -395,15 +589,15 @@ def make_ell_tree(stages, n: int, W: int):
     bitmask ANDs instead of per-uid IntersectSorted calls.
 
     All masks live in the STORE's global rank space, shape [n+1, W]
-    uint32 (row n = sentinel, always zero). Each stage's EllGraph has its
-    own degree-bucket permutation, so a stage translates its parent mask
-    into its own permuted space (one row gather), does the ELL pull-hop,
-    and translates back (one row gather) — both translations stream
+    (row n = sentinel, always zero). Each stage's EllGraph has its own
+    degree-class permutation, so a stage translates its parent mask into
+    its own permuted space (one row gather), does the ELL pull-hop, and
+    translates back (one row gather) — both translations stream
     sequentially and are noise next to the edge gather.
 
     `stages` is a list of dicts (static structure, device arrays):
       kind      "hop" | "recurse"
-      prepared  _prepare_buckets output for the stage's EllGraph
+      prepared  prepare_parts output for the stage's EllGraph
       perm_in   [n+1] int32 device: permuted row r ← global perm_in[r]
       out_idx   [n+1] int32 device: global row v ← permuted out_idx[v]
       parent    ("seed", slot) | ("stage", idx earlier in the list)
@@ -413,10 +607,12 @@ def make_ell_tree(stages, n: int, W: int):
 
     Returns fn(seeds: tuple, filts: tuple) → tuple with one entry per
     stage: hop → mask [n+1, W]; recurse → seen [n+1, W] (reachable set
-    incl. seeds) or (seen, hops [depth, n+1, W]) when keep_hops.
+    incl. seeds) or (seen, hops [depth, n+1, W]) when keep_hops. The
+    seed and filter masks are DONATED (consumed by the first gather).
     """
+    dtype = jnp.uint32 if word_bits == 32 else jnp.uint64
 
-    @jax.jit
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
     def run(seeds, filts):
         outs = []
         results = []
@@ -427,7 +623,8 @@ def make_ell_tree(stages, n: int, W: int):
             filt = filts[s["filt"]] if s["filt"] is not None else None
             pm = parent[s["perm_in"]]            # global → permuted
             if kind == "hop":
-                out = _ell_hop(s["prepared"], pm, W)[s["out_idx"]]
+                out = _ell_hop(s["prepared"], pm, W,
+                               dtype)[s["out_idx"]]
                 if filt is not None:
                     out = out & filt
                 outs.append(out)
@@ -438,7 +635,7 @@ def make_ell_tree(stages, n: int, W: int):
 
             def hop(carry, _, _prep=s["prepared"], _filt_p=filt_p):
                 frontier, seen = carry
-                nxt = _ell_hop(_prep, frontier, W)
+                nxt = _ell_hop(_prep, frontier, W, dtype)
                 fresh = nxt & ~seen
                 if _filt_p is not None:
                     fresh = fresh & _filt_p
@@ -461,8 +658,12 @@ def make_ell_tree(stages, n: int, W: int):
 def ell_recurse(g: EllGraph, mask0, depth: int, count_edges: bool = True):
     """One-shot convenience: device_put the blocks and run. For repeated
     runs hold make_ell_recurse + device arrays instead."""
-    ells_d = [jax.device_put(e) for e in g.ells]
-    outdeg_d = jax.device_put(g.outdeg)
-    fn = make_ell_recurse(ells_d, outdeg_d, g.n, mask0.shape[1],
-                          count_edges)
+    import numpy as np
+    word_bits = 64 if np.asarray(mask0).dtype == np.uint64 else 32
+    if word_bits == 64:
+        assert jax.config.jax_enable_x64, \
+            "uint64 lane words need x64 (jax.experimental.enable_x64)"
+    dev = device_ell(g)
+    fn = make_ell_recurse(dev, g.outdeg, g.n, mask0.shape[1],
+                          count_edges, word_bits)
     return fn(jax.device_put(mask0), depth)

@@ -648,8 +648,8 @@ class Alpha:
         batches execute as ONE lane-packed kernel launch (the north-star
         throughput path, engine/batch.py); everything else falls back to
         per-query execution. Returns one JSON dict per query, in order."""
-        from dgraph_tpu.dql.parser import parse
-        from dgraph_tpu.engine.batch import plan_batch_groups, run_batch
+        from dgraph_tpu.engine.batch import (plan_batch_groups_cached,
+                                             run_batch)
 
         with self._request("read", deadline_ms), \
                 self._reading(read_ts) as ts:
@@ -659,24 +659,13 @@ class Alpha:
             results: list = [None] * len(dqls)
             leftover = list(range(len(dqls)))
             try:
-                # per-query parse isolation: a syntax error sends THAT
-                # query to the per-query path (which reproduces its
-                # error object) without disabling the kernel for the
-                # parseable rest
-                parsed = {}
-                for i, q in enumerate(dqls):
-                    try:
-                        parsed[i] = parse(q)
-                    except Exception:  # noqa: BLE001 — re-raised per-query
-                        pass
-                plans, group_left = plan_batch_groups(
-                    store, [parsed[i] for i in sorted(parsed)])
-                order = sorted(parsed)
-                plans = [(p, [order[j] for j in idxs])
-                         for p, idxs in plans]
-                leftover = sorted(
-                    [order[j] for j in group_left]
-                    + [i for i in range(len(dqls)) if i not in parsed])
+                # parse isolation + plan memoization live in the cached
+                # planner: a syntax error sends THAT query to the
+                # per-query path (which reproduces its error object),
+                # and a repeated batch of identical texts skips parse +
+                # plan_batch_groups entirely (plan_cache_hits_total)
+                plans, leftover = plan_batch_groups_cached(store, dqls)
+                leftover = list(leftover)   # cached list: never mutate
                 # each compatible group is ONE lane-kernel launch; a
                 # failing group degrades to per-query, not to a failed
                 # batch

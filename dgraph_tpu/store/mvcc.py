@@ -260,7 +260,33 @@ class MVCCStore:
             new_ts = pending[-1].commit_ts
             store = _materialize(fold_store, pending)
             self._history.append((new_ts, store))
-            return store
+            def preds_of(layers_):
+                return {rec[1] for l in layers_
+                        for rec in (l.mut.edge_sets + l.mut.edge_dels
+                                    + l.mut.val_sets + l.mut.val_dels)}
+
+            touched = preds_of(pending)
+            # the freshest cached view over a PREFIX of the folded layer
+            # set differs from the fold only by the suffix layers — its
+            # kernel caches carry for every predicate the suffix left
+            # untouched
+            pend_ts = tuple(l.commit_ts for l in pending)
+            view, vlen = None, -1
+            for (f_ts, ts_tup), v in self._views.items():
+                if (f_ts == fold_ts and len(ts_tup) > vlen
+                        and ts_tup == pend_ts[:len(ts_tup)]):
+                    view, vlen = v, len(ts_tup)
+            view_touched = (preds_of(pending[vlen:])
+                            if view is not None else set())
+        # outside self._lock: the fold rebuilds untouched predicates to
+        # identical CSR blocks (vocab willing), so existing ELL/device/
+        # kernel caches stay valid — carry them instead of re-running a
+        # full build_ell on the next batch
+        from dgraph_tpu.engine.batch import carry_kernel_caches
+        if view is not None:
+            carry_kernel_caches(view, store, view_touched)
+        carry_kernel_caches(fold_store, store, touched)
+        return store
 
     def _fold_guard(self, fold_ts: int, upto_ts: int) -> tuple:
         """Fingerprint of what an external fold over (fold_ts, upto_ts]

@@ -63,3 +63,41 @@ def reset() -> None:
     """Test hook: forget every key (a fresh process compiles anew)."""
     with _lock:
         _seen.clear()
+
+
+class Memo:
+    """Bounded LRU memo for host-side derived objects that amortize like
+    compiled programs do (batch PLANS keyed by query shape, the bench's
+    ELL build) — the host-side sibling of the jit compile cache above.
+    Callers classify hits/misses into their own metrics; the memo only
+    stores. Thread-safe via a named lock so the lock-order sanitizer
+    covers every cache the batch path grew in PR 7."""
+
+    def __init__(self, name: str, capacity: int = 128):
+        import collections
+        self.name = name
+        self.capacity = capacity
+        self._d: "collections.OrderedDict" = collections.OrderedDict()
+        self._lock = locks.make_lock(f"jitcache.memo.{name}")
+
+    def get(self, key):
+        with self._lock:
+            if key not in self._d:
+                return None
+            self._d.move_to_end(key)
+            return self._d[key]
+
+    def put(self, key, value) -> None:
+        with self._lock:
+            self._d[key] = value
+            self._d.move_to_end(key)
+            while len(self._d) > self.capacity:
+                self._d.popitem(last=False)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._d.clear()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._d)

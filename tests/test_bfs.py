@@ -145,3 +145,119 @@ class TestEllRecurse:
 def jnp_put(x):
     import jax
     return jax.device_put(x)
+
+
+class TestSegmentCsr:
+    """Degree-bucketed dense-lane + segment-CSR templates == numpy walk,
+    across shapes that exercise every template: powerlaw (mixed), star
+    (one all-heavy hub), chain (deg ≤ 1 + indeg-0 head), all-heavy
+    uniform, and degree-gapped graphs (absent buckets)."""
+
+    def _assert_identity(self, rel, B=32, depth=3, seed=0):
+        from dgraph_tpu.ops.bfs import (build_ell, ell_recurse,
+                                        pack_seed_masks, unpack_masks)
+        n = rel.indptr.shape[0] - 1
+        rng = np.random.default_rng(seed)
+        seeds = [rng.integers(0, n, rng.integers(1, 4)) for _ in range(B)]
+        g = build_ell(rel.indptr, rel.indices)
+        assert g.nnz == rel.nnz
+        mask0 = pack_seed_masks(g, seeds)
+        _last, seen, edges = ell_recurse(g, mask0, depth)
+        seen_lists = unpack_masks(g, seen)
+        for q in range(B):
+            of, os_, oe = oracle_recurse(rel, seeds[q], depth)
+            assert np.array_equal(seen_lists[q], os_), f"query {q} seen"
+            assert int(np.asarray(edges)[q]) == oe, f"query {q} edges"
+        return g
+
+    def test_powerlaw_mixed(self):
+        g = self._assert_identity(powerlaw_rel(500, 8.0, seed=4))
+        assert g.seg_rows > 0, "powerlaw must exercise the heavy tail"
+        assert any(k == 0 for k in g.ks), "and the indeg-0 class"
+
+    def test_star_all_heavy_hub(self):
+        """Star: hub with in-degree n-1 — a single segment-CSR row whose
+        tile count forces the wide (reduce-form) level-2 combine."""
+        from dgraph_tpu.store.store import _csr_from_pairs
+        n = 600
+        src = np.concatenate([np.arange(1, n), np.zeros(n - 1)])
+        dst = np.concatenate([np.zeros(n - 1), np.arange(1, n)])
+        rel = _csr_from_pairs(src.astype(np.int32), dst.astype(np.int32),
+                              n)
+        g = self._assert_identity(rel, depth=2, seed=1)
+        assert g.seg_rows == 1
+        assert g.lvl2 and g.lvl2[-1].shape[1] > 32, \
+            "hub tile count must take the reduce-form combine"
+
+    def test_chain_zero_and_one_indeg(self):
+        from dgraph_tpu.store.store import _csr_from_pairs
+        n = 200
+        rel = _csr_from_pairs(np.arange(n - 1, dtype=np.int32),
+                              np.arange(1, n, dtype=np.int32), n)
+        g = self._assert_identity(rel, depth=5, seed=2)
+        assert g.seg_rows == 0 and set(g.ks) == {0, 1}
+        assert g.padded_edges == g.nnz, "chain ELL must be padding-free"
+
+    def test_all_heavy_tail(self):
+        rel = uniform_rel(64, 48, seed=3)
+        g = self._assert_identity(rel, depth=2, seed=3)
+        assert g.seg_rows >= 40, "uniform deg-48 is mostly tail"
+
+    def test_degree_gap_buckets_absent(self):
+        """Only the degree classes PRESENT get blocks — a gapped degree
+        distribution must not materialize empty buckets."""
+        from dgraph_tpu.ops.bfs import build_ell
+        from dgraph_tpu.store.store import _csr_from_pairs
+        # nodes 0..9 each receive exactly 4 edges; the rest receive 0
+        src = np.tile(np.arange(10, 50, dtype=np.int32), 1)
+        dst = np.repeat(np.arange(10, dtype=np.int32), 4)
+        rel = _csr_from_pairs(src[:40], dst, 64)
+        g = build_ell(rel.indptr, rel.indices)
+        assert set(g.ks) == {0, 4}
+        self._assert_identity(rel, depth=2, seed=5)
+
+    def test_padding_bound_on_powerlaw(self):
+        """The tentpole's padding claim: level-1 slots stay within
+        seg_tile-1 per heavy row of the true edge count (was up to 4x
+        under the power-of-4 ladder)."""
+        from dgraph_tpu.ops.bfs import SEG_TILE, build_ell
+        rel = powerlaw_rel(2000, 10.0, seed=6)
+        g = build_ell(rel.indptr, rel.indices)
+        assert g.padded_edges - g.nnz <= g.seg_rows * (SEG_TILE - 1)
+        assert g.padded_edges < 1.25 * g.nnz
+
+    def test_u64_words_match_u32(self):
+        """uint64 lane words (the x64 bench path) produce bit-identical
+        traversals to the uint32 default."""
+        import jax
+        from jax.experimental import enable_x64
+
+        from dgraph_tpu.ops.bfs import (build_ell, device_ell,
+                                        make_ell_count, make_ell_recurse,
+                                        pack_seed_masks, unpack_masks)
+        rel = powerlaw_rel(300, 6.0, seed=7)
+        n = rel.indptr.shape[0] - 1
+        rng = np.random.default_rng(7)
+        seeds = [rng.integers(0, n, 3) for _ in range(64)]
+        g = build_ell(rel.indptr, rel.indices)
+        m32 = pack_seed_masks(g, seeds, word_bits=32)
+        _l, seen32, edges32 = ell_recurse_local(g, m32, 3)
+        with enable_x64():
+            m64 = pack_seed_masks(g, seeds, word_bits=64)
+            dev = device_ell(g)
+            fn = make_ell_recurse(dev, g.outdeg, g.n, m64.shape[1],
+                                  count_edges=False, word_bits=64)
+            last64, seen64, _e = fn(jax.device_put(m64), 3)
+            cnt = make_ell_count(g.outdeg, g.n, m64.shape[1],
+                                 word_bits=64)
+            edges64 = np.asarray(cnt(last64, seen64))
+            s64 = unpack_masks(g, np.asarray(seen64), word_bits=64)
+        s32 = unpack_masks(g, np.asarray(seen32), word_bits=32)
+        assert np.array_equal(np.asarray(edges32), edges64)
+        for a, b in zip(s32, s64):
+            assert np.array_equal(a, b)
+
+
+def ell_recurse_local(g, mask0, depth):
+    from dgraph_tpu.ops.bfs import ell_recurse
+    return ell_recurse(g, mask0, depth)

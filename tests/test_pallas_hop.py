@@ -14,8 +14,9 @@ import jax
 import jax.numpy as jnp
 
 from dgraph_tpu.models.synthetic import powerlaw_rel
-from dgraph_tpu.ops.bfs import (build_ell, ell_recurse, make_ell_recurse,
-                                pack_seed_masks, unpack_masks)
+from dgraph_tpu.ops.bfs import (build_ell, device_ell, ell_recurse,
+                                make_ell_recurse, pack_seed_masks,
+                                unpack_masks)
 from dgraph_tpu.ops.pallas_hop import bucket_hop_pallas
 
 
@@ -53,9 +54,7 @@ def test_ell_recurse_pallas_equals_xla(monkeypatch):
     last_x, seen_x, edges_x = ell_recurse(g, mask0, 3)
 
     monkeypatch.setenv("DGRAPH_TPU_PALLAS", "1")
-    ells_d = [jax.device_put(e) for e in g.ells]
-    fn = make_ell_recurse(ells_d, jax.device_put(g.outdeg), g.n,
-                          mask0.shape[1])
+    fn = make_ell_recurse(device_ell(g), g.outdeg, g.n, mask0.shape[1])
     last_p, seen_p, edges_p = fn(jax.device_put(mask0), 3)
 
     assert np.array_equal(np.asarray(seen_x), np.asarray(seen_p))
@@ -70,16 +69,22 @@ def test_ell_recurse_pallas_equals_xla(monkeypatch):
 
 def test_pallas_flag_off_by_default(monkeypatch):
     monkeypatch.delenv("DGRAPH_TPU_PALLAS", raising=False)
-    from dgraph_tpu.ops.bfs import _prepare_buckets
+    from dgraph_tpu.ops.bfs import prepare_parts
     rel = powerlaw_rel(1 << 8, 4.0, seed=2)
     g = build_ell(rel.indptr, rel.indices)
-    kinds = {k for k, _e, _n in _prepare_buckets(
-        [jnp.asarray(e) for e in g.ells], g.n, 1)}
+    dev = device_ell(g)
+
+    def kinds_of(prep):
+        ks = {k for k, _e, _n in prep["parts"]}
+        if prep["tiles"] is not None:
+            ks.add(prep["tiles"][0])
+        return ks
+
+    kinds = kinds_of(prepare_parts(dev, 1))
     assert "pallas" not in kinds
     monkeypatch.setenv("DGRAPH_TPU_PALLAS", "1")
-    kinds = {k for k, _e, _n in _prepare_buckets(
-        [jnp.asarray(e) for e in g.ells], g.n, 1)}
-    assert kinds == {"pallas"}
+    kinds = kinds_of(prepare_parts(dev, 1))
+    assert kinds <= {"pallas", "zero"} and "pallas" in kinds
 
 
 def test_pallas_trace_failure_falls_back_to_xla(monkeypatch):
@@ -102,8 +107,8 @@ def test_pallas_trace_failure_falls_back_to_xla(monkeypatch):
     monkeypatch.setenv("DGRAPH_TPU_PALLAS", "1")
     monkeypatch.setattr(ph, "bucket_hop_pallas", boom)
     monkeypatch.setattr(bfs, "_pallas_failed", False)  # restored after
-    fn = bfs.make_ell_recurse([jnp.asarray(e) for e in g.ells],
-                              jnp.asarray(g.outdeg), g.n, mask0.shape[1])
+    fn = bfs.make_ell_recurse(bfs.device_ell(g), g.outdeg, g.n,
+                              mask0.shape[1])
     last, seen, edges = fn(jnp.asarray(mask0), 3)
     assert bfs._pallas_failed, "fallback flag must stick after failure"
     assert np.array_equal(np.asarray(seen), np.asarray(want_seen))
