@@ -434,6 +434,11 @@ def maintenance_stage() -> dict:
                                          int(len(lats) * 0.99))])}
 
     i_p, d_p = pcts(idle), pcts(during)
+    # shape-keyed cost records of the served mix (the cost-model
+    # dataset the stage just generated): per-shape percentiles + the
+    # most expensive shapes, out of the same aggregator /debug/costs
+    # serves in a server process — bench and serving records merge
+    from dgraph_tpu.utils import costprofile
     return {"stage": "maintenance",
             "secs": round(time.perf_counter() - t0, 2),
             "queries_idle": len(idle), "queries_during": len(during),
@@ -444,7 +449,8 @@ def maintenance_stage() -> dict:
                                       max(i_p["p99_us"], 1), 3),
             "maintenance_jobs": jobs,
             "pauses": snap.get("maintenance_pauses_total", 0.0),
-            "evictions": snap.get("maintenance_evictions_total", 0.0)}
+            "evictions": snap.get("maintenance_evictions_total", 0.0),
+            "cost_records": costprofile.summary(top_n=5)}
 
 
 # ---------------------------------------------------------------------------
@@ -609,6 +615,15 @@ def main() -> None:
         out.update(value=0, platform=platform, vs_baseline=0.0, error=err)
     if err and "error" not in out:
         out["error"] = err
+    # cost-record summary (ISSUE 8): the maintenance stage's served mix
+    # is the child's cost dataset; an absent stage reports the (empty)
+    # parent aggregate rather than dropping the key
+    sm_costs = (stages.get("maintenance") or {}).get("cost_records")
+    if sm_costs is not None:
+        out["cost_records"] = sm_costs
+    else:
+        from dgraph_tpu.utils import costprofile
+        out["cost_records"] = costprofile.summary(top_n=5)
     out["lint"] = lint_stage()
     emit(out)
     watchdog.cancel()

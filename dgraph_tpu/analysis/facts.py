@@ -15,6 +15,12 @@ so the same pass extracts:
 * **metrics** — every literal registration (name, kind, site).
 * **locks** — every `make_lock/make_rlock/make_condition` order class,
   the static side of the lock sanitizer's graph.
+* **cost_record_fields** — the runtime cost-record schema
+  (utils/costprofile.FIELDS, re-exported verbatim): the static
+  inventory and the runtime records SHARE this vocabulary, so a
+  recorded cost joins back to the kernels/spans that incurred it
+  (tests/test_lint.py pins the two in sync — the join key for the
+  future learned cost model).
 
 Emitted under `"facts"` in `--format=json` output.
 """
@@ -75,17 +81,24 @@ def extract_facts(contexts) -> dict:
                     metrics.append({"name": arg0, "kind": leaf,
                                     "file": ctx.rel,
                                     "line": node.lineno})
+    # ONE vocabulary: the runtime cost-record schema is imported, not
+    # re-declared — facts and records cannot drift apart silently
+    from dgraph_tpu.utils.costprofile import FIELDS as COST_FIELDS
+    cost_fields = [{"name": n, "kind": d["kind"], "doc": d["doc"]}
+                   for n, d in sorted(COST_FIELDS.items())]
     return {
         "kernels": kernels,
         "kernel_launch_sites": launches,
         "span_sites": spans,
         "metric_sites": metrics,
         "lock_classes": locks,
+        "cost_record_fields": cost_fields,
         "totals": {
             "kernels": len(kernels),
             "kernel_launch_sites": len(launches),
             "span_names": len({s["name"] for s in spans}),
             "metric_names": len({m["name"] for m in metrics}),
             "lock_classes": len({x["name"] for x in locks}),
+            "cost_record_fields": len(cost_fields),
         },
     }

@@ -77,6 +77,8 @@ def cmd_alpha(args) -> int:
         "max_inflight": args.max_inflight,
         "queue_depth": args.queue_depth,
         "default_deadline_ms": args.default_deadline_ms,
+        "telemetry_push_url": args.telemetry_push_url,
+        "telemetry_push_interval_s": args.telemetry_push_interval_s,
         "rpc_retries": args.rpc_retries,
         "breaker_threshold": args.breaker_threshold,
         "breaker_cooldown_ms": args.breaker_cooldown_ms}
@@ -143,10 +145,22 @@ def cmd_alpha(args) -> int:
         log.info("slow-query log armed at %d ms", cfg.slow_query_ms)
     if cfg.trace_dir:
         # device-timeline capture: spans marked device=True also write
-        # jax.profiler traces (Perfetto) under this dir
+        # jax.profiler traces (Perfetto) under this dir; POST
+        # /debug/profile starts/stops on-demand captures under it too
         from dgraph_tpu.utils import tracing
         tracing.enable_device_trace(cfg.trace_dir)
         log.info("device trace capture armed: %s", cfg.trace_dir)
+    pusher = None
+    if cfg.telemetry_push_url:
+        # live span + cost-record streaming to an external collector
+        # (bounded buffer, retry-with-backoff, counted drops); unset =
+        # graceful no-op — the historical shutdown/pull-only posture
+        from dgraph_tpu.utils.push import TelemetryPusher
+        pusher = TelemetryPusher(
+            cfg.telemetry_push_url,
+            interval_s=cfg.telemetry_push_interval_s).start()
+        log.info("telemetry push armed: %s every %.1fs",
+                 cfg.telemetry_push_url, cfg.telemetry_push_interval_s)
     if args.acl_secret_file:
         # ACL enforcement (reference: ee/acl --acl_secret_file): groot
         # bootstrap + token-gated endpoints
@@ -235,6 +249,8 @@ def cmd_alpha(args) -> int:
         log.info("shutting down; draining maintenance + checkpointing "
                  "to %s", cfg.p_dir)
         alpha.shutdown(cfg.p_dir)
+        if pusher is not None:
+            pusher.stop(flush=True)  # best-effort final batch
         if cfg.trace_export:
             # span registry → OTLP/JSON for an external collector
             from dgraph_tpu.utils import tracing
@@ -480,6 +496,16 @@ def main(argv=None) -> int:
     p.add_argument("--trace_export", default=None,
                    help="on shutdown, write the span registry as "
                         "OTLP/JSON to this path (collector-ready)")
+    p.add_argument("--telemetry_push_url", default=None,
+                   help="stream spans (OTLP /v1/traces) + query cost "
+                        "records (/v1/costs) to this collector base "
+                        "URL while serving; unset = export stays "
+                        "shutdown/pull-shaped")
+    p.add_argument("--telemetry_push_interval_s", type=float,
+                   default=None,
+                   help="flush cadence of the live telemetry pusher "
+                        "(bounded buffer; drops are counted in "
+                        "telemetry_dropped_total, never block serving)")
     p.add_argument("--max_inflight", type=int, default=None,
                    help="admission control: concurrent requests per "
                         "lane (read/mutate); 0 = unbounded (off)")

@@ -51,6 +51,11 @@ class FaultyGroups:
         self._inner = inner
         self._dropped: set[str] = set()       # peer addrs this node can't reach
         self._delay_s: dict[str, float] = {}  # peer addr → injected latency
+        # clock-free delays (ROADMAP follow-on): instead of
+        # time.sleep, a delayed link CONSUMES the ambient request
+        # budget virtually (RequestContext.consume) — tight budgets
+        # expire exactly as under a real stall, at zero wall time
+        self.clock_free = False
         # instrument the INNER pool too: methods reached through
         # attribute delegation (call_group's read failover) bind the
         # inner Groups as self, so only hooking FaultyGroups.pool would
@@ -94,7 +99,18 @@ class FaultyGroups:
             raise LinkDown(self._inner.my_addr, addr)
         d = self._delay_s.get(addr)
         if d:
-            time.sleep(d)
+            if self.clock_free:
+                from dgraph_tpu.utils import deadline as dl
+                from dgraph_tpu.utils.metrics import METRICS
+                METRICS.inc("fault_virtual_delays_total")
+                ctx = dl.current()
+                if ctx is not None:
+                    ctx.consume(d)
+                    # a budget the virtual stall exhausted dies HERE,
+                    # exactly where a real sleep would have died
+                    ctx.check("fault.delay")
+            else:
+                time.sleep(d)
 
     # -- Groups surface ------------------------------------------------------
     def pool(self, addr: str):
@@ -139,6 +155,15 @@ class FaultSchedule:
     pair always regenerates identically — and historical seeds replay
     byte-for-byte when the newer flags are off.
 
+    `clock_free=True` applies every delay event WITHOUT wall-clock
+    sleeps (ROADMAP follow-on): the delayed link virtually consumes
+    the ambient request budget (`RequestContext.consume`) and counts
+    `fault_virtual_delays_total`, so a schedule heavy with 30 ms
+    stalls fuzzes at full speed while tight budgets still expire
+    exactly as under real stalls. Application-time only — the flag
+    consumes NO rng draw, so historical-seed schedules replay
+    byte-identically with it on or off.
+
     `crash=True` adds WHOLE-NODE CRASH faults: a `crash` event kills
     node `src` outright — it refuses all RPCs in both directions and
     loses every bit of volatile state (tablet caches, chain positions,
@@ -152,10 +177,16 @@ class FaultSchedule:
 
     def __init__(self, seed: int, n_nodes: int, steps: int = 8,
                  max_delay_s: float = 0.03, wal_trunc: bool = False,
-                 deadline: bool = False, crash: bool = False):
+                 deadline: bool = False, crash: bool = False,
+                 clock_free: bool = False):
         import random
         self.seed = seed
         self.n_nodes = n_nodes
+        # clock-free delays change APPLICATION only, never generation:
+        # the flag consumes no rng draw, so every historical (flags,
+        # seed) pair replays byte-identically with it on or off (the
+        # golden-schedule test pins this)
+        self.clock_free = clock_free
         self.dropped: set[tuple[int, int]] = set()
         self.crashed: set[int] = set()  # nodes currently down (apply-time)
         rng = random.Random(seed)
@@ -254,6 +285,7 @@ class FaultSchedule:
             fg.heal_link(addrs[dst])
             self.dropped.discard((src, dst))
         else:
+            fg.clock_free = self.clock_free
             fg.delay_link(addrs[dst], secs)
 
     def heal_all(self, faulty_groups, crash_cb=None) -> None:

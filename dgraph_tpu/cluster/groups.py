@@ -167,6 +167,8 @@ class Groups:
                 continue
             if addr != preferred and rpc:
                 METRICS.inc("failover_total", rpc=rpc)
+                from dgraph_tpu.utils import costprofile
+                costprofile.add("rpc_failovers", 1)
             return out
         raise last if last is not None else RuntimeError(
             f"group {gid} has no nodes")

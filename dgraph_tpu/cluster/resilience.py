@@ -232,10 +232,16 @@ class PeerTable:
         """Run `attempt()` against `addr` under the breaker, retrying
         retryable transport failures within the remaining request
         budget. `attempt` performs exactly one wire call."""
+        from dgraph_tpu.utils import costprofile
         tries = (self.retries + 1) if retryable else 1
         delay = self.backoff_s
         last: Exception | None = None
         for i in range(tries):
+            if i:
+                # re-attempts join the request's cost record: a shape
+                # whose p99 is retry-dominated names a sick peer set,
+                # not an expensive plan
+                costprofile.add("rpc_retries", 1)
             self.acquire(addr)
             t0 = time.perf_counter()
             try:

@@ -13,6 +13,37 @@ from dgraph_tpu.engine.ir import (
 from dgraph_tpu.engine.outputnode import to_json
 
 
+def shape_of(blocks) -> str:
+    """Compact structural fingerprint of a parsed query — the
+    cost-profile shape key (utils/costprofile.py). Built from the
+    BOUNDED vocabulary that predicts cost (root func name, modifiers,
+    tree depth, recurse depth) — never from argument VALUES, so the
+    shape space stays within the cardinality guard for any workload
+    that reuses query templates."""
+    parts = []
+    for sg in blocks[:4]:
+        p = sg.func.name if sg.func is not None else "uid"
+        mods = ""
+        if sg.recurse is not None:
+            mods += f"~r{sg.recurse.depth or 0}"
+        if sg.shortest is not None:
+            mods += "~sp"
+        if sg.filters is not None:
+            mods += "~f"
+        if sg.var_name:
+            mods += "~v"
+        d, node = 0, sg
+        # graftlint: allow(hot-loop-checkpoint): bounded by the parsed
+        # tree's depth (parser-limited), no data-dependent iteration
+        while node.children:
+            d += 1
+            node = node.children[0]
+        parts.append(f"{p}{mods}~d{d}")
+    if len(blocks) > 4:
+        parts.append(f"+{len(blocks) - 4}")
+    return "q:" + ",".join(parts)
+
+
 class Engine:
     """Parse + execute + render DQL queries over a Store snapshot.
 
@@ -61,8 +92,10 @@ class Engine:
         if sq is not None:
             return self._schema_query(*sq), None
 
-        from dgraph_tpu.utils import tracing
+        from dgraph_tpu.utils import costprofile, tracing
         blocks = parse(q, variables)
+        costprofile.add_shape(shape_of(blocks))
+        costprofile.add("queries", 1)
         ex = Executor(self.store, device_threshold=self.device_threshold,
                       mesh=self.mesh)
         results: dict[int, LevelNode] = {}

@@ -22,13 +22,15 @@ snapshot.
 
 from __future__ import annotations
 
+import time
+
 import numpy as np
 
 from dgraph_tpu.engine.execute import Executor, LevelNode
 from dgraph_tpu.engine.ir import SubGraph
 from dgraph_tpu.engine.outputnode import to_json
 from dgraph_tpu.engine.recurse import RecurseData, _bind_recurse_vars
-from dgraph_tpu.utils import deadline, locks, tracing
+from dgraph_tpu.utils import costprofile, deadline, locks, tracing
 from dgraph_tpu.utils.jitcache import Memo, jit_call
 from dgraph_tpu.utils.metrics import METRICS
 
@@ -251,8 +253,11 @@ def plan_batch_groups_cached(store, dqls: list):
     cached = _plan_memo.get(key)
     if cached is not None:
         METRICS.inc("plan_cache_hits_total", cache="batch")
+        costprofile.note("plan_cache_hit", 1)
         return cached
     METRICS.inc("plan_cache_misses_total", cache="batch")
+    costprofile.note("plan_cache_hit", 0)
+    t_plan = time.perf_counter()
     with tracing.span("batch.plan", queries=len(dqls)):
         parsed = {}
         for i, q in enumerate(dqls):
@@ -270,6 +275,8 @@ def plan_batch_groups_cached(store, dqls: list):
     # store under the POST-planning fingerprint: planning may auto-create
     # default schema entries for unknown predicates, which would
     # otherwise shift the lookup key once and miss forever
+    costprofile.add("plan_us",
+                    int((time.perf_counter() - t_plan) * 1e6))
     sch = store.schema
     sch.__dict__.pop("_plan_fp", None)
     _plan_memo.put((_schema_fingerprint(store), tuple(dqls)),
@@ -317,6 +324,10 @@ def run_batch(store, plan, device_threshold: int) -> list:
                 family="recurse")
     METRICS.inc("kernel_padded_lanes_total", float(B - len(seeds)),
                 family="recurse")
+    _note_kernel_features(plan.attr, "recurse", B, B - len(seeds),
+                          plan.depth, len(plan.blocks))
+    costprofile.note_max("bucket_mix", len(g.parts))
+    t_exec = time.perf_counter()
     with tracing.span("batch.recurse_kernel", attr=plan.attr,
                       depth=plan.depth, queries=len(plan.blocks),
                       lanes=B, padded_lanes=B - len(seeds)):
@@ -329,6 +340,13 @@ def run_batch(store, plan, device_threshold: int) -> list:
             _last, _seen, _edges, hops = fn(jax.device_put(mask0),
                                             plan.depth, True)
         hops = np.asarray(hops)      # [depth, n+1, W] fresh masks
+    costprofile.add_kernel(
+        "recurse", execute_us=(time.perf_counter() - t_exec) * 1e6)
+    # gather-traffic model per hop (the bench's HBM model): index reads
+    # + one mask row per padded slot, times the scan depth
+    costprofile.add("bytes_gathered",
+                    plan.depth * g.padded_edges
+                    * (4 + mask0.shape[1] * 4))
     rel = store.rel(plan.attr, plan.reverse)
 
     root_nodes = [np.unique(s).astype(np.int32) for s in seeds]
@@ -348,6 +366,21 @@ def run_batch(store, plan, device_threshold: int) -> list:
 def _lane_count(nq: int) -> int:
     words = -(-nq // 32)
     return 32 * (1 << (words - 1).bit_length() if words > 1 else 1)
+
+
+def _note_kernel_features(attr: str, family: str, lanes: int,
+                          padded: int, depth: int, queries: int) -> None:
+    """Feed one kernel-group launch's plan features into the ambient
+    cost recorder (utils/costprofile.py): the shape component joins the
+    record to its digest key; lanes/padding/depth are the TpuGraphs-
+    style regressors the future cost model trains on."""
+    costprofile.add_shape(f"{family}:{attr}~d{depth}")
+    costprofile.note_max("lanes", lanes)
+    costprofile.note_max("depth", depth)
+    costprofile.add("padded_lanes", padded)
+    costprofile.note_max("padding_frac",
+                         int(1000 * padded / max(lanes, 1)))
+    costprofile.add("queries", queries)
 
 
 def _rebuild_recurse_batch(store, g, rel, hops, blocks,
@@ -405,12 +438,16 @@ def _rebuild_recurse_batch(store, g, rel, hops, blocks,
             fresh = np.unique(kc[lo:hi]).astype(np.int32)
             parents[q] = fresh
             all_nodes[q].append(fresh)
+    edges_total = 0
     for q in range(B):
         if p_parts[q]:
             datas[q].edges[0] = (np.concatenate(p_parts[q]),
                                  np.concatenate(c_parts[q]))
+            edges_total += len(datas[q].edges[0][0])
         datas[q].all_nodes = np.unique(
             np.concatenate(all_nodes[q])).astype(np.int32)
+    if edges_total:
+        costprofile.add("edges_traversed", edges_total)
     return datas
 
 
@@ -469,6 +506,10 @@ def _run_shortest_batch(store, plan: _ShortestPlan,
                     family="shortest")
         METRICS.inc("kernel_padded_lanes_total", float(lanes - B),
                     family="shortest")
+        _note_kernel_features(plan.attr, "shortest", lanes, lanes - B,
+                              plan.depth, B)
+        costprofile.note_max("bucket_mix", len(g.parts))
+        t_exec = time.perf_counter()
         step = _step_for(store, plan.attr, plan.reverse, W,
                          plan.first_visit)
         unresolved = {q: None for q in active}   # q → found level (bfs)
@@ -503,6 +544,10 @@ def _run_shortest_batch(store, plan: _ShortestPlan,
                         if not (alive[wq] & bq):
                             unresolved.pop(q)   # frontier exhausted
                 done += chunk
+        costprofile.add_kernel(
+            "shortest", execute_us=(time.perf_counter() - t_exec) * 1e6)
+        costprofile.add("bytes_gathered",
+                        done * g.padded_edges * (4 + W * 4))
 
     out = []
     for q in range(B):
@@ -638,6 +683,19 @@ def _cache_host(store, attr: str, reverse: bool):
     return base
 
 
+def _note_ell_cache(hit: bool) -> None:
+    """ell_cache_hit feature bit: 1 only when EVERY ELL lookup of the
+    request hit the snapshot cache — one cold build flips it to 0 for
+    the whole record (a build dominates the cost)."""
+    rec = costprofile.active()
+    if rec is None:
+        return
+    if not hit:
+        rec.note("ell_cache_hit", 0)
+    elif "ell_cache_hit" not in rec.vals:
+        rec.note("ell_cache_hit", 1)
+
+
 def _ell_for(store, attr: str, reverse: bool):
     """EllGraph per (snapshot, predicate, direction) — built once,
     reused across batches until the snapshot changes (stores are
@@ -649,19 +707,27 @@ def _ell_for(store, attr: str, reverse: bool):
     key = (attr, reverse)
     cache = getattr(host, "_ell_cache", None)
     if cache is not None and key in cache:  # hot path: no lock
+        _note_ell_cache(hit=True)
         return cache[key]
     with _cache_lock:
         cache = getattr(host, "_ell_cache", None)
         if cache is None:
             cache = host._ell_cache = {}
-        if key not in cache:
+        if key in cache:
+            _note_ell_cache(hit=True)
+        else:
             rel = store.rel(attr, reverse)
             if rel.nnz == 0:
                 cache[key] = None
             else:
+                _note_ell_cache(hit=False)
+                t_build = time.perf_counter()
                 with tracing.span("batch.build_ell", pred=attr,
                                   reverse=reverse):
                     g = build_ell(rel.indptr, rel.indices)
+                costprofile.add(
+                    "build_us",
+                    int((time.perf_counter() - t_build) * 1e6))
                 cache[key] = g
                 # segment-CSR padding waste: padded slots / real edges
                 METRICS.set_gauge("ell_padding_ratio",

@@ -31,6 +31,7 @@ from dgraph_tpu.engine.funcs import (EMPTY, eval_func,
 from dgraph_tpu.engine.ir import FilterNode, FuncNode, Order, SubGraph
 from dgraph_tpu.store.store import Store
 from dgraph_tpu.store.types import Kind
+from dgraph_tpu.utils import costprofile
 from dgraph_tpu.utils import deadline as dl
 from dgraph_tpu.utils import tracing
 from dgraph_tpu.utils.jitcache import jit_call
@@ -121,6 +122,9 @@ class Executor:
                 # the north-star counter, labeled by execution path
                 METRICS.inc("edges_traversed_total", float(len(out[0])),
                             path=path)
+                costprofile.add("edges_traversed", int(len(out[0])))
+                # gather-traffic model: neighbor + seg + position words
+                costprofile.add("bytes_gathered", 16 * int(len(out[0])))
             return out
 
     def _expand_routed(self, pred: str, reverse: bool,
@@ -134,6 +138,8 @@ class Executor:
                 if out is not None:
                     return out, "remote"
         rel = self.store.rel(pred, reverse)
+        # cost-model regressor: the largest tablet this request touched
+        costprofile.note_max("tablet_rows", int(len(rel.indptr)) - 1)
         if len(frontier) == 0 or rel.nnz == 0:
             return (EMPTY, EMPTY, EMPTY64), "empty"
         if len(frontier) >= self.device_threshold:
@@ -580,6 +586,10 @@ class Executor:
                 if len(fused[0]):
                     METRICS.inc("edges_traversed_total",
                                 float(len(fused[0])), path="fused")
+                    costprofile.add("edges_traversed",
+                                    int(len(fused[0])))
+                    costprofile.add("bytes_gathered",
+                                    16 * int(len(fused[0])))
                 return (*fused, True)
             nbrs, seg, pos = self.expand(
                 sg.attr, sg.is_reverse, frontier,

@@ -346,7 +346,10 @@ def run_tree_batch(store, plan: TreePlan, device_threshold: int) -> list:
     seeds_np = [_pack_global(n, lst, lanes) for lst in seed_lists]
     filts_np = [_pack_global(n, lst, lanes) for lst in filt_lists]
 
-    from dgraph_tpu.utils import deadline, tracing
+    import time as _time
+
+    from dgraph_tpu.engine.batch import _note_kernel_features
+    from dgraph_tpu.utils import costprofile, deadline, tracing
     from dgraph_tpu.utils.jitcache import jit_call
     from dgraph_tpu.utils.metrics import METRICS
     # budget gate before the device is committed to the fused program
@@ -355,12 +358,17 @@ def run_tree_batch(store, plan: TreePlan, device_threshold: int) -> list:
     METRICS.inc("kernel_group_queries_total", float(B), family="tree")
     METRICS.inc("kernel_padded_lanes_total", float(lanes - B),
                 family="tree")
+    _note_kernel_features("*", "tree", lanes, lanes - B,
+                          len(plan.stages), B)
     fn, stage_descs = _tree_kernel_for(store, plan, rels, n, W)
+    t_exec = _time.perf_counter()
     with tracing.span("batch.tree_kernel", stages=len(plan.stages),
                       queries=B, lanes=lanes, padded_lanes=lanes - B):
         with jit_call("treebatch.tree_kernel", (plan.sig, W, n)):
             outs = fn(tuple(jax.device_put(m) for m in seeds_np),
                       tuple(jax.device_put(m) for m in filts_np))
+    costprofile.add_kernel(
+        "tree", execute_us=(_time.perf_counter() - t_exec) * 1e6)
 
     # one host transfer per stage output; bit tests against these masks
     # rebuild every query's edge rows

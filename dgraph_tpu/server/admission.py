@@ -33,7 +33,7 @@ import threading
 import time
 from collections import deque
 
-from dgraph_tpu.utils import locks, tracing
+from dgraph_tpu.utils import costprofile, locks, tracing
 from dgraph_tpu.utils.metrics import METRICS
 
 __all__ = ["AdmissionController", "ServerOverloaded", "LANES"]
@@ -147,8 +147,9 @@ class _Lane:
                     ctx.check("admission")
                 raise ServerOverloaded(  # cancel-less fallback
                     f"{self.name} lane wait abandoned", lane=self.name)
-        METRICS.observe("admission_wait_us",
-                        (time.perf_counter() - t0) * 1e6, lane=self.name)
+        wait_us = (time.perf_counter() - t0) * 1e6
+        METRICS.observe("admission_wait_us", wait_us, lane=self.name)
+        costprofile.add("admission_wait_us", int(wait_us))
 
     def release(self, service_s: float | None = None) -> None:
         """Return a token; the OLDEST waiter inherits it (FIFO)."""

@@ -31,6 +31,7 @@ from dgraph_tpu.store.mvcc import MVCCStore, Mutation
 from dgraph_tpu.store.schema import parse_schema
 from dgraph_tpu.store.store import Store
 from dgraph_tpu.store.types import Kind, hash_password
+from dgraph_tpu.utils import costprofile
 from dgraph_tpu.utils import deadline as dl
 from dgraph_tpu.utils import tracing
 from dgraph_tpu.utils.metrics import METRICS
@@ -171,6 +172,10 @@ class Alpha:
         alpha.oracle.bump_ts(max_ts)
         if max_uid:
             alpha.oracle.bump_uid(max_uid)
+        # cost-profile continuity: merge the aggregate the previous run
+        # persisted next to the checkpoint (digest merge is exact, so
+        # restart never resets the cost dataset)
+        costprofile.load(os.path.join(p_dir, "costprofiles.json"))
         return alpha
 
     def attach_wal(self, wal_path: str, sync: bool = True) -> tuple[int, int]:
@@ -265,6 +270,7 @@ class Alpha:
                 if self.wal is not None:
                     self.wal.truncate(ts)
                 self._wal_floor = max(self._wal_floor, ts)
+            self._save_costprofiles(p_dir)
             return ts
         with self._apply_lock:
             store = self.mvcc.rollup()
@@ -276,7 +282,17 @@ class Alpha:
             if self.wal is not None:
                 self.wal.truncate(ts)
             self._wal_floor = max(self._wal_floor, ts)
+        self._save_costprofiles(p_dir)
         return ts
+
+    @staticmethod
+    def _save_costprofiles(p_dir: str) -> None:
+        """Persist the cost-profile aggregate beside the checkpoint
+        (best effort — cost history is telemetry, never worth failing
+        a checkpoint over)."""
+        import os
+        with contextlib.suppress(OSError):
+            costprofile.save(os.path.join(p_dir, "costprofiles.json"))
 
     def maintenance_rollup(self, p_dir: str | None = None,
                            pace=None) -> int:
@@ -354,7 +370,10 @@ class Alpha:
         if deadline_ms is None and self.default_deadline_ms:
             deadline_ms = self.default_deadline_ms
         ctx = dl.RequestContext(deadline_ms)
-        with dl.activate(ctx):
+        # cost profile opens BEFORE admission so queue wait is part of
+        # the record; outcomes (ok/shed/deadline/cancelled/error)
+        # classify at close (utils/costprofile.py)
+        with dl.activate(ctx), costprofile.profile(lane):
             if self.admission is not None:
                 with self.admission.admit(lane, ctx):
                     # budget may have died while queued

@@ -16,17 +16,27 @@ import socket
 import threading
 import time
 import urllib.parse
+from collections import deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from dgraph_tpu.dql.upsert import is_upsert as _is_upsert
 from dgraph_tpu.server.admission import ServerOverloaded
 from dgraph_tpu.server.api import (Alpha, NoQuorum, ReadUnavailable,
                                    TxnAborted)
+from dgraph_tpu.utils import costprofile, locks
 from dgraph_tpu.utils import deadline as dl
 from dgraph_tpu.utils import logging as xlog
 from dgraph_tpu.utils import tracing
 from dgraph_tpu.utils.deadline import Cancelled, DeadlineExceeded
 from dgraph_tpu.utils.metrics import METRICS
+
+# structured slow-query ring: every --slow_query_ms overrun keeps its
+# trace_id alongside the log line, so GET /debug/slow_queries →
+# /debug/traces?trace_id= resolves a slow query's full span tree in
+# one hop (the log-line form carried the id; nothing served it)
+_SLOW_MAX = 256
+_SLOW_LOG: deque = deque(maxlen=_SLOW_MAX)
+_SLOW_LOCK = locks.make_lock("http.slowlog")
 
 # how often the per-request watcher peeks the client socket for a
 # mid-request disconnect (an abandoned request must release its
@@ -203,6 +213,35 @@ def make_http_server(alpha: Alpha, addr: str = "127.0.0.1",
                 # body directly in Perfetto / chrome://tracing
                 spans = self._debug_spans()
                 self._send(200, tracing.to_chrome(spans))
+            elif self.path.startswith("/debug/costs"):
+                # shape-keyed query cost profiles: per-shape percentile
+                # digests + feature means + the top-N most expensive
+                # shapes (utils/costprofile.py — the cost-model dataset)
+                qs = urllib.parse.parse_qs(
+                    urllib.parse.urlsplit(self.path).query)
+                n = int((qs.get("n") or [10])[0])
+                doc = costprofile.summary(top_n=n)
+                if (qs.get("recent") or ["false"])[0] == "true":
+                    doc["recent"] = costprofile.recent(min(n, 100))
+                self._send(200, doc)
+            elif self.path.startswith("/debug/slow_queries"):
+                # the slow-query ring; ?trace_id= filters to one
+                # request, whose span tree is one hop away at
+                # /debug/traces?trace_id=
+                qs = urllib.parse.parse_qs(
+                    urllib.parse.urlsplit(self.path).query)
+                tid = (qs.get("trace_id") or [None])[0]
+                now = dl.monotonic_s()
+                with _SLOW_LOCK:
+                    entries = [e for e in _SLOW_LOG
+                               if tid is None or e["trace_id"] == tid]
+                self._send(200, {"slow_queries": [
+                    {**{k: v for k, v in e.items() if k != "mono_s"},
+                     "age_s": round(now - e["mono_s"], 3)}
+                    for e in entries]})
+            elif self.path.startswith("/debug/profile"):
+                # capture status; POST starts/stops (single-flight)
+                self._send(200, tracing.profile_status())
             elif self.path.startswith("/debug/admission"):
                 # admission-control status: per-lane inflight/queued/
                 # shed counts + limits (the numbers the overload
@@ -273,7 +312,9 @@ def make_http_server(alpha: Alpha, addr: str = "127.0.0.1",
             """Slow-query log (reference: the query log at --v=3 /
             slow-query tooling): queries past --slow_query_ms log with
             their trace id so the spans can be pulled from
-            /debug/traces after the fact."""
+            /debug/traces after the fact; the structured entry also
+            lands in the /debug/slow_queries ring, filterable by
+            ?trace_id= (one-hop correlation to the span tree)."""
             thresh_ms = getattr(alpha, "slow_query_ms", 0) or 0
             if thresh_ms <= 0 or us < thresh_ms * 1000:
                 return
@@ -282,6 +323,12 @@ def make_http_server(alpha: Alpha, addr: str = "127.0.0.1",
                 "slow query: %.1f ms (threshold %s ms) trace_id=%s "
                 "query=%.200s", us / 1000.0, thresh_ms, trace_id,
                 " ".join(q.split()))
+            with _SLOW_LOCK:
+                _SLOW_LOG.append({
+                    "trace_id": trace_id, "us": int(us),
+                    "threshold_ms": thresh_ms,
+                    "query": " ".join(q.split())[:200],
+                    "mono_s": dl.monotonic_s()})
 
         def _acl_user(self):
             """Resolve the access token when ACL is on (reference: the
@@ -407,6 +454,34 @@ def make_http_server(alpha: Alpha, addr: str = "127.0.0.1",
                 self._send(200, {"data": {"accessJWT": token}})
                 return
             acl_user = self._acl_user()
+            if self.path.startswith("/debug/profile"):
+                # on-demand jax.profiler device capture (admin bar):
+                # {"action": "start"|"stop", "dir"?: path}. start while
+                # one is running → 409 (single-flight, tracing.py);
+                # the XLA timeline lands under <dir>/plugins/profile/
+                if alpha.acl is not None:
+                    alpha.acl.check_alter(acl_user)
+                body = self._body().decode()
+                req = json.loads(body) if body.strip() else {}
+                action = req.get("action", "start")
+                try:
+                    if action == "start":
+                        d = tracing.profile_start(req.get("dir")
+                                                  or None)
+                        self._send(200, {"data": {"profiling": True,
+                                                  "dir": d}})
+                    elif action == "stop":
+                        d = tracing.profile_stop()
+                        self._send(200, {"data": {"profiling": False,
+                                                  "dir": d}})
+                    else:
+                        self._send(400, {"errors": [{
+                            "message": f"unknown action {action!r} "
+                                       f"(want start|stop)"}]})
+                except RuntimeError as e:
+                    # single-flight conflict / no capture running
+                    self._send(409, {"errors": [{"message": str(e)}]})
+                return
             deadline_ms = self._deadline_ms()
             if self.path.startswith("/query/batch"):
                 req = json.loads(self._body().decode())

@@ -442,6 +442,8 @@ class Client:
         self.fault_check = None
 
     def _call(self, service: str, method: str, req, resp_cls):
+        from dgraph_tpu.utils import costprofile
+        costprofile.add("rpc_legs", 1)
         rpc = self.channel.unary_unary(
             f"/{service}/{method}",
             request_serializer=lambda m: m.SerializeToString(),

@@ -69,6 +69,10 @@ class AlphaConfig:
                                         # doubling per re-open)
     trace_export: str = ""        # write the span registry as
                                   # OTLP/JSON here on shutdown
+    # live telemetry push (utils/push.py): stream spans + cost records
+    # to an OTLP collector while serving (unset = graceful no-op)
+    telemetry_push_url: str = ""      # collector base URL (…/v1/traces)
+    telemetry_push_interval_s: float = 5.0  # batch flush cadence
     encryption_key_file: str = ""  # at-rest AES key (reference: ee enc)
     encryption_strict: bool = False  # reject plaintext files once migrated
     slow_query_ms: int = 0        # log queries slower than this (0 = off)

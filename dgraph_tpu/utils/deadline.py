@@ -111,6 +111,19 @@ class RequestContext:
         return (self.deadline is not None
                 and time.monotonic() >= self.deadline)
 
+    def consume(self, seconds: float) -> None:
+        """VIRTUALLY advance this request's clock by `seconds`: the
+        deadline moves earlier by exactly that much, so budget
+        arithmetic (checkpoints, RPC timeout forwarding, admission
+        waits) behaves as if the time had really passed — without any
+        wall-clock sleep. This is the clock-free delay-fault primitive
+        (cluster/fault.py): a fuzzed 30 ms link stall costs the fuzz
+        run zero wall time but still expires tight budgets exactly
+        like a real stall. Unbounded contexts have no budget to
+        consume; the caller's drop counter still records the event."""
+        if self.deadline is not None and seconds > 0:
+            self.deadline -= seconds
+
     def check(self, stage: str = "") -> None:
         """Raise (retryably) if the budget is gone — the cooperative
         cancellation point. Metrics label the STAGE that noticed, so an

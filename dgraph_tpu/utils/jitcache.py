@@ -40,12 +40,14 @@ def jit_call(kernel: str, key: tuple):
     """Wrap one jitted-kernel launch; classifies it as compile (first
     time this static key is seen) or cache hit, and feeds the shared
     metrics/tracing registries. Yields True when a compile is expected."""
+    from dgraph_tpu.utils import costprofile
     with _lock:
         new = (kernel, key) not in _seen
         if new:
             _seen.add((kernel, key))
     if not new:
         METRICS.inc("jit_cache_hits_total", kernel=kernel)
+        costprofile.add("jit_cache_hits", 1)
         yield False
         return
     METRICS.inc("jit_compile_total", kernel=kernel)
@@ -54,9 +56,12 @@ def jit_call(kernel: str, key: tuple):
         try:
             yield True
         finally:
-            METRICS.observe("jit_compile_us",
-                            (time.perf_counter() - t0) * 1e6,
+            compile_us = (time.perf_counter() - t0) * 1e6
+            METRICS.observe("jit_compile_us", compile_us,
                             buckets=COMPILE_BUCKETS_US, kernel=kernel)
+            # per-kernel-family compile cost joins the request's cost
+            # record (the compile-vs-execute split the cost model needs)
+            costprofile.add_kernel(kernel, compile_us=compile_us)
 
 
 def reset() -> None:

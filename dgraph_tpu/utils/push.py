@@ -1,0 +1,155 @@
+"""Live telemetry push: spans + cost records → an OTLP collector.
+
+ROADMAP carried "live span push to a collector (export is
+shutdown/pull-shaped today)" since PR 4 — `--trace_export` writes
+OTLP/JSON at shutdown and `/debug/traces` serves pulls, but nothing
+STREAMS, so the chip window's telemetry is only attributable
+post-mortem. This closes it: a `TelemetryPusher` subscribes to the
+span registry (tracing.add_sink) and the cost-record stream
+(costprofile.add_sink), buffers bounded, and a background thread POSTs
+batches to the collector:
+
+  * spans      → `<url>/v1/traces` as OTLP/JSON (`tracing.to_otlp`)
+  * cost recs  → `<url>/v1/costs`  as `{"records": [...]}` JSON
+
+Contracts (tested in tests/test_costprofile.py):
+  * NEVER blocks the request path: the sink appends under a lock; a
+    full buffer drops the OLDEST entry and counts
+    `telemetry_dropped_total{kind=}` — an explicit drop counter, not a
+    silent deque overflow.
+  * retry with backoff: a failed POST re-queues its batch at the front
+    (oldest-first order preserved), doubles the delay (jittered cap),
+    and counts `telemetry_push_total{outcome="error"}`; successes
+    count `outcome="ok"`.
+  * graceful no-op when unconfigured: the CLI only constructs a pusher
+    when `--telemetry_push_url` is set.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.request
+
+from dgraph_tpu.utils import costprofile, locks, tracing
+from dgraph_tpu.utils.metrics import METRICS
+
+__all__ = ["TelemetryPusher"]
+
+_BACKOFF_BASE_S = 0.5
+_BACKOFF_CAP_S = 30.0
+
+
+class TelemetryPusher:
+    """Background exporter thread with a bounded two-stream buffer."""
+
+    def __init__(self, url: str, interval_s: float = 5.0,
+                 buffer_max: int = 2048, batch_max: int = 256,
+                 timeout_s: float = 2.0):
+        self.url = url.rstrip("/")
+        self.interval_s = max(float(interval_s), 0.05)
+        self.buffer_max = int(buffer_max)
+        self.batch_max = int(batch_max)
+        self.timeout_s = float(timeout_s)
+        self._spans: list = []
+        self._costs: list = []
+        self._lock = locks.make_lock("push.buffer")
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._backoff_s = 0.0
+
+    # -- request-path sinks (must stay cheap + non-blocking) -----------------
+    def _offer(self, buf: list, kind: str, item) -> None:
+        with self._lock:
+            if len(buf) >= self.buffer_max:
+                del buf[0]
+                METRICS.inc("telemetry_dropped_total", kind=kind)
+            buf.append(item)
+
+    def offer_span(self, span) -> None:
+        self._offer(self._spans, "span", span)
+
+    def offer_cost(self, record: dict) -> None:
+        self._offer(self._costs, "cost", record)
+
+    # -- lifecycle ------------------------------------------------------------
+    def start(self) -> "TelemetryPusher":
+        tracing.add_sink(self.offer_span)
+        costprofile.add_sink(self.offer_cost)
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="telemetry-push")
+        self._thread.start()
+        return self
+
+    def stop(self, flush: bool = True) -> None:
+        """Unsubscribe and stop; `flush=True` attempts one final push
+        of whatever is buffered (best effort — shutdown never hangs on
+        a dead collector beyond one POST timeout per stream)."""
+        tracing.remove_sink(self.offer_span)
+        costprofile.remove_sink(self.offer_cost)
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self.timeout_s * 3)
+        if flush:
+            self._push_once()
+
+    # -- exporter loop --------------------------------------------------------
+    def _run(self) -> None:
+        while not self._stop.wait(self._backoff_s or self.interval_s):
+            self._push_once()
+
+    def _take(self) -> tuple[list, list]:
+        with self._lock:
+            spans = self._spans[: self.batch_max]
+            del self._spans[: len(spans)]
+            costs = self._costs[: self.batch_max]
+            del self._costs[: len(costs)]
+        return spans, costs
+
+    def _requeue(self, buf: list, kind: str, batch: list) -> None:
+        """Put a failed batch back at the FRONT (order preserved);
+        entries that no longer fit drop, counted."""
+        with self._lock:
+            room = self.buffer_max - len(buf)
+            if room < len(batch):
+                METRICS.inc("telemetry_dropped_total",
+                            float(len(batch) - max(room, 0)), kind=kind)
+                batch = batch[len(batch) - max(room, 0):]
+            buf[:0] = batch
+
+    def _push_once(self) -> None:
+        spans, costs = self._take()
+        if not spans and not costs:
+            return
+        try:
+            if spans:
+                self._post("/v1/traces", tracing.to_otlp(spans))
+            if costs:
+                self._post("/v1/costs", {"records": costs})
+            METRICS.inc("telemetry_push_total", outcome="ok")
+            self._backoff_s = 0.0
+        except Exception:  # noqa: BLE001 — collector down ≠ serving down
+            METRICS.inc("telemetry_push_total", outcome="error")
+            self._requeue(self._spans, "span", spans)
+            self._requeue(self._costs, "cost", costs)
+            self._backoff_s = min(
+                _BACKOFF_CAP_S,
+                (self._backoff_s or _BACKOFF_BASE_S) * 2)
+
+    def _post(self, path: str, doc: dict) -> None:
+        req = urllib.request.Request(
+            self.url + path, data=json.dumps(doc).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        # graftlint: allow(direct-io): telemetry export to an EXTERNAL
+        # collector, not a cluster RPC — it must not ride the peer
+        # breaker/retry wrapper; this loop has its own bounded
+        # retry/backoff/drop policy
+        with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
+            r.read()
+
+    def status(self) -> dict:
+        with self._lock:
+            return {"url": self.url, "interval_s": self.interval_s,
+                    "buffered_spans": len(self._spans),
+                    "buffered_costs": len(self._costs),
+                    "backoff_s": self._backoff_s}

@@ -42,6 +42,7 @@ _LOCK = locks.make_lock("tracing.registry")
 _TLS = threading.local()
 _IDS = itertools.count(1)  # CPython: count.__next__ is atomic
 _ENABLED = True
+_SINKS: list = []          # live-export subscribers (utils/push.py)
 
 
 @dataclass
@@ -81,6 +82,64 @@ def enable_device_trace(trace_dir: str) -> None:
     """Arm jax.profiler capture for the next `span(..., device=True)`."""
     global _TRACE_DIR
     _TRACE_DIR = trace_dir
+
+
+# -- on-demand device profiling (POST /debug/profile) ------------------------
+# jax.profiler trace capture is process-global and NOT reentrant:
+# start/stop are single-flight behind a lock, so two operators hitting
+# /debug/profile concurrently can never corrupt a capture.
+_PROFILE_LOCK = locks.make_lock("tracing.profile")
+_PROFILE_DIR: str | None = None
+
+
+def profile_start(trace_dir: str | None = None) -> str:
+    """Start a jax.profiler trace capture under `trace_dir` (default:
+    the dir `enable_device_trace`/`--trace_dir` armed). Raises when no
+    dir is configured or a capture is already running (single-flight).
+    Returns the capture dir."""
+    from dgraph_tpu.utils.metrics import METRICS
+    global _PROFILE_DIR
+    d = trace_dir or _TRACE_DIR
+    if not d:
+        raise ValueError("no trace dir configured — start the server "
+                         "with --trace_dir or pass {\"dir\": ...}")
+    with _PROFILE_LOCK:
+        if _PROFILE_DIR is not None:
+            raise RuntimeError(
+                f"a device profile is already capturing under "
+                f"{_PROFILE_DIR} — stop it first (single-flight)")
+        import jax
+        jax.profiler.start_trace(d)
+        _PROFILE_DIR = d
+        METRICS.inc("device_profile_captures_total", outcome="started")
+        return d
+
+
+def profile_stop() -> str:
+    """Stop the running capture and return its dir; the XLA-level
+    timeline lands under `<dir>/plugins/profile/` (Perfetto/
+    TensorBoard-loadable)."""
+    from dgraph_tpu.utils.metrics import METRICS
+    global _PROFILE_DIR
+    with _PROFILE_LOCK:
+        if _PROFILE_DIR is None:
+            raise RuntimeError("no device profile is running")
+        d, _PROFILE_DIR = _PROFILE_DIR, None
+        import jax
+        try:
+            jax.profiler.stop_trace()
+        except Exception:
+            METRICS.inc("device_profile_captures_total",
+                        outcome="error")
+            raise
+        METRICS.inc("device_profile_captures_total", outcome="ok")
+        return d
+
+
+def profile_status() -> dict:
+    with _PROFILE_LOCK:
+        return {"running": _PROFILE_DIR is not None,
+                "dir": _PROFILE_DIR}
 
 
 def new_trace_id() -> str:
@@ -159,6 +218,26 @@ def span(name: str, device: bool = False, **attrs):
                         _TRACES.popitem(last=False)
                 if len(spans) < _MAX_TRACE_SPANS:
                     spans.append(s)
+        if _SINKS:
+            # live push (outside the lock): sinks buffer-and-return —
+            # the request path never blocks on a collector
+            for sink in tuple(_SINKS):
+                try:
+                    sink(s)
+                except Exception:  # noqa: BLE001 — a sink must never fail a span
+                    pass
+
+
+def add_sink(fn) -> None:
+    """Subscribe to completed spans (the live push pipeline). Sinks run
+    on the closing thread and must be non-blocking."""
+    if fn not in _SINKS:
+        _SINKS.append(fn)
+
+
+def remove_sink(fn) -> None:
+    with contextlib.suppress(ValueError):
+        _SINKS.remove(fn)
 
 
 def recent(n: int = 100) -> list[Span]:

@@ -1,0 +1,449 @@
+"""Query cost profiles (ISSUE 8): digest algebra, cardinality guard,
+persistence, the served-workload acceptance path, the live push
+pipeline under fault injection, on-demand device profiling, and the
+<5% uncontended hot-path overhead guard.
+
+The digests must merge EXACTLY (integer state) — bench aggregates,
+serving aggregates, and restart-persisted aggregates combine in any
+order; the guard/persistence/overhead contracts mirror the ones
+utils/metrics.py and utils/tracing.py already hold.
+"""
+
+import gzip
+import json
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from dgraph_tpu.server.api import Alpha
+from dgraph_tpu.server.http import make_http_server, serve_background
+from dgraph_tpu.utils import costprofile, tracing
+from dgraph_tpu.utils.costprofile import (Aggregator, Digest, FIELDS,
+                                          DIGEST_FIELDS, FEATURE_FIELDS)
+from dgraph_tpu.utils.metrics import METRICS
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    costprofile.reset()
+    costprofile.set_enabled(True)
+    yield
+    costprofile.set_enabled(True)
+    costprofile.reset()
+
+
+def _digest_of(values):
+    d = Digest()
+    for v in values:
+        d.add(v)
+    return d
+
+
+# ---------------------------------------------------------------------------
+# digest algebra
+
+def test_digest_merge_is_exact_and_associative():
+    """(a ⊕ b) ⊕ c == a ⊕ (b ⊕ c), bit for bit: integer bucket counts
+    and integer sums make the merge order-independent — the property
+    that lets bench, serving, and persisted aggregates combine."""
+    rng = np.random.default_rng(7)
+    parts = [list(rng.integers(0, 10**7, 200)) for _ in range(3)]
+    a, b, c = (_digest_of(p) for p in parts)
+    left = _digest_of(parts[0]).merge(_digest_of(parts[1]))
+    left.merge(_digest_of(parts[2]))
+    right_inner = _digest_of(parts[1]).merge(_digest_of(parts[2]))
+    right = _digest_of(parts[0]).merge(right_inner)
+    assert left.to_dict() == right.to_dict()
+    # and the merged digest equals the digest of the concatenation
+    combined = _digest_of(parts[0] + parts[1] + parts[2])
+    assert left.to_dict() == combined.to_dict()
+    assert combined.count == 600
+    assert combined.sum == sum(map(int, parts[0] + parts[1] + parts[2]))
+
+
+def test_digest_percentiles_bracket_the_data():
+    vals = [10] * 90 + [100_000] * 10
+    d = _digest_of(vals)
+    assert 8 <= d.percentile(0.5) <= 16     # within bucket resolution
+    assert d.percentile(0.99) >= 65_536     # lands in the tail bucket
+    assert d.percentile(0.99) <= d.max
+    assert d.min == 10 and d.max == 100_000
+    # round trip preserves every field
+    assert Digest.from_dict(d.to_dict()).to_dict() == d.to_dict()
+
+
+def test_empty_digest_is_safe():
+    d = Digest()
+    assert d.percentile(0.99) == 0
+    assert d.to_dict()["count"] == 0
+
+
+# ---------------------------------------------------------------------------
+# shape cardinality guard (the metrics label-limit discipline)
+
+def test_shape_cardinality_overflows_to_other():
+    agg = Aggregator(max_shapes=4)
+    before = METRICS.get("cost_shapes_dropped_total")
+    for i in range(10):
+        agg.record({"shape": f"s{i}", "total_us": 100 + i})
+    doc = agg.to_doc()
+    assert doc["records_total"] == 10
+    assert set(doc["shapes"]) == {"s0", "s1", "s2", "s3", "other"}
+    assert doc["shapes"]["other"]["count"] == 6
+    assert METRICS.get("cost_shapes_dropped_total") == before + 6
+    # KNOWN shapes keep recording exactly after the cap
+    agg.record({"shape": "s0", "total_us": 7})
+    assert agg.to_doc()["shapes"]["s0"]["count"] == 2
+
+
+# ---------------------------------------------------------------------------
+# persistence
+
+def test_persistence_round_trip_and_merge(tmp_path):
+    agg = Aggregator()
+    rng = np.random.default_rng(3)
+    for i in range(50):
+        agg.record({"shape": f"s{i % 3}",
+                    "total_us": int(rng.integers(1, 10**6)),
+                    "edges_traversed": int(rng.integers(0, 1000)),
+                    "lanes": 64, "depth": 4})
+    p = tmp_path / "costprofiles.json"
+    agg.save(str(p))
+    # round trip: the restored state is byte-identical
+    restored = Aggregator.from_state(json.loads(p.read_text()))
+    assert restored.to_state() == agg.to_state()
+    # merging the persisted aggregate into an empty one (the boot path)
+    # reproduces the original; merging it TWICE doubles counts exactly
+    boot = Aggregator()
+    assert boot.load(str(p))
+    assert boot.to_state() == agg.to_state()
+    boot.load(str(p))
+    assert boot.records_total == 2 * agg.records_total
+    s0 = boot.to_doc()["shapes"]["s0"]
+    assert s0["count"] == 2 * agg.to_doc()["shapes"]["s0"]["count"]
+    # corrupt/missing files are a no-op, never a boot failure
+    assert not Aggregator().load(str(tmp_path / "absent.json"))
+    bad = tmp_path / "bad.json"
+    bad.write_text("{not json")
+    assert not Aggregator().load(str(bad))
+
+
+def test_alpha_checkpoint_persists_and_reopen_merges(tmp_path):
+    """The serving wiring: checkpoint_to writes costprofiles.json next
+    to the checkpoint; Alpha.open merges it back — restart continuity
+    for the cost dataset."""
+    a = Alpha(device_threshold=10**9)
+    a.alter("name: string @index(exact) .")
+    a.mutate(set_nquads='_:a <name> "x" .')
+    a.query('{ q(func: eq(name, "x")) { name } }')
+    assert costprofile.COSTS.records_total >= 1
+    p_dir = str(tmp_path / "p")
+    a.checkpoint_to(p_dir)
+    state = json.loads((tmp_path / "p" / "costprofiles.json").read_text())
+    assert state["records_total"] == costprofile.COSTS.records_total
+    persisted = state["records_total"]
+    costprofile.reset()
+    a2 = Alpha.open(p_dir)
+    assert costprofile.COSTS.records_total == persisted
+    assert a2.mvcc.base.n_nodes >= 1
+
+
+# ---------------------------------------------------------------------------
+# record schema ↔ field vocabulary
+
+def test_records_speak_the_shared_vocabulary():
+    """Every record key is in FIELDS (the vocabulary facts re-exports),
+    and every cost/feature field appears in every record — the schema a
+    training pipeline can rely on."""
+    a = Alpha(device_threshold=10**9)
+    a.alter("name: string @index(exact) .")
+    a.mutate(set_nquads='_:a <name> "x" .')
+    a.query('{ q(func: eq(name, "x")) { name } }')
+    rec = costprofile.recent(1)[0]
+    assert set(rec) == set(FIELDS)
+    for f in DIGEST_FIELDS + FEATURE_FIELDS:
+        assert isinstance(rec[f], int), f
+    assert rec["outcome"] == "ok"
+    assert rec["shape"].startswith("q:")
+    assert rec["total_us"] > 0
+
+
+# ---------------------------------------------------------------------------
+# acceptance: a served batch workload shows up shape-keyed in /debug/costs
+
+def _batch_alpha():
+    a = Alpha(device_threshold=10**9)
+    a.alter("friend: [uid] @reverse .\nname: string @index(exact) .")
+    rng = np.random.default_rng(5)
+    lines = []
+    for i in range(1, 64):
+        lines.append(f'<{i}> <name> "p{i}" .')
+        for j in rng.integers(1, 64, 3):
+            if i != int(j):
+                lines.append(f"<{i}> <friend> <{int(j)}> .")
+    a.mutate(set_nquads="\n".join(lines))
+    return a
+
+
+def test_debug_costs_serves_shape_digests_for_batch_workload():
+    a = _batch_alpha()
+    a.slow_query_ms = 0.001  # everything is "slow": exercise the ring
+    srv = make_http_server(a)
+    serve_background(srv)
+    base = f"http://127.0.0.1:{srv.server_address[1]}"
+    try:
+        qs = ["{ q(func: uid(%d)) @recurse(depth: 3) { friend uid } }"
+              % i for i in range(1, 9)]
+        req = urllib.request.Request(
+            base + "/query/batch",
+            data=json.dumps({"queries": qs}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req) as r:
+            out = json.loads(r.read())
+        tid = out["extensions"]["trace_id"]
+        assert len(out["data"]) == 8
+
+        with urllib.request.urlopen(base + "/debug/costs?n=5") as r:
+            doc = json.loads(r.read())
+        assert doc["records_total"] >= 1
+        shape = "recurse:friend~d3"
+        assert shape in doc["shapes"], sorted(doc["shapes"])
+        st = doc["shapes"][shape]
+        assert st["costs"]["total_us"]["p50"] > 0
+        assert st["features"]["lanes"] == 32.0
+        assert st["features"]["queries"] == 8.0
+        assert any(t["shape"] == shape for t in doc["top"])
+
+        # the record's span form is joined to the request's trace
+        with urllib.request.urlopen(
+                base + f"/debug/traces?trace_id={tid}") as r:
+            spans = json.loads(r.read())["spans"]
+        cost_spans = [s for s in spans if s["name"] == "query.cost"]
+        assert cost_spans and cost_spans[0]["attrs"]["shape"] == shape
+
+        # slow-query ring correlates by trace_id in one hop
+        with urllib.request.urlopen(
+                base + f"/debug/slow_queries?trace_id={tid}") as r:
+            slow = json.loads(r.read())["slow_queries"]
+        assert slow and slow[0]["trace_id"] == tid
+        with urllib.request.urlopen(base + "/debug/slow_queries") as r:
+            assert len(json.loads(r.read())["slow_queries"]) >= len(slow)
+    finally:
+        srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# acceptance: live push pipeline under fault injection
+
+class _Collector:
+    """Local collector stub: stores POST bodies; can fail the first N
+    requests (fault injection for the retry path)."""
+
+    def __init__(self, fail_first: int = 0):
+        self.traces: list = []
+        self.costs: list = []
+        self.fail_remaining = fail_first
+        self.lock = threading.Lock()
+        coll = self
+
+        class H(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                body = self.rfile.read(
+                    int(self.headers.get("Content-Length") or 0))
+                with coll.lock:
+                    if coll.fail_remaining > 0:
+                        coll.fail_remaining -= 1
+                        self.send_response(503)
+                        self.end_headers()
+                        return
+                    doc = json.loads(body)
+                    if self.path == "/v1/traces":
+                        coll.traces.append(doc)
+                    else:
+                        coll.costs.append(doc)
+                self.send_response(200)
+                self.end_headers()
+
+        self.srv = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        threading.Thread(target=self.srv.serve_forever,
+                         daemon=True).start()
+        self.url = f"http://127.0.0.1:{self.srv.server_address[1]}"
+
+    def close(self):
+        self.srv.shutdown()
+
+
+def test_pusher_delivers_spans_and_costs_through_faults():
+    """The exporter delivers both streams to a collector that FAILS the
+    first requests (retry-with-backoff, order preserved), while the
+    request path never blocks."""
+    from dgraph_tpu.utils.push import TelemetryPusher
+    coll = _Collector(fail_first=2)
+    pusher = TelemetryPusher(coll.url, interval_s=0.05,
+                             timeout_s=2.0).start()
+    try:
+        a = Alpha(device_threshold=10**9)
+        a.alter("name: string @index(exact) .")
+        a.mutate(set_nquads='_:a <name> "x" .')
+        with tracing.trace("push-test"):
+            a.query('{ q(func: eq(name, "x")) { name } }')
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline:
+            with coll.lock:
+                if coll.traces and coll.costs:
+                    break
+            time.sleep(0.05)
+        with coll.lock:
+            assert coll.traces, "spans never reached the collector"
+            assert coll.costs, "cost records never reached the collector"
+            names = [s["name"]
+                     for doc in coll.traces
+                     for rs in doc["resourceSpans"]
+                     for ss in rs["scopeSpans"]
+                     for s in ss["spans"]]
+            recs = [r for doc in coll.costs for r in doc["records"]]
+        assert "engine.query" in names
+        assert any(r["shape"].startswith("q:") for r in recs)
+        assert set(recs[0]) == set(FIELDS)  # full-fidelity records
+        # the faults were real and the pusher recovered through them
+        assert METRICS.get("telemetry_push_total", outcome="error") >= 1
+        assert METRICS.get("telemetry_push_total", outcome="ok") >= 1
+    finally:
+        pusher.stop(flush=False)
+        coll.close()
+
+
+def test_pusher_bounded_buffer_drops_are_counted_not_blocking():
+    """A dead collector + tiny buffer: offers stay O(1) and fast, the
+    oldest entries drop, and every drop is counted — the buffer can
+    never wedge the serving path."""
+    from dgraph_tpu.utils.push import TelemetryPusher
+    # port 9 (discard) — nothing listens; every push errors
+    pusher = TelemetryPusher("http://127.0.0.1:9", interval_s=30.0,
+                             buffer_max=8, timeout_s=0.2)
+    before = METRICS.get("telemetry_dropped_total", kind="cost")
+    t0 = time.perf_counter()
+    for i in range(100):
+        pusher.offer_cost({"i": i})
+    offered_s = time.perf_counter() - t0
+    assert offered_s < 0.5, "offers must never block the request path"
+    assert METRICS.get("telemetry_dropped_total",
+                       kind="cost") == before + 92
+    assert pusher.status()["buffered_costs"] == 8
+    # the 8 survivors are the NEWEST (oldest-first drops)
+    with pusher._lock:
+        assert [c["i"] for c in pusher._costs] == list(range(92, 100))
+    pusher._push_once()  # fails fast; batch re-queued, backoff armed
+    assert METRICS.get("telemetry_push_total", outcome="error") >= 1
+    assert pusher.status()["backoff_s"] > 0
+    assert pusher.status()["buffered_costs"] == 8
+
+
+# ---------------------------------------------------------------------------
+# acceptance: POST /debug/profile produces a loadable jax.profiler trace
+
+def test_debug_profile_roundtrip_produces_loadable_trace(tmp_path):
+    import os
+    a = _batch_alpha()
+    srv = make_http_server(a)
+    serve_background(srv)
+    base = f"http://127.0.0.1:{srv.server_address[1]}"
+
+    def post(body):
+        req = urllib.request.Request(
+            base + "/debug/profile", data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req) as r:
+            return json.loads(r.read())
+
+    try:
+        d = str(tmp_path / "prof")
+        out = post({"action": "start", "dir": d})
+        assert out["data"]["profiling"] is True
+        # single-flight: a second start is refused with 409
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            post({"action": "start", "dir": d})
+        assert ei.value.code == 409
+        with urllib.request.urlopen(base + "/debug/profile") as r:
+            assert json.loads(r.read())["running"] is True
+        # device work lands inside the capture window
+        a.query_batch(["{ q(func: uid(%d)) @recurse(depth: 3) "
+                       "{ friend uid } }" % i for i in range(1, 9)])
+        out = post({"action": "stop"})
+        assert out["data"]["dir"] == d
+        files = [os.path.join(r, f) for r, _d, fs in os.walk(d)
+                 for f in fs]
+        assert files, "profiler capture produced no files"
+        # "loadable": the Perfetto trace decompresses to valid JSON
+        gz = [f for f in files if f.endswith(".trace.json.gz")]
+        assert gz, files
+        doc = json.loads(gzip.decompress(open(gz[0], "rb").read()))
+        assert "traceEvents" in doc
+        # and stopping again is a clean 409, not a crash
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            post({"action": "stop"})
+        assert ei.value.code == 409
+        assert METRICS.get("device_profile_captures_total",
+                           outcome="ok") >= 1
+    finally:
+        srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# tier-1 guard: cost profiling must never become the regression
+
+def _hot_loop_secs(alpha, queries, reps):
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        for q in queries:
+            alpha.query(q)
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def test_costprofile_hot_path_overhead_under_5_percent():
+    """The serving path with cost profiling armed (the default) must
+    stay within 5% of the same path with it disarmed — tracing and
+    metrics stay ON both sides so only the recorder is billed
+    (mirrors test_tracing.py's guard; min-of-N interleaved best-of
+    damps scheduler noise)."""
+    rng = np.random.default_rng(11)
+    n = 512
+    a = Alpha(device_threshold=10**9)
+    a.alter("name: string @index(exact) .\n"
+            "score: int @index(int) .\nfriend: [uid] @reverse .")
+    lines = []
+    for i in range(1, n + 1):
+        lines.append(f'<{i}> <name> "p{i}" .')
+        lines.append(f'<{i}> <score> "{i % 17}"^^<xs:int> .')
+        for j in rng.integers(1, n + 1, 4):
+            lines.append(f"<{i}> <friend> <{int(j)}> .")
+    a.mutate(set_nquads="\n".join(lines))
+    queries = [
+        '{ q(func: ge(score, 8)) { name friend { name score } } }',
+        '{ q(func: has(friend), first: 20) { name friend { friend '
+        '{ name } } } }',
+    ]
+    for q in queries:  # warm parse/caches once
+        a.query(q)
+
+    best_ratio = float("inf")
+    for _attempt in range(3):
+        costprofile.set_enabled(False)
+        off = _hot_loop_secs(a, queries, reps=5)
+        costprofile.set_enabled(True)
+        on = _hot_loop_secs(a, queries, reps=5)
+        best_ratio = min(best_ratio, on / off)
+        if best_ratio <= 1.05:
+            break
+    assert best_ratio <= 1.05, (
+        f"cost-profile overhead {best_ratio:.3f}x exceeds the 5% "
+        f"budget on the hot query path")

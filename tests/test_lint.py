@@ -95,6 +95,32 @@ def test_facts_inventory_shapes():
     assert {"metrics.registry", "mvcc.store", "wal.write"} <= ladder
 
 
+def test_cost_record_schema_shares_the_facts_vocabulary():
+    """ISSUE-8 satellite: the static facts inventory and the runtime
+    cost-record schema are ONE vocabulary — facts re-export
+    utils/costprofile.FIELDS verbatim, and a runtime record's keys are
+    exactly that field set (the join key for the future cost model).
+    Any drift between the two fails here."""
+    from dgraph_tpu.utils import costprofile
+    a = run(ROOT)
+    facts_fields = {f["name"]: f["kind"]
+                    for f in a.facts["cost_record_fields"]}
+    assert facts_fields == {n: d["kind"]
+                            for n, d in costprofile.FIELDS.items()}
+    assert a.facts["totals"]["cost_record_fields"] \
+        == len(costprofile.FIELDS)
+    # a runtime record speaks exactly the shared vocabulary
+    rec = costprofile.Recorder("read").finish("ok")
+    assert set(rec) == set(costprofile.FIELDS)
+    # the digest/feature split covers every non-meta field
+    assert {d["kind"] for d in costprofile.FIELDS.values()} \
+        == {"meta", "cost", "feature"}
+    assert set(costprofile.DIGEST_FIELDS) | set(
+        costprofile.FEATURE_FIELDS) \
+        == {n for n, d in costprofile.FIELDS.items()
+            if d["kind"] != "meta"}
+
+
 def test_cli_json_runs_clean():
     out = subprocess.run(
         [sys.executable, "-m", "dgraph_tpu.analysis", "--format=json"],

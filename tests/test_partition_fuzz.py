@@ -117,12 +117,13 @@ def _transfer(a, uids, rng):
         return "aborted"
 
 
-def _fuzz_iteration(nodes, addrs, uids, seed):
+def _fuzz_iteration(nodes, addrs, uids, seed, **sched_kw):
     """One seeded schedule: interleave fault events with transfers,
     assert minority refusal as we go, then heal and assert convergence
     plus the balance invariant. Returns the number of refusals the
-    workload observed (the fault schedule's metric footprint)."""
-    sched = FaultSchedule(seed, len(nodes))
+    workload observed (the fault schedule's metric footprint).
+    `sched_kw` selects schedule-space extensions (e.g. clock_free)."""
+    sched = FaultSchedule(seed, len(nodes), **sched_kw)
     rng = random.Random(seed ^ 0x9E3779B9)
     groups = [a.groups for a, _s in nodes]
     refused = 0
@@ -603,6 +604,78 @@ def test_historical_seed_schedules_replay_identically():
         kw = dict(crash=True, wal_trunc=True, deadline=True)
         assert (FaultSchedule(seed, 3, **kw).events
                 == FaultSchedule(seed, 3, **kw).events)
+
+
+# -- clock-free delay faults (ISSUE-8 satellite) ------------------------------
+
+def test_clock_free_flag_preserves_schedule_byte_identity():
+    """clock_free changes delay APPLICATION only, never generation:
+    every historical golden schedule regenerates byte-identically with
+    the flag on — DGRAPH_TPU_FUZZ_SEED replay stays exact."""
+    for (seed, flags), want in _GOLDEN_SCHEDULES.items():
+        kw = {f: True for f in flags}
+        assert FaultSchedule(seed, 3, clock_free=True,
+                             **kw).events == want, (
+            f"seed {seed} flags {flags}: clock_free shifted the "
+            f"schedule")
+
+
+def test_clock_free_delay_consumes_budget_without_sleeping():
+    """The clock-free delay primitive: a delayed link virtually
+    consumes the ambient request budget (RequestContext.consume) and
+    raises where a real stall would have — at ZERO wall-clock cost;
+    without a bounded budget it passes through instantly, counted."""
+    import time
+    import types
+
+    from dgraph_tpu.utils import deadline as dl
+
+    class _G:
+        my_addr = "me"
+
+        def pool(self, addr):
+            return types.SimpleNamespace()
+
+    fg = FaultyGroups(_G())
+    fg.clock_free = True
+    fg.delay_link("peer", 5.0)
+    t0 = time.perf_counter()
+    ctx = dl.RequestContext(deadline_ms=200)
+    with dl.activate(ctx):
+        with pytest.raises(DeadlineExceeded):
+            fg.check_link("peer")  # 5 s stall vs 200 ms budget
+    assert time.perf_counter() - t0 < 1.0, "virtual delay slept"
+    # unbounded budget: instant pass-through, but metered
+    before = _counter_sum("fault_virtual_delays_total")
+    t0 = time.perf_counter()
+    fg.check_link("peer")
+    assert time.perf_counter() - t0 < 0.5
+    assert _counter_sum("fault_virtual_delays_total") == before + 1
+    # the real-sleep path is untouched when the flag is off
+    fg.clock_free = False
+    fg.delay_link("peer", 0.02)
+    t0 = time.perf_counter()
+    fg.check_link("peer")
+    assert time.perf_counter() - t0 >= 0.02
+
+
+def test_clock_free_delay_fuzz_smoke(bank_trio):
+    """The partition fuzzer's delay family applied clock-free: same
+    seeded schedules (byte-identity asserted), the bank invariant and
+    convergence hold, and the virtual-delay path is metric-visible —
+    delay-heavy schedules now fuzz at full speed."""
+    nodes, addrs, uids = bank_trio
+    v0 = _counter_sum("fault_virtual_delays_total")
+    delays = 0
+    for seed in (1000, 1001, 1002):
+        sched = FaultSchedule(seed, len(nodes), clock_free=True)
+        assert sched.events == FaultSchedule(seed, len(nodes)).events
+        delays += sum(op == "delay" for op, *_ in sched.events)
+        _fuzz_iteration(nodes, addrs, uids, seed, clock_free=True)
+    assert delays, "chosen seeds must exercise delay events"
+    if delays:
+        # at least one RPC crossed a virtually-delayed link
+        assert _counter_sum("fault_virtual_delays_total") > v0
 
 
 def test_wal_truncation_fuzz_schedule(bank_trio):
