@@ -59,7 +59,7 @@ MAINT_N = 220              # maintenance-stage store size (host-side)
 METRIC = f"edges_traversed_per_sec_{DEPTH}hop_recurse_{B_DEV}q"
 GLOBAL_DEADLINE_S = 780
 STAGE_DEADLINES = {"stage0": 150.0, "stage1": 240.0, "stage2": 330.0,
-                   "maintenance": 60.0}
+                   "maintenance": 60.0, "sched": 90.0}
 HBM_PEAK_GBPS = 819.0      # v5e single chip
 
 _emitted = threading.Event()
@@ -336,6 +336,12 @@ def child_main(platform: str, expect_path: str) -> None:
         _stage(maintenance_stage())
     except Exception as e:  # noqa: BLE001 — the stage is additive telemetry
         _stage({"stage": "maintenance", "error": str(e)})
+
+    # -- sched stage: cost-prior scheduling A/B (ISSUE 9) -------------------
+    try:
+        _stage(sched_stage())
+    except Exception as e:  # noqa: BLE001 — additive telemetry
+        _stage({"stage": "sched", "error": str(e)})
     os._exit(0)
 
 
@@ -352,7 +358,181 @@ def lint_stage() -> dict:
         return {"error": f"{type(e).__name__}: {e}"}
 
 
-def maintenance_stage() -> dict:
+def run_sched_workload(priors_on: bool, chain_n: int = 2000,
+                       n_expensive: int = 3, n_cheap: int = 6,
+                       queue_depth: int = 4, seed: int = 23) -> dict:
+    """Mixed cheap/expensive serving under admission pressure — the
+    cost-prior A/B harness shared by the bench "sched" stage and the
+    tier-1 acceptance test (tests/test_costprior.py).
+
+    One token, a bounded queue: an EXPENSIVE query (shortest-path grind
+    over a `chain_n` uid chain hunting an unreachable island) holds the
+    token while more expensive queries queue; CHEAP name lookups then
+    arrive. With priors OFF the cheap arrivals queue FIFO behind the
+    expensive ones or get shed at the full queue (sheds land on cheap
+    work). With priors ON the scheduler predicts each arrival's cost
+    from its warmed shape prior: cheap queries displace queued
+    expensive ones (sheds land on the expensive work) and drain first
+    (SJF handoff). Reports cheap p50/p99 µs over COMPLETED cheap
+    queries, shed counts by kind, and shed precision = expensive sheds
+    / total sheds."""
+    import threading as _threading
+
+    from dgraph_tpu.server.admission import ServerOverloaded
+    from dgraph_tpu.server.api import Alpha
+    from dgraph_tpu.store import StoreBuilder, parse_schema
+    from dgraph_tpu.utils import costprior, costprofile
+
+    costprior.reset()
+    costprofile.reset()
+    floor0 = costprior.PRIORS.sample_floor
+    costprior.PRIORS.sample_floor = 2  # 2 warm runs arm a prior
+    try:
+        b = StoreBuilder(parse_schema(
+            "link: [uid] @reverse .\nname: string @index(exact) ."))
+        uids = np.arange(1, chain_n, dtype=np.int64)
+        b.add_edges("link", uids, uids + 1)
+        for i in range(1, 65):
+            b.add_value(i, "name", f"p{i}")
+        b.add_value(chain_n + 5, "name", "island")  # unreachable
+        alpha = Alpha(base=b.finalize(), device_threshold=10**9)
+        alpha.cost_priors = priors_on
+
+        exp_q = ("{ path as shortest(from: 0x1, to: 0x%x, depth: %d) "
+                 "{ link } }" % (chain_n + 5, chain_n))
+        rng = np.random.default_rng(seed)
+        cheap_qs = ['{ q(func: eq(name, "p%d")) { name } }' % i
+                    for i in rng.integers(1, 65, n_cheap)]
+
+        # warm uncontended: parse caches + (priors on) text→shape memo
+        # and per-shape priors past the (lowered) sample floor
+        for _ in range(2):
+            alpha.query(exp_q)
+            for q in cheap_qs:
+                alpha.query(q)
+
+        adm = alpha.attach_admission(max_inflight=1,
+                                     queue_depth=queue_depth)
+        results = {"cheap_us": [], "shed": {"cheap": 0, "expensive": 0},
+                   "ok": {"cheap": 0, "expensive": 0}}
+        lock = _threading.Lock()
+
+        def run(q: str, kind: str):
+            t0 = time.perf_counter()
+            try:
+                alpha.query(q)
+                us = (time.perf_counter() - t0) * 1e6
+                with lock:
+                    results["ok"][kind] += 1
+                    if kind == "cheap":
+                        results["cheap_us"].append(us)
+            except ServerOverloaded:
+                with lock:
+                    results["shed"][kind] += 1
+
+        threads = []
+
+        def submit(q, kind):
+            t = _threading.Thread(target=run, args=(q, kind))
+            t.start()
+            threads.append(t)
+
+        def wait_for(pred, timeout=10.0):
+            end = time.monotonic() + timeout
+            while time.monotonic() < end:
+                if pred():
+                    return True
+                time.sleep(0.002)
+            return False
+
+        lane = adm.lanes["read"]
+        submit(exp_q, "expensive")
+        wait_for(lambda: lane.inflight >= 1)
+        for _ in range(n_expensive - 1):
+            submit(exp_q, "expensive")
+        wait_for(lambda: len(lane.waiters) >= n_expensive - 1)
+        for q in cheap_qs:
+            submit(q, "cheap")
+            time.sleep(0.01)
+        for t in threads:
+            t.join(60)
+
+        lats = sorted(results["cheap_us"])
+        sheds = results["shed"]["cheap"] + results["shed"]["expensive"]
+        out = {
+            "priors": priors_on,
+            "cheap_completed": len(lats),
+            "cheap_p50_us": round(lats[len(lats) // 2]) if lats else 0,
+            "cheap_p99_us": round(lats[min(len(lats) - 1,
+                                           int(len(lats) * 0.99))])
+            if lats else 0,
+            "shed_cheap": results["shed"]["cheap"],
+            "shed_expensive": results["shed"]["expensive"],
+            "shed_precision": (results["shed"]["expensive"] / sheds
+                               if sheds else None),
+            "expensive_ok": results["ok"]["expensive"],
+        }
+        if priors_on:
+            st = costprior.status()
+            out["prior"] = {"hits": st["hits"],
+                            "fallbacks": st["fallbacks"],
+                            "error": st["error"]}
+        return out
+    finally:
+        costprior.PRIORS.sample_floor = floor0
+
+
+def sched_stage() -> dict:
+    """Cost-prior scheduling A/B (ISSUE 9 headline): the mixed workload
+    with priors on vs off — cheap-query p50/p99 and shed precision —
+    plus the prior fit summary and the batch planner's cost-pack
+    imbalance gauges from a mixed two-family kernel batch."""
+    from dgraph_tpu.server.api import Alpha
+    from dgraph_tpu.utils import costprior, costprofile
+    from dgraph_tpu.utils.metrics import METRICS
+
+    t0 = time.perf_counter()
+    off = run_sched_workload(priors_on=False)
+    on = run_sched_workload(priors_on=True)
+    fit = costprior.refit()  # fit over the on-run's digests
+
+    # cost-packed batch planning: two structurally-distinct recurse
+    # groups in one batch → plan_pack_imbalance{stage=count|predicted}
+    costprofile.reset()
+    a = Alpha(device_threshold=10**9)
+    a.alter("fan: [uid] @reverse .\nthin: [uid] @reverse .")
+    rng = np.random.default_rng(5)
+    lines = []
+    for i in range(1, 128):
+        for j in rng.integers(1, 128, 6):
+            if i != int(j):
+                lines.append(f"<{i}> <fan> <{int(j)}> .")
+    for i in range(1, 16):
+        lines.append(f"<{i}> <thin> <{i + 1}> .")
+    a.mutate(set_nquads="\n".join(lines))
+    fan_qs = ["{ q(func: uid(%d)) @recurse(depth: 3) { fan uid } }" % i
+              for i in range(1, 9)]
+    thin_qs = ["{ q(func: uid(%d)) @recurse(depth: 2) { thin uid } }"
+               % i for i in range(1, 9)]
+    # HOMOGENEOUS warm batches: each kernel family digests under its
+    # own shape key (enough times to clear the sample floor), so the
+    # mixed batch's groups each have a trusted prior
+    from dgraph_tpu.utils.costprior import SAMPLE_FLOOR
+    for _ in range(SAMPLE_FLOOR):
+        a.query_batch(fan_qs)
+        a.query_batch(thin_qs)
+    costprior.refit()
+    a.query_batch(fan_qs + thin_qs)
+    gauges = METRICS.snapshot()["gauges"]
+    imb = {stage: gauges.get('plan_pack_imbalance{stage="%s"}' % stage)
+           for stage in ("count", "predicted")}
+
+    return {"stage": "sched",
+            "secs": round(time.perf_counter() - t0, 2),
+            "priors_off": off, "priors_on": on,
+            "prior_fit": fit,
+            "pack_imbalance": imb,
+            "scheduler": costprior.status(top_n=5)}
     """Pause-impact telemetry (ISSUE 3): serve a query mix against an
     out-of-core store while the background scheduler streams rollups +
     checkpoints, and report the latency penalty maintenance imposes —
@@ -474,12 +654,13 @@ def run_child_staged(platform: str, expect_path: str,
     err = None
     t_start = time.perf_counter()
     try:
-        for name in ("stage0", "stage1", "stage2", "maintenance"):
+        for name in ("stage0", "stage1", "stage2", "maintenance",
+                     "sched"):
             remaining = budget_s - (time.perf_counter() - t_start)
             deadline = min(STAGE_DEADLINES[name], max(remaining, 1.0))
             line = _read_line(proc, deadline)
             if line is None:
-                if name == "maintenance":
+                if name in ("maintenance", "sched"):
                     break  # additive telemetry: absence is not an error
                 err = (f"{name} produced no output within {deadline:.0f}s "
                        f"(rc={proc.poll()})")
@@ -624,6 +805,14 @@ def main() -> None:
     else:
         from dgraph_tpu.utils import costprofile
         out["cost_records"] = costprofile.summary(top_n=5)
+    # cost-prior scheduling headline (ISSUE 9): priors on vs off on the
+    # mixed workload — cheap p50/p99, shed precision, prior fit, pack
+    # imbalance — straight off the child's sched stage
+    ss = stages.get("sched")
+    if ss is not None and "error" not in ss:
+        out["sched"] = {k: ss[k] for k in
+                        ("priors_on", "priors_off", "prior_fit",
+                         "pack_imbalance") if k in ss}
     out["lint"] = lint_stage()
     emit(out)
     watchdog.cancel()

@@ -86,6 +86,14 @@ def extract_facts(contexts) -> dict:
     from dgraph_tpu.utils.costprofile import FIELDS as COST_FIELDS
     cost_fields = [{"name": n, "kind": d["kind"], "doc": d["doc"]}
                    for n, d in sorted(COST_FIELDS.items())]
+    # same discipline for the PRIOR model's regressors (ISSUE 9): the
+    # feature vocabulary utils/costprior.py fits on is re-exported
+    # verbatim; tests/test_lint.py pins it both ways against FIELDS —
+    # a prior can never train on a feature no record carries, and a
+    # feature field can never silently fall out of the model's reach
+    from dgraph_tpu.utils.costprior import FEATURES as PRIOR_FEATURES
+    prior_features = [{"name": n, "kind": COST_FIELDS[n]["kind"]}
+                      for n in PRIOR_FEATURES]
     return {
         "kernels": kernels,
         "kernel_launch_sites": launches,
@@ -93,6 +101,7 @@ def extract_facts(contexts) -> dict:
         "metric_sites": metrics,
         "lock_classes": locks,
         "cost_record_fields": cost_fields,
+        "cost_prior_features": prior_features,
         "totals": {
             "kernels": len(kernels),
             "kernel_launch_sites": len(launches),
@@ -100,5 +109,6 @@ def extract_facts(contexts) -> dict:
             "metric_names": len({m["name"] for m in metrics}),
             "lock_classes": len({x["name"] for x in locks}),
             "cost_record_fields": len(cost_fields),
+            "cost_prior_features": len(prior_features),
         },
     }

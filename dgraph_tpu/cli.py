@@ -77,6 +77,7 @@ def cmd_alpha(args) -> int:
         "max_inflight": args.max_inflight,
         "queue_depth": args.queue_depth,
         "default_deadline_ms": args.default_deadline_ms,
+        "cost_priors": args.cost_priors,
         "telemetry_push_url": args.telemetry_push_url,
         "telemetry_push_interval_s": args.telemetry_push_interval_s,
         "rpc_retries": args.rpc_retries,
@@ -141,6 +142,13 @@ def cmd_alpha(args) -> int:
         alpha.default_deadline_ms = cfg.default_deadline_ms
         log.info("default request deadline: %.0f ms",
                  cfg.default_deadline_ms)
+    # cost-prior scheduling (utils/costprior.py): per-shape predicted
+    # cost feeds admission shedding/hints, batch-plan ordering, and the
+    # placement heartbeat; --no-cost_priors restores count/EMA behavior
+    alpha.cost_priors = cfg.cost_priors
+    if not cfg.cost_priors:
+        log.info("cost-prior scheduling DISABLED (--no-cost_priors): "
+                 "admission/planning fall back to count + lane EMA")
     if cfg.slow_query_ms:
         log.info("slow-query log armed at %d ms", cfg.slow_query_ms)
     if cfg.trace_dir:
@@ -224,6 +232,14 @@ def cmd_alpha(args) -> int:
         threading.Thread(target=run_heartbeat_loop, daemon=True,
                          args=("liveness", args.heartbeat,
                                liveness_step, log)).start()
+        # peer-health + tablet-cost heartbeat (ISSUE 9): Zero's
+        # tablet-move decisions read this node's breaker table and
+        # measured per-tablet cost sums (Alpha.report_health →
+        # ZeroService.ReportHealth) so moves prefer healthy,
+        # under-loaded peers and never target half-open/dead ones
+        threading.Thread(target=run_heartbeat_loop, daemon=True,
+                         args=("health", 15.0,
+                               alpha.report_health, log)).start()
     # background maintenance: rollup-when-deep + periodic checkpoint +
     # admin-triggered backup/export, paced and budget-bounded
     # (store/maintenance.py; reference: Badger's background rollups,
@@ -515,6 +531,13 @@ def main(argv=None) -> int:
     p.add_argument("--default_deadline_ms", type=float, default=None,
                    help="budget for requests that carry no ?timeout=/"
                         "X-Deadline-Ms of their own (0 = unbounded)")
+    p.add_argument("--cost_priors", default=None,
+                   action=argparse.BooleanOptionalAction,
+                   help="per-shape cost priors drive admission "
+                        "shedding/Retry-After, batch-plan ordering, "
+                        "and the placement heartbeat (default on; "
+                        "--no-cost_priors restores count/EMA-only "
+                        "scheduling)")
     p.add_argument("--rpc_retries", type=int, default=None,
                    help="re-attempts per retryable cluster RPC "
                         "(UNAVAILABLE/connect failures only; backoff "

@@ -111,6 +111,17 @@ class Groups:
             return sorted({a for nodes in self._groups.values()
                            for a in nodes.values() if a != self.my_addr})
 
+    def peer_health(self) -> dict[str, dict]:
+        """This node's breaker/latency view of every peer it dials —
+        the `/debug/peers` data in heartbeat form (ISSUE 9: Zero's
+        tablet-move decisions read it via ReportHealth, so moves never
+        target a peer this node's breaker already knows is down)."""
+        out = {}
+        for addr, p in self.resilience.snapshot().items():
+            out[addr] = {"state": p["state"],
+                         "ema_latency_us": p["ema_latency_us"]}
+        return out
+
     # -- conn pooling ---------------------------------------------------------
     def pool(self, addr: str):
         """Cached worker client per peer address (conn/pool.go). Every

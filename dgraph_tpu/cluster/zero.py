@@ -35,6 +35,11 @@ LEASE_BLOCK = 1000   # ts/uid leases persist at block granularity
 MAX_UNACKED_BLOCKS = 4
 STANDBY_GRACE_S = 15.0
 DOC_LOG_CAP = 8192
+# peer-health reports (ISSUE 9): alphas ship their breaker/latency view
+# (/debug/peers) + per-tablet cost sums in a health heartbeat; reports
+# older than this no longer veto a move target (a healed peer must not
+# stay blacklisted by a stale report)
+HEALTH_TTL_S = 60.0
 
 
 class ZeroState:
@@ -64,6 +69,9 @@ class ZeroState:
         self.tablets: dict[str, int] = {}
         # group_id -> {pred: approx bytes} (rebalance input)
         self.tablet_sizes: dict[int, dict[str, int]] = {}
+        # node_id -> freshest health report (peer breaker states +
+        # per-tablet cost sums; see report_health) — placement input
+        self.alpha_health: dict[int, dict] = {}
         self.counter = 0
         # node_id -> monotonic last-heard time (liveness; reference: the
         # membership-stream health Zero keeps per Alpha)
@@ -355,6 +363,65 @@ class ZeroState:
         with self._lock:
             self.tablet_sizes[group] = dict(sizes)
 
+    # -- peer health + tablet cost reports (ISSUE 9 placement input) -----
+    def report_health(self, doc: dict) -> None:
+        """One alpha's health heartbeat: its breaker/latency view of
+        every peer it dials (cluster/resilience.py snapshot) plus the
+        per-tablet cost sums it measured (utils/costprofile.py). Zero
+        keeps the freshest report per node; move/rebalance decisions
+        read the aggregate (peer_unhealthy / group_cost_load)."""
+        import time as _time
+        node_id = int(doc.get("node_id", 0))
+        with self._lock:
+            self.alpha_health[node_id] = {
+                "at": _time.monotonic(),
+                "group": int(doc.get("group", 0)),
+                "addr": str(doc.get("addr", "")),
+                "peers": dict(doc.get("peers", {})),
+                "tablet_costs": {str(p): int(c) for p, c in
+                                 dict(doc.get("tablet_costs",
+                                              {})).items()},
+            }
+
+    def unhealthy_addrs(self) -> set[str]:
+        """Addresses NO tablet move should target right now: any peer a
+        FRESH health report marks breaker open/half-open (some alpha is
+        actively failing to reach it), plus every liveness-dead node's
+        address. Stale reports (past HEALTH_TTL_S) don't veto — a
+        healed peer must come back into rotation."""
+        import time as _time
+        now = _time.monotonic()
+        dead = set(self.dead_nodes())
+        with self._lock:
+            bad: set[str] = set()
+            for nodes in self.groups.values():
+                for nid, addr in nodes.items():
+                    if nid in dead:
+                        bad.add(addr)
+            for rep in self.alpha_health.values():
+                if now - rep["at"] > HEALTH_TTL_S:
+                    continue
+                for addr, p in rep["peers"].items():
+                    if p.get("state") in ("open", "half_open"):
+                        bad.add(addr)
+            return bad
+
+    def group_cost_load(self, group: int) -> int:
+        """Measured µs-equivalents of tablet work the group's nodes
+        reported (freshest report per node) — the load half of the
+        placement decision the byte sizes alone can't see (a small, hot
+        tablet)."""
+        import time as _time
+        now = _time.monotonic()
+        with self._lock:
+            total = 0
+            for rep in self.alpha_health.values():
+                if rep["group"] != group \
+                        or now - rep["at"] > HEALTH_TTL_S:
+                    continue
+                total += sum(rep["tablet_costs"].values())
+            return total
+
     def move_tablet(self, pred: str, dst_group: int) -> bool:
         """Flip a tablet's owner (the map half of a move; the data ship
         happens first — see ZeroService.MoveTablet / rebalance_once)."""
@@ -368,17 +435,36 @@ class ZeroState:
             return True
 
     def rebalance_candidate(self):
-        """Pick (pred, src_group, dst_group): move the smallest tablet of
-        the most-loaded group to the least-loaded group, if the imbalance
-        is worth it (reference: zero/tablet.go rebalance loop)."""
+        """Pick (pred, src_group, dst_group): move the smallest tablet
+        of the most-loaded group to the least-loaded HEALTHY group, if
+        the imbalance is worth it (reference: zero/tablet.go rebalance
+        loop). Load is the reported byte size PLUS the reported tablet
+        cost sums (µs-equivalents — a small but hot tablet weighs in),
+        and a group none of whose nodes are currently healthy is never
+        a destination (`zero_moves_skipped_unhealthy_total`)."""
+        from dgraph_tpu.utils.metrics import METRICS
+        bad = self.unhealthy_addrs()           # takes the lock itself
+        cost = {g: self.group_cost_load(g) for g in list(self.groups)}
         with self._lock:
             if len(self.groups) < 2:
                 return None
             load = {g: sum(self.tablet_sizes.get(g, {}).values())
+                    + cost.get(g, 0)
                     for g in self.groups}
             src = max(load, key=load.get)
-            dst = min(load, key=load.get)
-            if src == dst or load[src] <= 1.5 * max(load[dst], 1):
+            ranked = [g for g in sorted(load, key=load.get) if g != src]
+            healthy_dst = [g for g in ranked
+                           if any(a not in bad
+                                  for a in self.groups[g].values())]
+            if not healthy_dst:
+                # every candidate destination is unhealthy: no move
+                METRICS.inc("zero_moves_skipped_unhealthy_total")
+                return None
+            dst = healthy_dst[0]
+            if dst != ranked[0]:
+                # the least-loaded group was vetoed by peer health
+                METRICS.inc("zero_moves_skipped_unhealthy_total")
+            if load[src] <= 1.5 * max(load[dst], 1):
                 return None
             movable = {p: s for p, s in self.tablet_sizes[src].items()
                        if self.tablets.get(p) == src}
@@ -557,6 +643,20 @@ class ZeroService:
         self.state.report_sizes(int(req.group), dict(req.sizes))
         return pb.Payload(data=b"ok")
 
+    def ReportHealth(self, req: pb.Payload, ctx) -> pb.Payload:
+        """Alpha health heartbeat (ISSUE 9): a JSON doc in Payload.data
+        — {node_id, group, addr, peers: {addr: {state, ema_latency_us}},
+        tablet_costs: {pred: µs}} — no proto change needed (Payload is
+        the existing opaque envelope). Malformed docs are dropped, never
+        a crashed heartbeat loop."""
+        import json as _json
+        try:
+            doc = _json.loads(req.data.decode() or "{}")
+        except (UnicodeDecodeError, ValueError):
+            return pb.Payload(data=b"bad")
+        self.state.report_health(doc)
+        return pb.Payload(data=b"ok")
+
     def RemoveTablet(self, req: pb.TabletRequest, ctx) -> pb.Payload:
         self._primary_only(ctx)
         self.state.remove_tablet(req.pred)
@@ -596,13 +696,23 @@ def move_tablet(state: ZeroState, pred: str, dst_group: int) -> bool:
     it, the new owners (already loaded) do. The flip only happens after
     at least one replica holds the bulk copy; delta failures retry and
     are loudly logged (the replica heals fully on its next rejoin
-    resync)."""
+    resync).
+
+    Peer health gates the TARGETS (ISSUE 9): a destination replica that
+    any fresh alpha health report marks breaker-open/half-open — or
+    that liveness declares dead — is never pulled to; with EVERY
+    destination replica unhealthy the move is refused outright
+    (`zero_moves_skipped_unhealthy_total`). Shipping a tablet onto a
+    half-dead node would hand its reads to the one peer the fleet
+    already can't reach."""
     import contextlib
     import time as _time
 
     from dgraph_tpu.server.task import Client
     from dgraph_tpu.utils import logging as xlog
+    from dgraph_tpu.utils.metrics import METRICS
     log = xlog.get("zero")
+    bad = state.unhealthy_addrs()
     with state._lock:
         src_group = state.tablets.get(pred)
         src_nodes = dict(state.groups.get(src_group, {}))
@@ -610,6 +720,19 @@ def move_tablet(state: ZeroState, pred: str, dst_group: int) -> bool:
     if src_group is None or src_group == dst_group or not dst_nodes \
             or not src_nodes:
         return False
+    healthy_dst = {n: a for n, a in dst_nodes.items() if a not in bad}
+    if not healthy_dst:
+        METRICS.inc("zero_moves_skipped_unhealthy_total")
+        log.warning(
+            "move of %s to group %d refused: every destination replica "
+            "%s is breaker-open or dead per peer health reports",
+            pred, dst_group, sorted(dst_nodes.values()))
+        return False
+    if len(healthy_dst) < len(dst_nodes):
+        log.info("move of %s: skipping unhealthy replica(s) %s",
+                 pred, sorted(set(dst_nodes.values())
+                              - set(healthy_dst.values())))
+    dst_nodes = healthy_dst
     src_addr = sorted(src_nodes.values())[0]
     with contextlib.ExitStack() as stack:
         clients = []
@@ -843,6 +966,7 @@ def make_zero_server(state: ZeroState | None = None,
             "AssignUids": _unary(svc.AssignUids, pb.AssignRequest),
             "Commit": _unary(svc.Commit, pb.CommitRequest),
             "ReportTablets": _unary(svc.ReportTablets, pb.TabletSizes),
+            "ReportHealth": _unary(svc.ReportHealth, pb.Payload),
             "MoveTablet": _unary(svc.MoveTablet, pb.MoveTabletRequest),
             "RemoveTablet": _unary(svc.RemoveTablet, pb.TabletRequest),
             "Heartbeat": _unary(svc.Heartbeat, pb.HeartbeatMsg),
@@ -978,6 +1102,14 @@ class ZeroClient:
     def report_tablets(self, group: int, sizes: dict[str, int]) -> None:
         self._call("ReportTablets",
                    pb.TabletSizes(group=group, sizes=sizes), pb.Payload)
+
+    def report_health(self, doc: dict) -> None:
+        """Ship one health heartbeat doc (see ZeroService.ReportHealth);
+        the JSON rides the existing Payload envelope."""
+        import json as _json
+        self._call("ReportHealth", pb.Payload(
+            data=_json.dumps(doc, separators=(",", ":")).encode()),
+            pb.Payload)
 
     def heartbeat(self, node_id: int, group: int = 0, max_ts: int = 0,
                   max_uid: int = 0) -> None:

@@ -35,6 +35,12 @@ from dgraph_tpu.utils.jitcache import Memo, jit_call
 from dgraph_tpu.utils.metrics import METRICS
 
 MIN_BATCH = 4            # below this the per-query engine is cheaper
+# cost-packed planning (ISSUE 9): a group SMALLER than MIN_BATCH still
+# earns a kernel launch when its predicted cost says the work dwarfs
+# the launch overhead — grouping by predicted cost, not query count
+# (utils/costprior.py; priors below the sample floor leave the count
+# rule in charge)
+KERNEL_WORTH_US = 5_000.0
 # Depth is a static arg of the jitted kernel: each distinct value is an
 # XLA compile, and the scan materializes a [depth, n+1, W] hops buffer
 # with no early exit. Depths past any real graph's diameter fall back to
@@ -199,27 +205,111 @@ def plan_batch_groups(store, queries_blocks):
         leftover.append(i)
     plans = []
     for sig, items in groups.items():
-        if len(items) < MIN_BATCH:
+        if not _kernel_worth(f"recurse:{sig[0]}~d{sig[2]}", len(items)):
             leftover.extend(i for i, _ in items)
         else:
             plans.append((_BatchPlan([sg for _, sg in items],
                                      sig[0], sig[1], sig[2]),
                           [i for i, _ in items]))
     for sig, items in sp_groups.items():
-        if len(items) < MIN_BATCH:
+        if not _kernel_worth(f"shortest:{sig[1]}~d{sig[3]}",
+                             len(items)):
             leftover.extend(i for i, _ in items)
         else:
             plans.append((_ShortestPlan(sig, [it for _, it in items]),
                           [i for i, _ in items]))
     for sig, items in tree_groups.items():
-        if len(items) < MIN_BATCH:
+        plan: TreePlan = items[0][2]
+        if not _kernel_worth(f"tree:*~d{len(plan.stages)}",
+                             len(items)):
             leftover.extend(i for i, _b, _p in items)
         else:
-            plan: TreePlan = items[0][2]
             plan.queries = [b for _i, b, _p in items]
             plans.append((plan, [i for i, _b, _p in items]))
     leftover.sort()
     return plans, leftover
+
+
+def _kernel_worth(shape: str, n: int) -> bool:
+    """Launch gate, by predicted COST rather than query count alone
+    (ISSUE 9): `MIN_BATCH` keeps its historical role, but a smaller
+    group whose per-shape prior says the work dwarfs the launch
+    overhead (`KERNEL_WORTH_US`) still rides the kernel. Without a
+    trusted prior (unseen shape, priors off) the count rule decides —
+    bit-identical to the pre-prior planner."""
+    if n >= MIN_BATCH:
+        return True
+    if n == 0:
+        return False
+    from dgraph_tpu.utils import costprior
+    if not costprior.enabled():
+        return False
+    us = costprior.PRIORS.predict_shape(shape)
+    return us is not None and us >= KERNEL_WORTH_US
+
+
+# -- cost-packed launch ordering ---------------------------------------------
+
+def _plan_shape(plan) -> str:
+    """The shape-fingerprint component a plan's launch will record
+    (matches _note_kernel_features's add_shape) — the prior lookup
+    key."""
+    from dgraph_tpu.engine.treebatch import TreePlan
+    if isinstance(plan, _ShortestPlan):
+        return f"shortest:{plan.attr}~d{plan.depth}"
+    if isinstance(plan, TreePlan):
+        return f"tree:*~d{len(plan.stages)}"
+    return f"recurse:{plan.attr}~d{plan.depth}"
+
+
+def _plan_queries(plan) -> int:
+    from dgraph_tpu.engine.treebatch import TreePlan
+    if isinstance(plan, (_ShortestPlan, TreePlan)):
+        return len(plan.queries)
+    return len(plan.blocks)
+
+
+def plan_cost_us(plan) -> float:
+    """Predicted µs for one kernel-group launch: per-shape prior first,
+    the feature least-squares fit for unseen shapes (lanes/depth/
+    queries are known at plan time — the TpuGraphs-style static
+    prediction), query count as the last resort proxy."""
+    from dgraph_tpu.engine.treebatch import TreePlan
+    from dgraph_tpu.utils import costprior
+    n = _plan_queries(plan)
+    us = costprior.PRIORS.predict_shape(_plan_shape(plan))
+    if us is None:
+        depth = (len(plan.stages) if isinstance(plan, TreePlan)
+                 else plan.depth)
+        us = costprior.PRIORS.predict_features(
+            {"lanes": _lane_count(n), "depth": depth, "queries": n})
+    if us is None:
+        us = 1000.0 * n      # count proxy: every query worth ~1 ms
+    return float(us)
+
+
+def order_plans_by_cost(plans, enabled: bool = True):
+    """Order kernel groups for launch by DESCENDING predicted cost
+    (longest-processing-time-first: under a shared deadline the
+    expensive group starts while the budget is freshest, and total
+    makespan shrinks). Gauges the pack imbalance across launches both
+    ways — query-count view vs predicted-cost view
+    (`plan_pack_imbalance{stage=}`) — so the win of cost packing over
+    count packing is visible per batch. Returns a new list; the cached
+    plan list is never mutated."""
+    plans = list(plans)
+    from dgraph_tpu.utils import costprior
+    if not enabled or not costprior.enabled() or len(plans) < 2:
+        return plans
+    counts = [float(_plan_queries(p)) for p, _ in plans]
+    costs = [plan_cost_us(p) for p, _ in plans]
+    for stage, vals in (("count", counts), ("predicted", costs)):
+        mean = sum(vals) / len(vals)
+        METRICS.set_gauge("plan_pack_imbalance",
+                          max(vals) / mean if mean > 0 else 1.0,
+                          stage=stage)
+    order = sorted(range(len(plans)), key=lambda i: -costs[i])
+    return [plans[i] for i in order]
 
 
 # -- plan cache --------------------------------------------------------------
@@ -340,8 +430,9 @@ def run_batch(store, plan, device_threshold: int) -> list:
             _last, _seen, _edges, hops = fn(jax.device_put(mask0),
                                             plan.depth, True)
         hops = np.asarray(hops)      # [depth, n+1, W] fresh masks
-    costprofile.add_kernel(
-        "recurse", execute_us=(time.perf_counter() - t_exec) * 1e6)
+    exec_us = (time.perf_counter() - t_exec) * 1e6
+    costprofile.add_kernel("recurse", execute_us=exec_us)
+    costprofile.add_tablet_cost(plan.attr, exec_us)
     # gather-traffic model per hop (the bench's HBM model): index reads
     # + one mask row per padded slot, times the scan depth
     costprofile.add("bytes_gathered",
@@ -544,8 +635,9 @@ def _run_shortest_batch(store, plan: _ShortestPlan,
                         if not (alive[wq] & bq):
                             unresolved.pop(q)   # frontier exhausted
                 done += chunk
-        costprofile.add_kernel(
-            "shortest", execute_us=(time.perf_counter() - t_exec) * 1e6)
+        exec_us = (time.perf_counter() - t_exec) * 1e6
+        costprofile.add_kernel("shortest", execute_us=exec_us)
+        costprofile.add_tablet_cost(plan.attr, exec_us)
         costprofile.add("bytes_gathered",
                         done * g.padded_edges * (4 + W * 4))
 
@@ -725,9 +817,9 @@ def _ell_for(store, attr: str, reverse: bool):
                 with tracing.span("batch.build_ell", pred=attr,
                                   reverse=reverse):
                     g = build_ell(rel.indptr, rel.indices)
-                costprofile.add(
-                    "build_us",
-                    int((time.perf_counter() - t_build) * 1e6))
+                build_us = (time.perf_counter() - t_build) * 1e6
+                costprofile.add("build_us", int(build_us))
+                costprofile.add_tablet_cost(attr, build_us)
                 cache[key] = g
                 # segment-CSR padding waste: padded slots / real edges
                 METRICS.set_gauge("ell_padding_ratio",

@@ -125,6 +125,10 @@ class Executor:
                 costprofile.add("edges_traversed", int(len(out[0])))
                 # gather-traffic model: neighbor + seg + position words
                 costprofile.add("bytes_gathered", 16 * int(len(out[0])))
+                # placement signal: modeled µs charged to this tablet
+                # (~16 host edges per µs — the same order the bench's
+                # CPU baseline measures)
+                costprofile.add_tablet_cost(pred, len(out[0]) // 16 + 1)
             return out
 
     def _expand_routed(self, pred: str, reverse: bool,

@@ -10,16 +10,36 @@ with a retryable `ServerOverloaded` carrying a retry-after hint, rather
 than queued into a latency collapse (the classic overload spiral:
 everything admitted, nothing finishing inside its deadline).
 
-The retry-after hint is not a guess: each lane keeps an EMA of observed
-service time (the spirit of TpuGraphs' learned cost priors — measured
-spans over assumed costs), so the hint scales with what the workload is
-actually doing: `queued/inflight slots ahead × recent service time`.
+The retry-after hint is not a guess: with cost priors armed
+(utils/costprior.py), every request arrives with a PER-SHAPE predicted
+cost, and the hint is the predicted work ahead of the would-be waiter
+(inflight + queued predicted µs, divided across the lane's tokens).
+Without a prediction each lane falls back to an EMA of observed service
+time — decayed back to its seed after an idle period, so a quiet lane's
+stale EMA can't poison the first hints of the next burst.
+
+Cost-prior scheduling (ISSUE 9) changes two decisions when predictions
+are present, and leaves the classic behavior untouched when they are
+not (`cost_us=None`):
+
+  * **Cheapest-predicted-first handoff** — release hands the token to
+    the cheapest PREDICTED waiter instead of the oldest (shortest-job-
+    first: a cheap lookup no longer waits behind a fleet of expensive
+    recurse shapes). A starvation guard restores FIFO for any waiter
+    older than `starvation_s`.
+  * **Cost-aware displacement** — when the queue is full, an arriving
+    request cheaper than the most expensive queued waiter DISPLACES it
+    (the expensive waiter is shed, `shed_total{reason="displaced"}`)
+    instead of being refused itself — sheds land on the work that was
+    going to blow the deadline anyway (shed precision, measured by the
+    bench "sched" stage). Every cost-informed shed records its
+    predicted cost (`shed_predicted_cost_us`).
 
 Queued waiters respect the request's deadline: a request whose budget
 expires while waiting is shed (`shed_total{reason="deadline"}`) instead
-of being admitted to do work nobody will read. Token handoff is FIFO by
-construction — release passes the token to the OLDEST waiter under the
-lane lock, so a burst drains in arrival order.
+of being admitted to do work nobody will read. Token handoff without
+predictions is FIFO by construction — release passes the token to the
+OLDEST waiter under the lane lock, so a burst drains in arrival order.
 
 The maintenance scheduler consults `saturated()` at tablet boundaries
 and yields the machine while real traffic is queued
@@ -44,6 +64,14 @@ LANES = ("read", "mutate")
 # drops below (a hint of 0 would make clients hammer-retry)
 _EMA_ALPHA = 0.2
 _MIN_RETRY_S = 0.01
+# EMA cold-start: the seed before any observation, and how long a lane
+# may sit idle before its EMA is considered stale and reset to the seed
+# (a quiet lane's last burst must not shape the next one's hints)
+_EMA_SEED_S = 0.05
+_EMA_IDLE_RESET_S = 30.0
+# SJF starvation guard: a waiter queued longer than this is served
+# FIFO regardless of predicted cost
+_STARVATION_S = 5.0
 
 
 class ServerOverloaded(Exception):
@@ -60,16 +88,22 @@ class ServerOverloaded(Exception):
 
 
 class _Waiter:
-    __slots__ = ("event", "granted")
+    __slots__ = ("event", "granted", "displaced", "cost_us", "seq",
+                 "enq_mono")
 
-    def __init__(self):
+    def __init__(self, cost_us: float | None, seq: int):
         self.event = threading.Event()
         self.granted = False
+        self.displaced = False          # shed by a cheaper arrival
+        self.cost_us = cost_us          # predicted cost (None = unknown)
+        self.seq = seq                  # arrival order (FIFO tie-break)
+        self.enq_mono = time.monotonic()
 
 
 class _Lane:
     """One admission lane: `max_inflight` tokens + a FIFO queue bounded
-    at `queue_depth`."""
+    at `queue_depth` (cost-aware handoff/displacement when predictions
+    ride along — see module doc)."""
 
     def __init__(self, name: str, max_inflight: int, queue_depth: int):
         self.name = name
@@ -80,7 +114,13 @@ class _Lane:
         self.waiters: deque[_Waiter] = deque()
         self.admitted_total = 0
         self.shed_total = 0
-        self.service_ema_s = 0.05  # seeded guess; real spans take over
+        self.service_ema_s = _EMA_SEED_S  # seed; real spans take over
+        self.idle_reset_s = _EMA_IDLE_RESET_S
+        self.starvation_s = _STARVATION_S
+        self._seq = 0
+        self._last_activity = time.monotonic()
+        # predicted µs currently admitted (cost-aware retry hints)
+        self.inflight_cost_us = 0.0
 
     # -- gauges ---------------------------------------------------------------
     def _publish(self) -> None:
@@ -90,35 +130,100 @@ class _Lane:
         METRICS.set_gauge("admission_queued", float(len(self.waiters)),
                           lane=self.name)
 
-    def _retry_after_s(self, queued: int) -> float:
-        """Slots ahead of a would-be waiter × recent service time."""
+    def _maybe_decay_ema(self, now: float) -> None:
+        """Caller holds the lock. An idle lane's EMA is stale evidence:
+        after `idle_reset_s` without activity it resets to the seed, so
+        the first retry hints of the next burst aren't shaped by
+        whatever the LAST burst happened to look like (the cold-start
+        fix — regression-tested in tests/test_admission.py)."""
+        if now - self._last_activity > self.idle_reset_s:
+            self.service_ema_s = _EMA_SEED_S
+
+    def _queued_cost_us(self) -> float:
+        """Caller holds the lock: predicted µs waiting in the queue
+        (unknown costs count as one EMA service time)."""
+        ema_us = self.service_ema_s * 1e6
+        return sum(w.cost_us if w.cost_us is not None else ema_us
+                   for w in self.waiters)
+
+    def _retry_after_s(self, queued: int,
+                       cost_us: float | None = None) -> float:
+        """Predicted work ahead of a would-be waiter, divided across
+        the lane's tokens. With cost predictions the hint is the
+        predicted µs actually in front (inflight + queued + the arrival
+        itself); without, the classic slots-ahead × service-time EMA."""
+        if cost_us is not None:
+            ahead_us = (self.inflight_cost_us + self._queued_cost_us()
+                        + cost_us)
+            return max(_MIN_RETRY_S, ahead_us / self.max_inflight / 1e6)
         ahead = (queued + self.inflight) / self.max_inflight
         return max(_MIN_RETRY_S, ahead * self.service_ema_s)
 
+    def _overloaded(self, hint: float, reason: str,
+                    cost_us: float | None) -> ServerOverloaded:
+        """Caller holds the lock: count one shed and build the error."""
+        self.shed_total += 1
+        METRICS.inc("shed_total", lane=self.name, reason=reason)
+        if cost_us is not None:
+            METRICS.observe("shed_predicted_cost_us", cost_us,
+                            lane=self.name)
+        return ServerOverloaded(
+            f"{self.name} lane overloaded: {self.inflight} "
+            f"inflight, {len(self.waiters)} queued (limits "
+            f"{self.max_inflight}/{self.queue_depth}); retry "
+            f"after {hint:.3f}s", retry_after_s=hint,
+            lane=self.name)
+
+    def _try_displace(self, cost_us: float) -> bool:
+        """Caller holds the lock, queue full: shed the most expensive
+        PREDICTED waiter if it is strictly costlier than the arrival —
+        sheds land on the work least likely to finish inside anyone's
+        deadline. Among equal costs the newest waiter goes (least
+        sunk wait). Returns True when a slot was freed."""
+        victim = None
+        for w in self.waiters:
+            if w.cost_us is None or w.cost_us <= cost_us:
+                continue
+            if victim is None or (w.cost_us, w.seq) > (victim.cost_us,
+                                                       victim.seq):
+                victim = w
+        if victim is None:
+            return False
+        self.waiters.remove(victim)
+        self.shed_total += 1
+        METRICS.inc("shed_total", lane=self.name, reason="displaced")
+        METRICS.observe("shed_predicted_cost_us", victim.cost_us,
+                        lane=self.name)
+        victim.displaced = True
+        victim.event.set()
+        return True
+
     # -- token protocol -------------------------------------------------------
-    def acquire(self, ctx=None) -> None:
-        """Take a token, queueing FIFO behind earlier waiters. Raises
-        `ServerOverloaded` when the queue is full, or the context's
+    def acquire(self, ctx=None, cost_us: float | None = None) -> None:
+        """Take a token, queueing behind earlier waiters (FIFO without
+        predictions; cheapest-predicted-first with). Raises
+        `ServerOverloaded` when the queue is full (and no costlier
+        waiter could be displaced), or the context's
         `DeadlineExceeded`/`Cancelled` when the budget dies while
         queued."""
         with self.lock:
+            now = time.monotonic()
+            self._maybe_decay_ema(now)
+            self._last_activity = now
             if self.inflight < self.max_inflight and not self.waiters:
                 self.inflight += 1
                 self.admitted_total += 1
+                if cost_us is not None:
+                    self.inflight_cost_us += cost_us
                 self._publish()
                 return
             if len(self.waiters) >= self.queue_depth:
-                self.shed_total += 1
-                hint = self._retry_after_s(len(self.waiters))
-                METRICS.inc("shed_total", lane=self.name,
-                            reason="queue_full")
-                raise ServerOverloaded(
-                    f"{self.name} lane overloaded: {self.inflight} "
-                    f"inflight, {len(self.waiters)} queued (limits "
-                    f"{self.max_inflight}/{self.queue_depth}); retry "
-                    f"after {hint:.3f}s", retry_after_s=hint,
-                    lane=self.name)
-            w = _Waiter()
+                if cost_us is None or not self._try_displace(cost_us):
+                    hint = self._retry_after_s(len(self.waiters),
+                                               cost_us)
+                    raise self._overloaded(hint, "queue_full", cost_us)
+            self._seq += 1
+            w = _Waiter(cost_us, self._seq)
             self.waiters.append(w)
             self._publish()
         t0 = time.perf_counter()
@@ -130,19 +235,32 @@ class _Lane:
                     if rem is not None:
                         timeout = max(rem, 0.0)
                 if w.event.wait(timeout):
+                    if w.displaced:
+                        # a cheaper arrival took this slot: shed (the
+                        # displacer already counted + removed us)
+                        with self.lock:
+                            hint = self._retry_after_s(
+                                len(self.waiters), w.cost_us)
+                            self._publish()
+                        raise ServerOverloaded(
+                            f"{self.name} lane wait displaced by a "
+                            f"cheaper request; retry after "
+                            f"{hint:.3f}s", retry_after_s=hint,
+                            lane=self.name)
                     break
                 # budget died while queued: withdraw — unless release
-                # granted the token in the same instant (checked under
-                # the lock), in which case we keep it and let the next
-                # checkpoint raise
+                # granted the token (or a displacement shed us) in the
+                # same instant (checked under the lock), in which case
+                # that outcome stands and the next checkpoint raises
                 with self.lock:
                     if w.granted:
                         break
-                    self.waiters.remove(w)
-                    self.shed_total += 1
-                    self._publish()
-                    METRICS.inc("shed_total", lane=self.name,
-                                reason="deadline")
+                    if not w.displaced:
+                        self.waiters.remove(w)
+                        self.shed_total += 1
+                        self._publish()
+                        METRICS.inc("shed_total", lane=self.name,
+                                    reason="deadline")
                 if ctx is not None:
                     ctx.check("admission")
                 raise ServerOverloaded(  # cancel-less fallback
@@ -151,16 +269,41 @@ class _Lane:
         METRICS.observe("admission_wait_us", wait_us, lane=self.name)
         costprofile.add("admission_wait_us", int(wait_us))
 
-    def release(self, service_s: float | None = None) -> None:
-        """Return a token; the OLDEST waiter inherits it (FIFO)."""
+    def _pick_waiter(self) -> _Waiter:
+        """Caller holds the lock, waiters non-empty. Without cost
+        predictions: FIFO (oldest). With: cheapest-predicted-first,
+        arrival order breaking ties — unless the oldest waiter has
+        starved past `starvation_s`, which restores its FIFO turn."""
+        if all(w.cost_us is None for w in self.waiters):
+            return self.waiters.popleft()
+        oldest = min(self.waiters, key=lambda w: w.seq)
+        if time.monotonic() - oldest.enq_mono > self.starvation_s:
+            w = oldest
+        else:
+            w = min(self.waiters,
+                    key=lambda w: (w.cost_us if w.cost_us is not None
+                                   else -1.0, w.seq))
+        self.waiters.remove(w)
+        return w
+
+    def release(self, service_s: float | None = None,
+                cost_us: float | None = None) -> None:
+        """Return a token; a waiter inherits it (see _pick_waiter)."""
         with self.lock:
+            now = time.monotonic()
+            self._last_activity = now
             if service_s is not None:
                 self.service_ema_s += _EMA_ALPHA * (service_s
                                                     - self.service_ema_s)
+            if cost_us is not None:
+                self.inflight_cost_us = max(
+                    0.0, self.inflight_cost_us - cost_us)
             if self.waiters:
-                w = self.waiters.popleft()
+                w = self._pick_waiter()
                 w.granted = True
                 self.admitted_total += 1
+                if w.cost_us is not None:
+                    self.inflight_cost_us += w.cost_us
                 # inflight unchanged: the token transfers to the waiter
                 self._publish()
                 w.event.set()
@@ -176,6 +319,10 @@ class _Lane:
                     "queue_depth": self.queue_depth,
                     "admitted_total": self.admitted_total,
                     "shed_total": self.shed_total,
+                    "inflight_predicted_us":
+                        round(self.inflight_cost_us, 1),
+                    "queued_predicted_us":
+                        round(self._queued_cost_us(), 1),
                     "service_ema_ms": round(self.service_ema_s * 1e3,
                                             3)}
 
@@ -189,24 +336,25 @@ class AdmissionController:
         self._tls = threading.local()
 
     @contextlib.contextmanager
-    def admit(self, lane: str, ctx=None):
-        """Hold one `lane` token for the duration. Reentrant per
-        thread: a nested server call (an upsert's query leg, a txn read
-        inside a continued txn) rides the token its request already
-        holds — re-admitting would deadlock a full lane against
-        itself."""
+    def admit(self, lane: str, ctx=None, cost_us: float | None = None):
+        """Hold one `lane` token for the duration. `cost_us` is the
+        scheduler's predicted cost (utils/costprior.py) — None keeps
+        the classic count-based behavior. Reentrant per thread: a
+        nested server call (an upsert's query leg, a txn read inside a
+        continued txn) rides the token its request already holds —
+        re-admitting would deadlock a full lane against itself."""
         if getattr(self._tls, "holding", False):
             yield
             return
         ln = self.lanes[lane]
-        ln.acquire(ctx)
+        ln.acquire(ctx, cost_us=cost_us)
         self._tls.holding = True
         t0 = time.perf_counter()
         try:
             yield
         finally:
             self._tls.holding = False
-            ln.release(time.perf_counter() - t0)
+            ln.release(time.perf_counter() - t0, cost_us=cost_us)
 
     def queued(self) -> int:
         return sum(len(ln.waiters) for ln in self.lanes.values())

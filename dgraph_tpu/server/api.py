@@ -31,7 +31,7 @@ from dgraph_tpu.store.mvcc import MVCCStore, Mutation
 from dgraph_tpu.store.schema import parse_schema
 from dgraph_tpu.store.store import Store
 from dgraph_tpu.store.types import Kind, hash_password
-from dgraph_tpu.utils import costprofile
+from dgraph_tpu.utils import costprior, costprofile
 from dgraph_tpu.utils import deadline as dl
 from dgraph_tpu.utils import tracing
 from dgraph_tpu.utils.metrics import METRICS
@@ -129,6 +129,11 @@ class Alpha:
         # budget (0 = unbounded, the historical behavior)
         self.admission = None
         self.default_deadline_ms = 0.0
+        # cost-prior scheduling (utils/costprior.py, --cost_priors):
+        # per-shape predicted cost feeds admission shedding/hints, the
+        # batch planner's ordering, and Zero's placement heartbeat.
+        # False restores the count/EMA-only behavior.
+        self.cost_priors = True
         self._apply_lock = locks.make_lock("alpha.apply")
         self._state_lock = locks.make_lock("alpha.state")
         self._open_txns: dict[int, Txn] = {}
@@ -176,6 +181,11 @@ class Alpha:
         # persisted next to the checkpoint (digest merge is exact, so
         # restart never resets the cost dataset)
         costprofile.load(os.path.join(p_dir, "costprofiles.json"))
+        # cost-prior continuity: merge the persisted prior model, then
+        # fill in any shapes the digests know that the model doesn't
+        # (overwrite=False keeps the merged incremental refinements)
+        costprior.load(os.path.join(p_dir, "costpriors.json"))
+        costprior.refit(overwrite=False)
         return alpha
 
     def attach_wal(self, wal_path: str, sync: bool = True) -> tuple[int, int]:
@@ -287,12 +297,14 @@ class Alpha:
 
     @staticmethod
     def _save_costprofiles(p_dir: str) -> None:
-        """Persist the cost-profile aggregate beside the checkpoint
-        (best effort — cost history is telemetry, never worth failing
-        a checkpoint over)."""
+        """Persist the cost-profile aggregate and the fitted priors
+        beside the checkpoint (best effort — cost history is
+        telemetry, never worth failing a checkpoint over)."""
         import os
         with contextlib.suppress(OSError):
             costprofile.save(os.path.join(p_dir, "costprofiles.json"))
+        with contextlib.suppress(OSError):
+            costprior.save(os.path.join(p_dir, "costpriors.json"))
 
     def maintenance_rollup(self, p_dir: str | None = None,
                            pace=None) -> int:
@@ -353,7 +365,8 @@ class Alpha:
         return self.admission
 
     @contextlib.contextmanager
-    def _request(self, lane: str, deadline_ms: float | None):
+    def _request(self, lane: str, deadline_ms: float | None,
+                 query_text: str | None = None):
         """Request-lifecycle shell every public entrypoint runs inside:
         establish the budget (explicit deadline_ms, else the configured
         default), install it as the thread's ambient context
@@ -362,7 +375,15 @@ class Alpha:
         duration. A nested server call (a txn read issued inside an
         already-admitted request) reuses the enclosing context: the
         OUTER budget governs, and no second token is taken — a full
-        lane must never deadlock against its own request."""
+        lane must never deadlock against its own request.
+
+        With cost priors armed (`cost_priors` + utils/costprior.py) and
+        a `query_text`, the request's cost is PREDICTED before admission
+        (shape memo → per-shape prior, lane EMA fallback) and rides the
+        admission decision; completed requests feed the observed cost
+        back (text→shape memo + incremental prior refit), and a shed
+        records its prediction into the cost profile so shed precision
+        is measurable after the fact."""
         outer = dl.current()
         if outer is not None:
             yield outer
@@ -374,13 +395,35 @@ class Alpha:
         # the record; outcomes (ok/shed/deadline/cancelled/error)
         # classify at close (utils/costprofile.py)
         with dl.activate(ctx), costprofile.profile(lane):
-            if self.admission is not None:
-                with self.admission.admit(lane, ctx):
-                    # budget may have died while queued
-                    ctx.check("admission")
+            predicted = source = None
+            priors_on = self.cost_priors and costprior.enabled()
+            if priors_on and query_text is not None:
+                predicted, source = costprior.predict(
+                    lane, text=query_text)
+            t0 = time.perf_counter()
+            completed = False
+            try:
+                if self.admission is not None:
+                    with self.admission.admit(lane, ctx,
+                                              cost_us=predicted):
+                        # budget may have died while queued
+                        ctx.check("admission")
+                        yield ctx
+                else:
                     yield ctx
-            else:
-                yield ctx
+                completed = True
+            finally:
+                if predicted is not None:
+                    # predicted-vs-actual joins the cost record (a shed
+                    # keeps its prediction with outcome="shed")
+                    costprofile.note("predicted_us", int(predicted))
+                if completed and priors_on and query_text is not None:
+                    rec = costprofile.active()
+                    costprior.learn(
+                        lane, query_text,
+                        rec.shape_key() if rec is not None else None,
+                        (time.perf_counter() - t0) * 1e6,
+                        predicted_us=predicted, source=source)
 
     def shutdown(self, p_dir: str | None = None) -> None:
         """Drain maintenance (finish the in-flight + requested jobs),
@@ -633,7 +676,7 @@ class Alpha:
         request — engine hot loops and RPC legs checkpoint against it
         and raise a retryable `DeadlineExceeded` within one level/BFS
         iteration of the budget."""
-        with self._request("read", deadline_ms):
+        with self._request("read", deadline_ms, query_text=dql):
             with self._reading(read_ts) as ts:
                 self._verify_read_chains(ts)
                 store = self._query_view(ts, acl_user)
@@ -650,7 +693,7 @@ class Alpha:
         """Serving-path query: response BYTES via the native JSON emitter
         (engine/emit.py), never a Python object tree (reference:
         outputnode.go ToJson writes bytes straight into the response)."""
-        with self._request("read", deadline_ms):
+        with self._request("read", deadline_ms, query_text=dql):
             with self._reading(read_ts) as ts:
                 self._verify_read_chains(ts)
                 store = self._query_view(ts, acl_user)
@@ -667,10 +710,14 @@ class Alpha:
         batches execute as ONE lane-packed kernel launch (the north-star
         throughput path, engine/batch.py); everything else falls back to
         per-query execution. Returns one JSON dict per query, in order."""
-        from dgraph_tpu.engine.batch import (plan_batch_groups_cached,
+        from dgraph_tpu.engine.batch import (order_plans_by_cost,
+                                             plan_batch_groups_cached,
                                              run_batch)
 
-        with self._request("read", deadline_ms), \
+        # the batch's scheduler key is the joined texts (one combined
+        # shape; repeated dashboard batches hit the same prior)
+        with self._request("read", deadline_ms,
+                           query_text="\x1e".join(dqls)), \
                 self._reading(read_ts) as ts:
             self._verify_read_chains(ts)
             store = self._query_view(ts, acl_user)
@@ -685,6 +732,11 @@ class Alpha:
                 # plan_batch_groups entirely (plan_cache_hits_total)
                 plans, leftover = plan_batch_groups_cached(store, dqls)
                 leftover = list(leftover)   # cached list: never mutate
+                # cost-packed launch order: predicted-expensive kernel
+                # groups first (LPT — shorter makespan under deadlines);
+                # a copy, never the cached list (engine/batch.py)
+                plans = order_plans_by_cost(
+                    plans, enabled=self.cost_priors)
                 # each compatible group is ONE lane-kernel launch; a
                 # failing group degrades to per-query, not to a failed
                 # batch
@@ -1773,6 +1825,27 @@ class Alpha:
             sizes[pred] = n
         self.groups.zero.report_tablets(self.groups.gid, sizes)
         return sizes
+
+    def report_health(self) -> dict:
+        """Ship this node's peer-health view (/debug/peers data: breaker
+        states + EMA latencies, cluster/resilience.py) and its per-tablet
+        cost sums (utils/costprofile.py) to Zero — the placement signal
+        that lets tablet moves prefer healthy, under-loaded peers and
+        never target half-open/dead ones (cluster/zero.py
+        report_health / move_tablet)."""
+        peers = self.groups.peer_health()
+        doc = {"node_id": self.groups.node_id,
+               "group": self.groups.gid,
+               "addr": self.groups.my_addr,
+               "peers": peers,
+               "tablet_costs": {
+                   p: c for p, c in costprofile.tablet_costs().items()
+                   # claim=False: a cost key must never CLAIM a tablet
+                   # (the overflow key "other" is not even a predicate)
+                   if self.groups.tablet_owner(p, claim=False)
+                   == self.groups.gid}}
+        self.groups.zero.report_health(doc)
+        return doc
 
     # -- maintenance --------------------------------------------------------
     def _maybe_gc(self) -> None:

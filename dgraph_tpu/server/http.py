@@ -242,6 +242,23 @@ def make_http_server(alpha: Alpha, addr: str = "127.0.0.1",
             elif self.path.startswith("/debug/profile"):
                 # capture status; POST starts/stops (single-flight)
                 self._send(200, tracing.profile_status())
+            elif self.path.startswith("/debug/scheduler"):
+                # cost-prior scheduling state (utils/costprior.py):
+                # live priors with hit/fallback counts, predicted-vs-
+                # actual error digests, lane-EMA fallbacks, the feature
+                # least-squares fit, and the admission lanes' predicted
+                # inflight/queued work
+                from dgraph_tpu.utils import costprior
+                qs = urllib.parse.parse_qs(
+                    urllib.parse.urlsplit(self.path).query)
+                n = int((qs.get("n") or [10])[0])
+                doc = {"enabled": bool(getattr(alpha, "cost_priors",
+                                               False))
+                       and costprior.enabled(),
+                       **costprior.status(top_n=n)}
+                if alpha.admission is not None:
+                    doc["admission"] = alpha.admission.status()
+                self._send(200, doc)
             elif self.path.startswith("/debug/admission"):
                 # admission-control status: per-lane inflight/queued/
                 # shed counts + limits (the numbers the overload

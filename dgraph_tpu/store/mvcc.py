@@ -337,7 +337,15 @@ class MVCCStore:
         rollup/checkpoint that wrote per-tablet segments to disk and
         reopened them out-of-core). Raises FoldRaced when the layer/drop
         state below new_ts changed since the plan was taken — the fold
-        on disk is missing those records and must not serve."""
+        on disk is missing those records and must not serve.
+
+        Kernel caches CARRY exactly as the in-core `rollup` path's do:
+        predicates the folded layers didn't touch stream out to
+        byte-identical CSR content (same pinned vocabulary), so the
+        seed fold point's ELL blocks / device uploads / compiled
+        kernels stay valid on the new snapshot
+        (`ell_cache_carried_total`; the vocab-growth guard inside
+        carry_kernel_caches refuses when the fold added uids)."""
         import bisect
         with self._lock:
             if not self._guard_ok(new_ts, guard):
@@ -346,9 +354,22 @@ class MVCCStore:
                     f"discard and re-plan")
             if any(ts == new_ts for ts, _ in self._history):
                 return  # identical content by the MVCC ts contract
+            fold_ts = guard[0]
+            seed = next((s for t, s in self._history if t == fold_ts),
+                        None)
+            touched = {rec[1]
+                       for l in self.layers
+                       if fold_ts < l.commit_ts <= new_ts
+                       for rec in (l.mut.edge_sets + l.mut.edge_dels
+                                   + l.mut.val_sets + l.mut.val_dels)}
             bisect.insort(self._history, (new_ts, store),
                           key=lambda e: e[0])
             self._views.clear()
+        # outside the lock, like rollup: the carry only reads immutable
+        # snapshot attributes + the batch-module cache lock
+        if seed is not None:
+            from dgraph_tpu.engine.batch import carry_kernel_caches
+            carry_kernel_caches(seed, store, touched)
 
     def pending_layer_count(self) -> int:
         """Delta layers ABOVE the newest fold point — what a rollup

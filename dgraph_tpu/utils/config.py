@@ -58,6 +58,11 @@ class AlphaConfig:
                                   # full queue sheds (ServerOverloaded)
     default_deadline_ms: float = 0.0  # budget for requests that bring
                                       # none (0 = unbounded)
+    cost_priors: bool = True      # per-shape cost priors drive admission
+                                  # shedding/hints, batch-plan ordering,
+                                  # and the placement heartbeat
+                                  # (utils/costprior.py); False restores
+                                  # count/EMA-only scheduling
     # peer-failure resilience (cluster/resilience.py):
     rpc_retries: int = 2          # re-attempts per retryable cluster RPC
                                   # (transport failures only; backoff is

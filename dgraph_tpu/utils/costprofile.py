@@ -48,7 +48,8 @@ from dgraph_tpu.utils.metrics import MAX_LABEL_SETS, METRICS
 
 __all__ = ["FIELDS", "DIGEST_FIELDS", "FEATURE_FIELDS", "Digest",
            "Recorder", "Aggregator", "COSTS", "profile", "active",
-           "note", "note_max", "add", "add_shape", "add_kernel", "recent",
+           "note", "note_max", "add", "add_shape", "add_kernel",
+           "add_tablet_cost", "tablet_costs", "recent",
            "add_sink", "remove_sink", "set_enabled", "summary",
            "save", "load", "reset"]
 
@@ -77,6 +78,7 @@ FIELDS: dict[str, dict] = {
     "rpc_legs":          {"kind": "cost", "doc": "outbound cluster RPC attempts"},
     "rpc_retries":       {"kind": "cost", "doc": "re-attempts the resilience layer spent"},
     "rpc_failovers":     {"kind": "cost", "doc": "read legs served by a non-preferred replica"},
+    "predicted_us":      {"kind": "cost", "doc": "scheduler's pre-run cost prediction (utils/costprior.py; 0 = no prediction)"},
     # plan features (averaged per shape)
     "lanes":             {"kind": "feature", "doc": "kernel lanes launched (padded batch width)"},
     "padded_lanes":      {"kind": "feature", "doc": "zero-seeded padding lanes"},
@@ -207,6 +209,13 @@ class Recorder:
         if value > self.vals.get(field, 0):
             self.vals[field] = value
 
+    def shape_key(self) -> str:
+        """The digest key this record will fold under — exposed so the
+        scheduler (utils/costprior.py) can map query text → shape while
+        the request is still open (finish() uses the same rule)."""
+        return ("+".join(sorted(self.shapes))
+                or self.lane or UNCLASSIFIED)
+
     def add_kernel(self, family: str, compile_us: float = 0.0,
                    execute_us: float = 0.0) -> None:
         k = self.kernels.setdefault(family,
@@ -222,8 +231,7 @@ class Recorder:
         # no shape component (mutations, schema queries): the lane is
         # the coarsest honest shape — never a silent "unclassified"
         # unless even the lane is unknown
-        rec = {"shape": ("+".join(sorted(self.shapes))
-                         or self.lane or UNCLASSIFIED),
+        rec = {"shape": self.shape_key(),
                "trace_id": self.trace_id, "lane": self.lane,
                "outcome": outcome,
                "total_us": int((time.perf_counter() - self.t0) * 1e6),
@@ -386,6 +394,12 @@ class Aggregator:
 # -- module-level ambient recorder (METRICS-style process singletons) --------
 
 COSTS = Aggregator()
+# per-tablet (predicate) cost sums in µs-equivalents: measured kernel
+# execute + ELL build µs where available, a modeled µs for host
+# expansions. Bounded metrics-style (cap + "other"); ships to Zero in
+# the health heartbeat so tablet moves prefer under-loaded groups.
+_TABLET_COSTS: dict[str, int] = {}
+_TABLET_LOCK = locks.make_lock("costprofile.tablets")
 _RECENT: list = []            # ring of finished records (lock-guarded)
 _RECENT_LOCK = locks.make_lock("costprofile.recent")
 _SINKS: list = []             # push-pipeline subscribers
@@ -496,6 +510,26 @@ def add_kernel(family: str, compile_us: float = 0.0,
                        execute_us=execute_us)
 
 
+def add_tablet_cost(pred: str, us) -> None:
+    """Charge `us` µs-equivalents of work to a predicate's tablet (the
+    placement signal — see _TABLET_COSTS). Cheap: one lock + dict add
+    per kernel launch / level expansion, gated on the same switch the
+    <5% overhead guard flips."""
+    if not _ENABLED:
+        return
+    with _TABLET_LOCK:
+        if pred not in _TABLET_COSTS \
+                and len(_TABLET_COSTS) >= MAX_LABEL_SETS:
+            pred = OVERFLOW_SHAPE
+        _TABLET_COSTS[pred] = _TABLET_COSTS.get(pred, 0) + int(us)
+
+
+def tablet_costs() -> dict[str, int]:
+    """Per-tablet cost sums since process start (heartbeat payload)."""
+    with _TABLET_LOCK:
+        return dict(_TABLET_COSTS)
+
+
 def recent(n: int = 100) -> list[dict]:
     with _RECENT_LOCK:
         return _RECENT[-n:]
@@ -531,4 +565,6 @@ def reset() -> None:
     COSTS.clear()
     with _RECENT_LOCK:
         _RECENT.clear()
+    with _TABLET_LOCK:
+        _TABLET_COSTS.clear()
     del _SINKS[:]

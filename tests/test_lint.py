@@ -121,6 +121,28 @@ def test_cost_record_schema_shares_the_facts_vocabulary():
             if d["kind"] != "meta"}
 
 
+def test_cost_prior_features_pinned_to_cost_fields():
+    """ISSUE-9 satellite: the prior model's regressor vocabulary
+    (utils/costprior.FEATURES) is lint-pinned to costprofile.FIELDS in
+    BOTH directions, like cost_record_fields — the facts inventory
+    re-exports it verbatim, every prior feature is a real `feature`
+    field of the record schema, and every feature field is reachable
+    by the model."""
+    from dgraph_tpu.utils import costprior, costprofile
+    a = run(ROOT)
+    facts_feats = [f["name"] for f in a.facts["cost_prior_features"]]
+    # direction 1: facts == the model's vocabulary, order included
+    assert facts_feats == list(costprior.FEATURES)
+    assert a.facts["totals"]["cost_prior_features"] \
+        == len(costprior.FEATURES)
+    # direction 2: every prior feature is a `feature`-kind record
+    # field, and every feature-kind field is in the model's reach
+    for f in a.facts["cost_prior_features"]:
+        assert costprofile.FIELDS[f["name"]]["kind"] == "feature"
+        assert f["kind"] == "feature"
+    assert set(costprior.FEATURES) == set(costprofile.FEATURE_FIELDS)
+
+
 def test_cli_json_runs_clean():
     out = subprocess.run(
         [sys.executable, "-m", "dgraph_tpu.analysis", "--format=json"],

@@ -380,3 +380,43 @@ def test_backup_incremental_chain_from_ooc(seed_ckpt, tmp_path):
         "q": [{"name": "post-full"}]}
     assert r.query('{ q(func: eq(name, "p5")) { name } }') == {
         "q": [{"name": "p5"}]}
+
+
+def test_streaming_fold_carries_ell_cache(seed_ckpt, tmp_path):
+    """ISSUE 9 satellite (carried from PR 7): a STREAMING fold
+    (MVCCStore.install_fold via checkpoint_streaming) carries
+    ELL/device/kernel cache entries for predicates the folded layers
+    didn't touch, exactly like the in-core rollup — counted by
+    `ell_cache_carried_total` — and the folded store still answers the
+    batch identically through the carried cache."""
+    from dgraph_tpu.engine.batch import _cache_host
+
+    d = str(tmp_path / "p")
+    shutil.copytree(seed_ckpt, d)
+    budget = _disk_bytes(d) // 3
+    a = Alpha.open(d, device_threshold=10**9, sync=False,
+                   memory_budget=budget)
+    qs = ['{ q(func: eq(name, "p%d")) @recurse(depth: 2) '
+          '{ name follows } }' % (i * 13 % N) for i in range(6)]
+    want = a.query_batch(qs)            # primes the ELL cache
+    base = a.mvcc.base
+    host = _cache_host(base, "follows", False)
+    g_old = host._ell_cache[("follows", False)]
+    assert g_old is not None
+
+    # touch an EXISTING node's value on another predicate: the fold's
+    # vocabulary stays identical, `follows` untouched
+    uid = a.query('{ q(func: eq(name, "p9")) { uid } }')["q"][0]["uid"]
+    a.mutate(set_nquads=f'<{uid}> <score> "999"^^<xs:int> .')
+    carried0 = METRICS.get("ell_cache_carried_total")
+    a.maintenance_rollup(d)             # streaming fold → install_fold
+    assert METRICS.get("ell_cache_carried_total") > carried0
+    new_base = a.mvcc.base
+    assert new_base is not base
+    carried = getattr(new_base, "_ell_cache", {})
+    assert carried.get(("follows", False)) is g_old, \
+        "untouched predicate's ELL must carry through install_fold"
+    # the folded store answers the same batch identically
+    assert a.query_batch(qs) == want
+    got = a.query('{ q(func: eq(name, "p9")) { score } }')
+    assert got["q"][0]["score"] == 999

@@ -119,6 +119,76 @@ def test_rebalance_moves_smallest_tablet_from_loaded_group():
         s.stop(None)
 
 
+def test_move_and_rebalance_never_target_unhealthy_peers():
+    """ISSUE 9 placement acceptance: a destination replica that a fresh
+    alpha health report marks breaker-open (or half-open) is NEVER a
+    move target — move_tablet refuses outright when every destination
+    replica is unhealthy, rebalance skips the group, and both count
+    `zero_moves_skipped_unhealthy_total`. A stale (past-TTL) or healed
+    report lifts the veto."""
+    from dgraph_tpu.utils.metrics import METRICS
+
+    state = ZeroState(replicas=1)
+    n1, g1 = state.connect("127.0.0.1:7001", 0)
+    n2, g2 = state.connect("127.0.0.1:7002", 0)
+    assert g1 != g2
+    for pred in ("name", "age"):
+        assert state.should_serve(pred, g1) == g1
+    state.report_sizes(g1, {"name": 1000, "age": 10})
+    state.report_sizes(g2, {})
+
+    # node 1's breaker view: the only node of group 2 is OPEN, and its
+    # tablets carry measured cost (the load half of the signal)
+    state.report_health({
+        "node_id": n1, "group": g1, "addr": "127.0.0.1:7001",
+        "peers": {"127.0.0.1:7002": {"state": "open",
+                                     "ema_latency_us": 9.9}},
+        "tablet_costs": {"name": 5000, "age": 50}})
+    assert "127.0.0.1:7002" in state.unhealthy_addrs()
+    assert state.group_cost_load(g1) == 5050
+
+    skipped0 = METRICS.get("zero_moves_skipped_unhealthy_total")
+    # rebalance: the only candidate destination is unhealthy → no move
+    assert state.rebalance_candidate() is None
+    assert METRICS.get("zero_moves_skipped_unhealthy_total") \
+        == skipped0 + 1
+    # an explicit move to the unhealthy group is refused before any
+    # pull is attempted (no server is even listening on these ports —
+    # a wire attempt would surface as a gRPC error, not a clean False)
+    assert move_tablet(state, "name", g2) is False
+    assert state.tablets["name"] == g1
+    assert METRICS.get("zero_moves_skipped_unhealthy_total") \
+        == skipped0 + 2
+
+    # half-open is just as vetoed (a probe in flight is not health)
+    state.report_health({
+        "node_id": n1, "group": g1, "addr": "127.0.0.1:7001",
+        "peers": {"127.0.0.1:7002": {"state": "half_open",
+                                     "ema_latency_us": 9.9}},
+        "tablet_costs": {}})
+    assert "127.0.0.1:7002" in state.unhealthy_addrs()
+
+    # a healed report lifts the veto: rebalance proposes the move again
+    state.report_health({
+        "node_id": n1, "group": g1, "addr": "127.0.0.1:7001",
+        "peers": {"127.0.0.1:7002": {"state": "closed",
+                                     "ema_latency_us": 5.0}},
+        "tablet_costs": {"name": 5000, "age": 50}})
+    assert "127.0.0.1:7002" not in state.unhealthy_addrs()
+    cand = state.rebalance_candidate()
+    assert cand == ("age", g1, g2)  # smallest tablet of the loaded group
+
+    # ...and a STALE unhealthy report (past HEALTH_TTL_S) doesn't veto
+    state.report_health({
+        "node_id": n1, "group": g1, "addr": "127.0.0.1:7001",
+        "peers": {"127.0.0.1:7002": {"state": "open",
+                                     "ema_latency_us": 9.9}},
+        "tablet_costs": {}})
+    from dgraph_tpu.cluster.zero import HEALTH_TTL_S
+    state.alpha_health[n1]["at"] -= HEALTH_TTL_S + 1
+    assert "127.0.0.1:7002" not in state.unhealthy_addrs()
+
+
 def test_rejoin_reclaims_identity_after_zero_restart(tmp_path):
     """A journal-replayed membership must hand a rejoining address its
     OLD node id and group, or tablets stay mapped to a ghost group
