@@ -59,7 +59,19 @@ MAINT_N = 220              # maintenance-stage store size (host-side)
 METRIC = f"edges_traversed_per_sec_{DEPTH}hop_recurse_{B_DEV}q"
 GLOBAL_DEADLINE_S = 780
 STAGE_DEADLINES = {"stage0": 150.0, "stage1": 240.0, "stage2": 330.0,
-                   "maintenance": 60.0, "sched": 90.0}
+                   "maintenance": 60.0, "sched": 90.0, "mesh": 300.0}
+
+# mesh stage: reshard-free chained hops over 1/2/4 host devices
+# (ISSUE 10) — one grandchild per device count, XLA_FLAGS set before
+# its jax import; a TPU backend ignores the host-device flag and
+# shards over real chips instead
+MESH_STAGE_DEVICES = (1, 2, 4)
+MESH_N = 1 << 16
+MESH_DEG = 8.0
+MESH_DEPTH = 3
+MESH_SEEDS = 512
+MESH_REPS = 3
+MESH_CHILD_TIMEOUT_S = 90.0
 HBM_PEAK_GBPS = 819.0      # v5e single chip
 
 _emitted = threading.Event()
@@ -342,7 +354,133 @@ def child_main(platform: str, expect_path: str) -> None:
         _stage(sched_stage())
     except Exception as e:  # noqa: BLE001 — additive telemetry
         _stage({"stage": "sched", "error": str(e)})
+
+    # -- mesh stage: sharded-serving scaling vs device count (ISSUE 10) -----
+    try:
+        _stage(mesh_stage())
+    except Exception as e:  # noqa: BLE001 — additive telemetry
+        _stage({"stage": "mesh", "error": str(e)})
     os._exit(0)
+
+
+def mesh_child_main(n_dev: int) -> None:
+    """One mesh scaling point: depth-MESH_DEPTH visit-once expansion as
+    chained reshard-free hops (parallel/dhop.chain_hop — the mesh
+    serving path's kernel) over `n_dev` devices, same workload at every
+    device count. The spawner set XLA_FLAGS before this process
+    imported jax, so a CPU backend fakes `n_dev` host devices; a real
+    TPU backend ignores the flag and shards over its chips. Prints ONE
+    JSON line: edges/s, shard balance, resident bytes, and the reshard
+    counter (the steady-path zero-copy contract, asserted)."""
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.join(ROOT, ".jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+
+    from dgraph_tpu.ops.uidalgebra import SENTINEL32
+    from dgraph_tpu.parallel.dhop import chain_hop
+    from dgraph_tpu.parallel.mesh import make_mesh, reshard_count
+    from dgraph_tpu.parallel.pshard import device_put_rel, shard_rel
+
+    d = min(n_dev, len(jax.devices()))
+    mesh = make_mesh(d)
+    rel = build_graph(MESH_N, MESH_DEG, seed=17)
+    host_srel = shard_rel(rel, d)
+    nnz = host_srel.indptr_s[:, -1].astype(np.int64)
+    srel = device_put_rel(host_srel, mesh)
+
+    out_cap = MESH_N
+    seen_cap = 2 * MESH_N
+    edge_cap = 1
+    while edge_cap < max(int(nnz.max()), 1):
+        edge_cap <<= 1
+    rng = np.random.default_rng(3)
+    seeds = np.unique(rng.integers(0, MESH_N, MESH_SEEDS)).astype(
+        np.int32)
+
+    def pad(a, size):
+        out = np.full(size, SENTINEL32, np.int32)
+        out[:len(a)] = a
+        return out
+
+    def run_chain(check: bool):
+        fr, seen = pad(seeds, out_cap), pad(seeds, seen_cap)
+        edges = []
+        for _h in range(MESH_DEPTH):
+            fr, seen, e, needs, *_rest = chain_hop(
+                mesh, srel, fr, seen, edge_cap, out_cap, seen_cap)
+            if check:
+                need = np.asarray(needs)
+                assert need[0] <= out_cap and need[1] <= seen_cap \
+                    and need[2] <= edge_cap, need.tolist()
+            edges.append(e)
+        return int(sum(np.asarray(e) for e in edges))
+
+    t0 = time.perf_counter()
+    total_edges = run_chain(check=True)  # compile + cap proof
+    compile_s = time.perf_counter() - t0
+    ts = []
+    for _ in range(MESH_REPS):
+        t0 = time.perf_counter()
+        got = run_chain(check=False)
+        ts.append(time.perf_counter() - t0)
+        assert got == total_edges
+    best = min(ts)
+    resharded = reshard_count()
+    assert resharded == 0, resharded  # the steady-path contract
+    per_shard_bytes = int(host_srel.indptr_s[0].nbytes
+                          + host_srel.indices_s[0].nbytes + 4)
+    print(json.dumps({
+        "n_dev": d, "platform": jax.devices()[0].platform,
+        "depth": MESH_DEPTH, "total_edges": total_edges,
+        "compile_secs": round(compile_s, 2),
+        "run_ms": round(best * 1e3, 1),
+        "edges_per_sec": round(total_edges / best),
+        "resharded": resharded,
+        "shard_balance": round(float(nnz.max())
+                               / max(float(nnz.mean()), 1.0), 3),
+        "shard_bytes": per_shard_bytes}), flush=True)
+    os._exit(0)
+
+
+def mesh_stage() -> dict:
+    """Mesh-sharded serving scaling (ISSUE 10): the SAME chained-hop
+    workload at 1/2/4 devices, each point its own subprocess so
+    XLA_FLAGS binds before jax initializes. Reports edges/s per device
+    count plus scaling (4-dev / 1-dev) and parallel efficiency
+    (scaling / 4) — on a single-core host the virtual devices share
+    one core, so efficiency is a lower bound; the number is recorded
+    either way for the chip window to beat."""
+    t0 = time.perf_counter()
+    devices: dict[str, dict] = {}
+    for n in MESH_STAGE_DEVICES:
+        env = dict(os.environ)
+        flags = [f for f in env.get("XLA_FLAGS", "").split()
+                 if "xla_force_host_platform_device_count" not in f]
+        env["XLA_FLAGS"] = " ".join(
+            flags + [f"--xla_force_host_platform_device_count={n}"])
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__),
+                 "--mesh-child", str(n)],
+                capture_output=True, text=True, cwd=ROOT, env=env,
+                timeout=MESH_CHILD_TIMEOUT_S)
+            line = proc.stdout.strip().splitlines()[-1]
+            devices[str(n)] = json.loads(line)
+        except Exception as e:  # noqa: BLE001 — per-point isolation
+            devices[str(n)] = {"error": f"{type(e).__name__}: {e}"}
+    out = {"stage": "mesh",
+           "secs": round(time.perf_counter() - t0, 2),
+           "devices": devices}
+    e1 = devices.get("1", {}).get("edges_per_sec")
+    e4 = devices.get("4", {}).get("edges_per_sec")
+    if e1 and e4:
+        out["scaling_4v1"] = round(e4 / e1, 3)
+        out["efficiency_4"] = round(e4 / e1 / 4, 3)
+        out["resharded"] = sum(v.get("resharded", 0)
+                               for v in devices.values())
+    return out
 
 
 def lint_stage() -> dict:
@@ -533,6 +671,9 @@ def sched_stage() -> dict:
             "prior_fit": fit,
             "pack_imbalance": imb,
             "scheduler": costprior.status(top_n=5)}
+
+
+def maintenance_stage() -> dict:
     """Pause-impact telemetry (ISSUE 3): serve a query mix against an
     out-of-core store while the background scheduler streams rollups +
     checkpoints, and report the latency penalty maintenance imposes —
@@ -655,12 +796,12 @@ def run_child_staged(platform: str, expect_path: str,
     t_start = time.perf_counter()
     try:
         for name in ("stage0", "stage1", "stage2", "maintenance",
-                     "sched"):
+                     "sched", "mesh"):
             remaining = budget_s - (time.perf_counter() - t_start)
             deadline = min(STAGE_DEADLINES[name], max(remaining, 1.0))
             line = _read_line(proc, deadline)
             if line is None:
-                if name in ("maintenance", "sched"):
+                if name in ("maintenance", "sched", "mesh"):
                     break  # additive telemetry: absence is not an error
                 err = (f"{name} produced no output within {deadline:.0f}s "
                        f"(rc={proc.poll()})")
@@ -813,6 +954,14 @@ def main() -> None:
         out["sched"] = {k: ss[k] for k in
                         ("priors_on", "priors_off", "prior_fit",
                          "pack_imbalance") if k in ss}
+    # mesh-sharded serving scaling (ISSUE 10): edges/s per device count,
+    # 4-vs-1 scaling + efficiency, shard balance, reshard counter —
+    # straight off the child's mesh stage
+    sme = stages.get("mesh")
+    if sme is not None and "error" not in sme:
+        out["mesh"] = {k: sme[k] for k in
+                       ("devices", "scaling_4v1", "efficiency_4",
+                        "resharded") if k in sme}
     out["lint"] = lint_stage()
     emit(out)
     watchdog.cancel()
@@ -824,5 +973,7 @@ if __name__ == "__main__":
     if len(sys.argv) >= 3 and sys.argv[1] == "--child":
         child_main(sys.argv[2], sys.argv[3] if len(sys.argv) > 3
                    else os.path.join(ROOT, ".bench_expect.npz"))
+    elif len(sys.argv) >= 3 and sys.argv[1] == "--mesh-child":
+        mesh_child_main(int(sys.argv[2]))
     else:
         main()

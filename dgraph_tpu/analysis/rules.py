@@ -21,6 +21,12 @@ R5 metric-docs           metric names are string literals, label sets
 R6 jit-purity            no `.item()`/`.tolist()`/numpy host ops or
                          Python branches on tracer params inside
                          functions handed to `jax.jit`.
+R7 shard-map-compat      `shard_map` resolves ONLY through
+                         utils/jaxcompat.py — direct `jax.shard_map` /
+                         `jax.experimental.shard_map` references
+                         elsewhere re-pin the mesh layer to one jax
+                         version (the exact regression that parked the
+                         whole parallel/ layer in the failure set).
 """
 
 from __future__ import annotations
@@ -30,7 +36,7 @@ import ast
 from dgraph_tpu.analysis import FileContext, Finding, Rule
 
 __all__ = ["default_rules", "HotLoopCheckpoint", "DirectIO", "WallClock",
-           "RetryDeadline", "MetricDocs", "JitPurity"]
+           "RetryDeadline", "MetricDocs", "JitPurity", "ShardMapCompat"]
 
 
 def _dotted(node: ast.AST) -> str:
@@ -392,6 +398,57 @@ class JitPurity(Rule):
         return out
 
 
+# ---------------------------------------------------------------------------
+class ShardMapCompat(Rule):
+    name = "shard-map-compat"
+    doc = ("`shard_map` has moved across jax releases "
+           "(jax.experimental.shard_map.shard_map with check_rep → "
+           "jax.shard_map with check_vma); utils/jaxcompat.py resolves "
+           "it ONCE per process and is the only file allowed to touch "
+           "either spelling — everywhere else imports the shim, so a "
+           "jax upgrade can't silently re-park the mesh layer")
+
+    SHIM = "dgraph_tpu/utils/jaxcompat.py"
+
+    def applies(self, rel: str) -> bool:
+        return ((rel.startswith("dgraph_tpu/") or rel == "bench.py")
+                and rel != self.SHIM)
+
+    def check_file(self, ctx: FileContext) -> list[Finding]:
+        out = []
+        flagged: set[int] = set()  # one finding per line, not per
+        #                            nested Attribute of the same chain
+
+        def flag(line: int, what: str) -> None:
+            if line in flagged:
+                return
+            flagged.add(line)
+            out.append(Finding(
+                self.name, ctx.rel, line,
+                f"direct {what} outside utils/jaxcompat.py — import "
+                f"the versioned resolver instead "
+                f"(from dgraph_tpu.utils.jaxcompat import shard_map)"))
+
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Attribute):
+                d = _dotted(node)
+                if (d == "jax.shard_map"
+                        or d.startswith("jax.experimental.shard_map")):
+                    flag(node.lineno, f"`{d}` reference")
+            elif isinstance(node, ast.ImportFrom):
+                mod = node.module or ""
+                if mod.startswith("jax.experimental.shard_map") or (
+                        mod == "jax" and any(a.name == "shard_map"
+                                             for a in node.names)):
+                    flag(node.lineno, f"import from `{mod}`")
+            elif isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name.startswith("jax.experimental.shard_map"):
+                        flag(node.lineno, f"import of `{a.name}`")
+        return out
+
+
 def default_rules() -> list[Rule]:
     return [HotLoopCheckpoint(), DirectIO(), WallClock(),
-            RetryDeadline(), MetricDocs(), JitPurity()]
+            RetryDeadline(), MetricDocs(), JitPurity(),
+            ShardMapCompat()]

@@ -908,6 +908,7 @@ def carry_kernel_caches(old_store, new_store, touched) -> int:
         return 0
     if not np.array_equal(old_store.uids, new_store.uids):
         return 0
+    carry_mesh_residency(old_store, new_store, touched)
     carried = 0
     with _cache_lock:
         src_cache = getattr(old_store, "_ell_cache", None)
@@ -937,4 +938,31 @@ def carry_kernel_caches(old_store, new_store, touched) -> int:
             carried += 1
     if carried:
         METRICS.inc("ell_cache_carried_total", float(carried))
+    return carried
+
+
+def carry_mesh_residency(old_store, new_store, touched) -> int:
+    """Sharded mesh tablets (store.sharded_rel cache) carry across a
+    fold exactly like ELL/device blocks: a predicate the folded layers
+    didn't touch rebuilds to identical CSR content, so the placed shard
+    stack stays valid for the same mesh — the serving path never
+    re-uploads a resident tablet because of an unrelated fold."""
+    src = getattr(old_store, "_sharded", None)
+    if not src:
+        return 0
+    mesh = getattr(old_store, "_sharded_mesh", None)
+    with _cache_lock:
+        dst = getattr(new_store, "_sharded", None)
+        if dst is None or getattr(new_store, "_sharded_mesh",
+                                  None) is not mesh:
+            dst = new_store._sharded = {}
+            new_store._sharded_mesh = mesh
+        carried = 0
+        for key, srel in src.items():
+            if key[0] in touched or key in dst:
+                continue
+            dst[key] = srel
+            carried += 1
+    if carried:
+        METRICS.inc("mesh_resident_carried_total", float(carried))
     return carried

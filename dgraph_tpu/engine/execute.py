@@ -21,6 +21,7 @@ matrix preserves per-parent row structure for nested JSON.
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -114,11 +115,23 @@ class Executor:
         allow_remote=False."""
         with tracing.span("ops.expand", pred=pred, reverse=reverse,
                           frontier=int(len(frontier))) as sp:
+            t0 = time.perf_counter()
             out, path = self._expand_routed(pred, reverse, frontier,
                                             allow_remote)
             sp.attrs["path"] = path
             sp.attrs["edges"] = int(len(out[0]))
+            if self.mesh is not None:
+                # route-selector accounting: which path won while a
+                # mesh was configured (the promotion A/B signal)
+                METRICS.inc("mesh_route_total", route=path)
             if len(out[0]):
+                # learned route costs: µs per 1k edges EMA per path —
+                # the prior the selector consults to promote the mesh
+                # route below the static threshold
+                from dgraph_tpu.utils import costprior
+                costprior.PRIORS.learn_route(
+                    path, (time.perf_counter() - t0) * 1e6
+                    / max(len(out[0]), 1) * 1000.0)
                 # the north-star counter, labeled by execution path
                 METRICS.inc("edges_traversed_total", float(len(out[0])),
                             path=path)
@@ -151,7 +164,39 @@ class Executor:
                 return self._expand_mesh(pred, reverse, frontier), "mesh"
             return (self._expand_device(pred, reverse, frontier),
                     "device")
+        if self.mesh is not None and self._mesh_promoted(len(frontier)):
+            return self._expand_mesh(pred, reverse, frontier), "mesh"
         return csr_rows(rel, frontier), "numpy"
+
+    # learned-promotion floor: below this many frontier rows, per-launch
+    # dispatch overhead dominates any measured per-edge win, so the
+    # numpy path keeps them regardless of what the route EMAs say
+    mesh_floor = 64
+
+    def _mesh_promoted(self, n: int) -> bool:
+        """Cost-prior route promotion: frontiers below device_threshold
+        still take the mesh route when the measured per-edge cost EMAs
+        (utils/costprior.py, learned from every expansion) say the mesh
+        is cheaper than the host walk. Before any data exists — or with
+        priors disabled — the classic threshold routing is unchanged."""
+        from dgraph_tpu.utils import costprior
+        if n < self.mesh_floor or not costprior.enabled():
+            return False
+        m = costprior.PRIORS.route_cost("mesh")
+        h = costprior.PRIORS.route_cost("numpy")
+        return m is not None and h is not None and m < h
+
+    def _note_mesh_shards(self, counts) -> None:
+        """Shard-keyed accounting for one mesh-routed expansion: the
+        shape component + shard-count feature the cost priors key on,
+        and modeled per-shard µs into the shard cost sums (the
+        scheduler/placement signal /debug/scheduler surfaces)."""
+        counts = np.asarray(counts)
+        costprofile.add_shape("mesh")
+        costprofile.note_max("mesh_shards", int(len(counts)))
+        for d, c in enumerate(counts.tolist()):
+            if int(c):
+                costprofile.add_shard_cost(d, int(c) // 16 + 1)
 
     def facet_positions(self, sg: SubGraph, pos: np.ndarray) -> np.ndarray:
         """Edge positions in the forward-CSR space facet columns key on
@@ -228,6 +273,8 @@ class Executor:
             self.mesh, srel, fr, edge_cap)
         max_shard = int(host_np(max_shard))
         assert max_shard <= edge_cap, (max_shard, edge_cap)
+        totals = host_np(totals)
+        self._note_mesh_shards(totals)
         return self._reassemble_shards(srel, nbrs_s, seg_s, pos_s, totals)
 
     def _expand_mesh_ring(self, pred: str, reverse: bool,
@@ -259,6 +306,7 @@ class Executor:
         nbrs_a, seg_a, pos_a = (host_np(nbrs_a), host_np(seg_a),
                                 host_np(pos_a))
         totals = host_np(totals)
+        self._note_mesh_shards(totals.sum(axis=1))
         nbrs, seg, pos = self._stitch_edge_parts(
             (nbrs_a[dev, i, :int(totals[dev, i])],
              seg_a[dev, i, :int(totals[dev, i])] + ((dev - i) % d) * per,
@@ -742,10 +790,12 @@ class Executor:
         srel = self.store.sharded_rel(sg.attr, sg.is_reverse, self.mesh)
         edge_cap = self._shard_edge_cap(srel, frontier, deg)
         from dgraph_tpu.parallel.mesh import host_np
-        nbrs_s, seg_s, pos_s, kept, _totals, max_shard = matrix_level(
+        nbrs_s, seg_s, pos_s, kept, totals, max_shard = matrix_level(
             self.mesh, srel, fr, allowed_d, sg.offset, first,
             edge_cap, use_allowed)
         assert int(host_np(max_shard)) <= edge_cap, edge_cap
+        self._note_mesh_shards(host_np(totals))
+        METRICS.inc("mesh_route_total", route="fused")
         return self._reassemble_shards(srel, nbrs_s, seg_s, pos_s, kept)
 
     # -- leaves, vars, expand(_all_) ----------------------------------------

@@ -25,6 +25,13 @@ from dgraph_tpu.utils import deadline
 
 MAX_RECURSE_DEPTH = 64  # guard when depth: 0 (fixpoint mode)
 
+# Mesh @recurse route: chained hops (ONE compiled hop program reused at
+# every depth, frontier/seen device-resident between launches — the
+# reshard-free serving path) vs the monolithic lax.scan program
+# (recurse_fused_matrix, which retraces per depth). Chain is the
+# serving default; the scan variant stays for A/B and tests.
+MESH_CHAIN_HOPS = True
+
 
 @dataclass
 class RecurseData:
@@ -67,7 +74,10 @@ def expand_recurse(ex, root) -> None:
             and len(data.edge_sgs) == 1 and not data.edge_sgs[0].filters
             and not data.edge_sgs[0].facet_filter
             and len(root.nodes) > 0):
-        _fused_recurse(ex, root, data, args.depth)
+        if MESH_CHAIN_HOPS:
+            _chain_recurse(ex, root, data, args.depth)
+        else:
+            _fused_recurse(ex, root, data, args.depth)
         _bind_recurse_vars(ex, root, data, sg)
         root.recurse_data = data
         return
@@ -135,6 +145,103 @@ def _bind_recurse_vars(ex, root, data: RecurseData, sg: SubGraph) -> None:
             root.nodes = saved_nodes
     if sg.var_name:
         ex.uid_vars[sg.var_name] = data.all_nodes
+
+
+def _chain_recurse(ex, root, data: RecurseData, depth: int) -> None:
+    """Depth-bounded mesh @recurse as `depth` launches of ONE compiled
+    hop program (parallel.dhop.chain_hop). The hop's replicated
+    out_specs are exactly the next launch's in_specs, so the frontier
+    and seen set stay device-resident between hops — zero cross-device
+    reshards on the steady path (mesh.reshard_guard armed around the
+    loop; the pjit pitfall SNIPPETS calls out) — and the compile is
+    depth-independent, where the lax.scan program retraces per depth.
+    The host only READS each hop's outputs (edge matrices + the input
+    frontier's values, for rendering) and feeds the same device arrays
+    back in. Semantics are identical to _fused_recurse (visit-once,
+    first-visit-tree), pinned by tests against it and the host loop."""
+    from dgraph_tpu.engine.execute import _bucket
+    from dgraph_tpu.ops.uidalgebra import SENTINEL32
+    from dgraph_tpu.parallel.dhop import chain_hop
+    from dgraph_tpu.parallel.mesh import host_np, reshard_guard
+    from dgraph_tpu.utils import costprofile, tracing
+
+    def pad_host(a: np.ndarray, size: int) -> np.ndarray:
+        # host-side sentinel pad: the chain's SEED is an expected
+        # upload; a device-side pad would read as a reshard to the
+        # guard (ops.pad_to lands on the default device)
+        out = np.full(size, SENTINEL32, np.int32)
+        out[:len(a)] = a
+        return out
+
+    from dgraph_tpu.utils.metrics import METRICS
+    METRICS.inc("mesh_route_total", route="chain")
+    esg = data.edge_sgs[0]
+    srel = ex.store.sharded_rel(esg.attr, esg.is_reverse, ex.mesh)
+    seeds = np.sort(root.nodes).astype(np.int32)
+    out_cap = _bucket(max(len(seeds), 1))
+    seen_cap = _bucket(4 * out_cap, lo=256)
+    edge_cap = _bucket(1, lo=1024)
+    parts_p: list[np.ndarray] = []
+    parts_c: list[np.ndarray] = []
+    seen = None
+    for _attempt in range(12):  # geometric cap growth, bounded
+        fr = pad_host(seeds, out_cap)
+        seen = pad_host(seeds, seen_cap)
+        parts_p, parts_c = [], []
+        overflowed = False
+        with reshard_guard():
+            for h in range(depth):
+                deadline.checkpoint("recurse")
+                with tracing.span("mesh.hop", pred=esg.attr, hop=h,
+                                  shards=srel.n_shards) as sp:
+                    (fr_next, seen_next, _edges, needs, nbrs_s, seg_s,
+                     shard_edges, kept) = chain_hop(
+                        ex.mesh, srel, fr, seen,
+                        edge_cap, out_cap, seen_cap)
+                    need_out, need_seen, need_edge = (
+                        int(x) for x in host_np(needs))
+                    if (need_out > out_cap or need_seen > seen_cap
+                            or need_edge > edge_cap):
+                        out_cap = _bucket(max(need_out, out_cap))
+                        seen_cap = _bucket(max(need_seen, seen_cap),
+                                           lo=256)
+                        edge_cap = _bucket(max(need_edge, edge_cap),
+                                           lo=1024)
+                        overflowed = True
+                        break
+                    # render reads: the hop's INPUT frontier values map
+                    # seg → parent ranks; the device fr/seen arrays feed
+                    # the next launch unmoved
+                    fr_h = host_np(fr)
+                    nbrs_h = host_np(nbrs_s)
+                    seg_h = host_np(seg_s)
+                    per_shard = host_np(shard_edges)
+                    sp.attrs["edges"] = int(host_np(kept))
+                    for d in range(srel.n_shards):
+                        row = nbrs_h[d]
+                        m = row != SENTINEL32
+                        if m.any():
+                            parts_p.append(fr_h[seg_h[d][m]])
+                            parts_c.append(row[m])
+                        # modeled per-shard µs (the ~16 edges/µs host
+                        # scale expand() charges tablets with) — the
+                        # scheduler/placement signal for mesh work
+                        if int(per_shard[d]):
+                            costprofile.add_shard_cost(
+                                d, int(per_shard[d]) // 16 + 1)
+                    fr, seen = fr_next, seen_next
+                    if need_out == 0:  # frontier emptied: fixpoint
+                        break
+        if not overflowed:
+            break
+    else:
+        raise RuntimeError("recurse caps failed to converge")
+
+    if parts_p:
+        data.edges[0] = (np.concatenate(parts_p).astype(np.int32),
+                         np.concatenate(parts_c).astype(np.int32))
+    seen_h = host_np(seen)
+    data.all_nodes = seen_h[seen_h != SENTINEL32].astype(np.int32)
 
 
 def _fused_recurse(ex, root, data: RecurseData, depth: int) -> None:

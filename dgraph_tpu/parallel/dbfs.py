@@ -30,6 +30,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from dgraph_tpu.utils.jaxcompat import shard_map
 from dgraph_tpu.parallel.mesh import SHARD_AXIS
 
 
@@ -123,7 +124,7 @@ def _build(mesh: Mesh, depth: int):
             None, length=depth)
         return last[None], seen[None], edges
 
-    fn = jax.shard_map(
+    fn = shard_map(
         per_device, mesh=mesh,
         in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS),
                   P(SHARD_AXIS)),

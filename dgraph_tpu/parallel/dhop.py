@@ -32,7 +32,8 @@ from jax.sharding import Mesh, PartitionSpec as P
 from dgraph_tpu.ops.hop import gather_edges
 from dgraph_tpu.ops.uidalgebra import (
     _member, difference_sorted, sentinel, sort_unique_count, valid_mask)
-from dgraph_tpu.parallel.mesh import SHARD_AXIS
+from dgraph_tpu.utils.jaxcompat import shard_map
+from dgraph_tpu.parallel.mesh import SHARD_AXIS, hop_input
 from dgraph_tpu.parallel.pshard import ShardedRel
 
 
@@ -68,7 +69,7 @@ def _build_sg_hop(mesh: Mesh, edge_cap: int, out_cap: int):
         count = jnp.maximum(count, lax.pmax(local_cnt, SHARD_AXIS))
         return merged, count, total_all, max_shard_edges
 
-    fn = jax.shard_map(
+    fn = shard_map(
         per_device, mesh=mesh,
         in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS), P()),
         out_specs=(P(), P(), P(), P()),
@@ -90,7 +91,8 @@ def scatter_gather_hop(mesh: Mesh, rel: ShardedRel, frontier: jax.Array,
     out_cap.
     """
     return _build_sg_hop(mesh, edge_cap, out_cap)(
-        rel.indptr_s, rel.indices_s, rel.row_lo, frontier)
+        rel.indptr_s, rel.indices_s, rel.row_lo,
+        hop_input(frontier, mesh))
 
 
 @functools.lru_cache(maxsize=64)
@@ -102,7 +104,7 @@ def _build_matrix_hop(mesh: Mesh, edge_cap: int):
         return (nbrs[None], seg[None], edge_pos[None], total[None],
                 max_shard)
 
-    fn = jax.shard_map(
+    fn = shard_map(
         per_device, mesh=mesh,
         in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS), P()),
         out_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS),
@@ -129,7 +131,8 @@ def matrix_hop(mesh: Mesh, rel: ShardedRel, frontier: jax.Array,
     position facet columns key on. Valid only if max_shard_edges ≤
     edge_cap; otherwise re-run at a bigger bucket."""
     return _build_matrix_hop(mesh, edge_cap)(
-        rel.indptr_s, rel.indices_s, rel.row_lo, frontier)
+        rel.indptr_s, rel.indices_s, rel.row_lo,
+        hop_input(frontier, mesh))
 
 
 @functools.lru_cache(maxsize=64)
@@ -150,7 +153,7 @@ def _build_matrix_level(mesh: Mesh, edge_cap: int, use_allowed: bool):
         return (c_nbrs[None], c_seg[None], c_pos[None], n_kept[None],
                 total[None], max_shard)
 
-    fn = jax.shard_map(
+    fn = shard_map(
         per_device, mesh=mesh,
         in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS), P(), P(),
                   P(), P()),
@@ -210,7 +213,7 @@ def _build_ring_hop(mesh: Mesh, edge_cap: int, out_cap: int):
         count = jnp.maximum(count, lax.pmax(need, SHARD_AXIS))
         return acc[None], merged, count, total_all, max_edges
 
-    fn = jax.shard_map(
+    fn = shard_map(
         per_device, mesh=mesh,
         in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS)),
         out_specs=(P(SHARD_AXIS), P(), P(), P(), P()),
@@ -233,7 +236,8 @@ def ring_hop(mesh: Mesh, rel: ShardedRel, frontier_chunks: jax.Array,
     always visible).
     """
     return _build_ring_hop(mesh, edge_cap, out_cap)(
-        rel.indptr_s, rel.indices_s, rel.row_lo, frontier_chunks)
+        rel.indptr_s, rel.indices_s, rel.row_lo,
+        hop_input(frontier_chunks, mesh, P(SHARD_AXIS)))
 
 
 @functools.lru_cache(maxsize=64)
@@ -268,7 +272,7 @@ def _build_ring_matrix(mesh: Mesh, edge_cap: int, f_cap: int):
         return (nbrs_a[None], seg_a[None], pos_a[None], tot_a[None],
                 max_all)
 
-    fn = jax.shard_map(
+    fn = shard_map(
         per_device, mesh=mesh,
         in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS),
                   P(SHARD_AXIS)),
@@ -335,7 +339,7 @@ def _build_recurse(mesh: Mesh, edge_cap: int, out_cap: int, seen_cap: int,
         needs = jnp.stack([need_out, need_seen, need_edge])
         return last, seen, edges, needs
 
-    fn = jax.shard_map(
+    fn = shard_map(
         per_device, mesh=mesh,
         in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS), P()),
         out_specs=(P(), P(), P(), P()),
@@ -393,7 +397,7 @@ def _build_recurse_matrix(mesh: Mesh, edge_cap: int, out_cap: int,
         return (last, seen, edges, needs,
                 ys_nbrs[None], ys_seg[None], ys_pos[None], ys_frontier)
 
-    fn = jax.shard_map(
+    fn = shard_map(
         per_device, mesh=mesh,
         in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS), P()),
         out_specs=(P(), P(), P(), P(),
@@ -441,3 +445,73 @@ def recurse_fused(mesh: Mesh, rel: ShardedRel, frontier: jax.Array,
         raise ValueError(f"frontier buffer {frontier.shape[0]} != out_cap {out_cap}")
     return _build_recurse(mesh, edge_cap, out_cap, seen_cap, depth)(
         rel.indptr_s, rel.indices_s, rel.row_lo, frontier)
+
+
+@functools.lru_cache(maxsize=64)
+def _build_chain_hop(mesh: Mesh, edge_cap: int, out_cap: int,
+                     seen_cap: int):
+    """ONE visit-once hop with edge-matrix capture, compiled so its
+    replicated (frontier, seen) outputs are EXACTLY the next launch's
+    replicated inputs — the reshard-free multi-hop building block. One
+    compiled program serves every depth (the lax.scan variants above
+    retrace per depth), and between launches the frontier/seen arrays
+    stay device-resident: the host reads their VALUES for rendering but
+    feeds the same jax.Arrays back in, so no bytes re-cross the mesh
+    (mesh.hop_input counts any violation)."""
+
+    def per_device(indptr_b, indices_b, row_lo_b, frontier, seen):
+        indptr, indices, row_lo = indptr_b[0], indices_b[0], row_lo_b[0]
+        n_rows = indptr.shape[0] - 1
+        snt = sentinel(frontier.dtype)
+        mine = (valid_mask(frontier) & (frontier >= row_lo)
+                & (frontier < row_lo + n_rows))
+        local_f = jnp.where(mine, frontier - row_lo, snt)
+        nbrs, seg, _pos, valid, t = gather_edges(
+            indptr, indices, local_f, edge_cap)
+        # visit-once: drop edges to nodes seen BEFORE this hop (edges
+        # between two same-hop discoveries are kept — the host loop's
+        # first-visit-tree semantics, identical to recurse_fused_matrix)
+        keep = valid & ~_member(nbrs, seen)
+        m_nbrs = jnp.where(keep, nbrs, snt)
+        m_seg = jnp.where(keep, seg, jnp.int32(-1))
+        local, local_cnt = sort_unique_count(m_nbrs, out_cap)
+        gathered = lax.all_gather(local, SHARD_AXIS)
+        fresh, mcnt = sort_unique_count(gathered.reshape(-1), out_cap)
+        seen2, scnt = sort_unique_count(
+            jnp.concatenate([seen, fresh]), seen_cap)
+        needs = jnp.stack([
+            jnp.maximum(mcnt, lax.pmax(local_cnt, SHARD_AXIS)),
+            scnt, lax.pmax(t, SHARD_AXIS)])
+        totals = lax.psum(
+            jnp.where(keep, 1, 0).sum().astype(jnp.int32), SHARD_AXIS)
+        return (fresh, seen2, lax.psum(t, SHARD_AXIS), needs,
+                m_nbrs[None], m_seg[None], t[None], totals)
+
+    fn = shard_map(
+        per_device, mesh=mesh,
+        in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS), P(), P()),
+        out_specs=(P(), P(), P(), P(),
+                   P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS), P()),
+        check_vma=False,
+    )
+    return jax.jit(fn)
+
+
+def chain_hop(mesh: Mesh, rel: ShardedRel, frontier, seen,
+              edge_cap: int, out_cap: int, seen_cap: int):
+    """One launch of the chained visit-once hop (see _build_chain_hop).
+
+    `frontier`/`seen` are sorted sentinel-padded buffers of exactly
+    `out_cap`/`seen_cap` slots — host numpy on the first hop (the seed
+    upload), then the previous launch's DEVICE outputs unmoved. Returns
+    `(fresh[out_cap], seen2[seen_cap], edges, needs[3],
+    nbrs[D, edge_cap], seg[D, edge_cap], shard_edges[D], kept)`:
+    `fresh`/`seen2` are the next launch's inputs; `seg` indexes this
+    hop's input frontier; per shard d the slots with nbrs != sentinel
+    are its surviving (visit-once filtered) edges in CSR row order;
+    `shard_edges[d]` is the raw edges shard d expanded (the balance /
+    per-shard cost signal). Overflow contract of recurse_fused: results
+    valid only if needs <= [out_cap, seen_cap, edge_cap]."""
+    return _build_chain_hop(mesh, edge_cap, out_cap, seen_cap)(
+        rel.indptr_s, rel.indices_s, rel.row_lo,
+        hop_input(frontier, mesh), hop_input(seen, mesh))

@@ -24,6 +24,7 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from dgraph_tpu.ops.uidalgebra import valid_mask
+from dgraph_tpu.utils.jaxcompat import shard_map
 from dgraph_tpu.parallel.mesh import SHARD_AXIS, shard_leading
 
 
@@ -47,7 +48,7 @@ def _build_topk(mesh: Mesh, cap: int, k: int, rows: int):
         o2 = jnp.lexsort((gr, gv))           # k-way merge, one sort
         return gr[o2[:k]], gv[o2[:k]]
 
-    fn = jax.shard_map(
+    fn = shard_map(
         per_device, mesh=mesh,
         in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P()),
         out_specs=(P(), P()),
@@ -177,7 +178,7 @@ def _build_row_sort(mesh: Mesh, cap: int, rows: int, desc: bool):
         # lexsort contract of Executor.order_ranks
         return jnp.lexsort((nbrs, kv, seg_k))
 
-    fn = jax.shard_map(
+    fn = shard_map(
         per_device, mesh=mesh,
         in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(), P()),
         out_specs=P(),

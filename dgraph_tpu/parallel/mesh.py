@@ -14,9 +14,13 @@ touching query code.
 
 from __future__ import annotations
 
+import contextlib
+
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dgraph_tpu.utils.metrics import METRICS
 
 SHARD_AXIS = "shard"
 
@@ -85,3 +89,55 @@ def shard_leading(mesh: Mesh) -> NamedSharding:
 
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
+
+
+# -- reshard accounting -------------------------------------------------------
+# The steady serving contract (the pjit pitfall SNIPPETS calls out): a
+# hop's out_specs ARE the next hop's in_specs, so a chained frontier
+# re-enters the next launch with its sharding already right and XLA
+# inserts no cross-device copy. `hop_input` is the guard at every hop
+# entry point: a committed device array arriving with a DIFFERENT
+# sharding than the launch expects counts `mesh_hop_resharded_total`
+# (host numpy seeds are first-hop uploads, expected and not counted).
+
+def hop_input(x, mesh: Mesh, spec=P()):
+    """Count an unexpected reshard on a hop input; returns `x` unchanged.
+
+    Steady-path inputs are either host arrays (the chain's seed — a
+    transfer, not a reshard) or device arrays whose sharding already
+    equals `NamedSharding(mesh, spec)` (the previous hop's out_specs).
+    Anything else would make XLA re-lay the array across devices before
+    the launch — the silent copy this counter exists to catch."""
+    if isinstance(x, jax.Array):
+        sh = getattr(x, "sharding", None)
+        if sh is not None and not _sharding_matches(sh, mesh, spec,
+                                                    x.ndim):
+            METRICS.inc("mesh_hop_resharded_total")
+    return x
+
+
+def _sharding_matches(sh, mesh: Mesh, spec, ndim: int) -> bool:
+    want = NamedSharding(mesh, spec)
+    try:
+        return sh.is_equivalent_to(want, ndim)
+    except (AttributeError, TypeError):
+        return sh == want
+
+
+def reshard_count() -> int:
+    return int(METRICS.get("mesh_hop_resharded_total"))
+
+
+@contextlib.contextmanager
+def reshard_guard(strict: bool = True):
+    """Assert the steady path stayed reshard-free: zero
+    `mesh_hop_resharded_total` increments inside the block (armed
+    around hop loops by the engine and by the bit-identity tests)."""
+    before = reshard_count()
+    yield
+    after = reshard_count()
+    if strict and after != before:
+        raise AssertionError(
+            f"{after - before} unexpected cross-device reshard(s) on a "
+            f"steady hop path — an out_specs/in_specs mismatch between "
+            f"chained hops (see parallel/mesh.py hop_input)")

@@ -258,6 +258,13 @@ def make_http_server(alpha: Alpha, addr: str = "127.0.0.1",
                        **costprior.status(top_n=n)}
                 if alpha.admission is not None:
                     doc["admission"] = alpha.admission.status()
+                # mesh-route view: shard-keyed cost sums recorded by
+                # mesh expansions (engine/execute.py) — how the
+                # scheduler sees work land across the device mesh
+                from dgraph_tpu.utils import costprofile as _cp
+                shard_cost = _cp.shard_costs()
+                if shard_cost:
+                    doc["mesh"] = {"shard_cost_us": shard_cost}
                 self._send(200, doc)
             elif self.path.startswith("/debug/admission"):
                 # admission-control status: per-lane inflight/queued/

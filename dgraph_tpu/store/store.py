@@ -246,7 +246,35 @@ class Store:
         if key not in cache:
             srel = shard_rel(self.rel(pred, reverse), mesh.devices.size)
             cache[key] = device_put_rel(srel, mesh)
+            self._note_mesh_residency(srel)
         return cache[key]
+
+    def _note_mesh_residency(self, srel) -> None:
+        """Residency gauges for a newly placed sharded tablet:
+        `mesh_shard_bytes{shard=}` accumulates each shard's resident
+        bytes across this snapshot's cached tablets (padded widths —
+        what actually occupies device memory), `mesh_shard_balance`
+        tracks max/mean TRUE edges per shard (1.0 = perfectly
+        balanced; the padding hides imbalance from the bytes gauge)."""
+        from dgraph_tpu.utils.metrics import METRICS
+        ptr = np.asarray(srel.indptr_s)
+        d = ptr.shape[0]
+        per_bytes = (ptr[0].nbytes
+                     + np.asarray(srel.indices_s[0]).nbytes + 4)
+        nnz = ptr[:, -1].astype(np.int64)
+        tot_b = getattr(self, "_mesh_shard_bytes", None)
+        if tot_b is None or len(tot_b) != d:
+            tot_b = self._mesh_shard_bytes = np.zeros(d, np.int64)
+            self._mesh_shard_nnz = np.zeros(d, np.int64)
+        tot_b += per_bytes
+        self._mesh_shard_nnz += nnz
+        for s in range(d):
+            METRICS.set_gauge("mesh_shard_bytes", float(tot_b[s]),
+                              shard=s)
+        mean = float(self._mesh_shard_nnz.mean())
+        if mean > 0:
+            METRICS.set_gauge("mesh_shard_balance",
+                              float(self._mesh_shard_nnz.max()) / mean)
 
     # -- values -------------------------------------------------------------
     def value_col(self, pred: str, lang: str = "") -> ValueColumn | None:

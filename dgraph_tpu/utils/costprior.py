@@ -77,6 +77,11 @@ class CostPriorModel:
         self._shapes: dict[str, dict] = {}
         # lane → EMA of observed request µs (the admission fallback)
         self._lane_ema: dict[str, float] = {}
+        # execution route (mesh/device/numpy/...) → EMA of measured µs
+        # per 1k edges: the engine's route selector consults these to
+        # promote the mesh route below its static frontier threshold
+        # (engine/execute.py _mesh_promoted)
+        self._route_ema: dict[str, float] = {}
         # query-text hash → shape fingerprint, learned as requests
         # complete (admission predicts BEFORE parsing; the memo is how
         # a repeated template's shape is known pre-parse). Insertion
@@ -136,6 +141,22 @@ class CostPriorModel:
         for f, w in fit["coef"].items():
             us += w * float(features.get(f, 0))
         return max(us, 0.0)
+
+    # -- route costs (the expansion-path selector's prior) -------------------
+    def learn_route(self, path: str, us_per_kedge: float) -> None:
+        """Fold one expansion's measured µs-per-1k-edges into the
+        path's EMA (called from engine ops.expand on every route)."""
+        with self._lock:
+            ema = self._route_ema.get(path)
+            self._route_ema[path] = (
+                float(us_per_kedge) if ema is None
+                else ema + _EMA_ALPHA * (float(us_per_kedge) - ema))
+
+    def route_cost(self, path: str) -> float | None:
+        """Measured µs/1k-edges EMA for an execution route, or None
+        before any observation."""
+        with self._lock:
+            return self._route_ema.get(path)
 
     # -- learning ------------------------------------------------------------
     def learn(self, lane: str, text: str | None, shape: str | None,
@@ -240,7 +261,8 @@ class CostPriorModel:
             return {"version": 1,
                     "shapes": {s: dict(p)
                                for s, p in self._shapes.items()},
-                    "lane_ema": dict(self._lane_ema)}
+                    "lane_ema": dict(self._lane_ema),
+                    "route_ema": dict(self._route_ema)}
 
     def merge_state(self, state: dict) -> None:
         """Merge a persisted model (boot path): per shape, n-weighted
@@ -271,6 +293,10 @@ class CostPriorModel:
                 mine_v = self._lane_ema.get(lane)
                 self._lane_ema[lane] = (float(v) if mine_v is None
                                         else (mine_v + float(v)) / 2.0)
+            for path, v in state.get("route_ema", {}).items():
+                mine_v = self._route_ema.get(path)
+                self._route_ema[path] = (float(v) if mine_v is None
+                                         else (mine_v + float(v)) / 2.0)
 
     def save(self, path: str) -> None:
         with open(path, "w") as f:
@@ -302,6 +328,9 @@ class CostPriorModel:
                 "sample_floor": self.sample_floor,
                 "lane_ema_us": {ln: round(v, 1)
                                 for ln, v in self._lane_ema.items()},
+                "route_us_per_kedge": {p: round(v, 2)
+                                       for p, v in
+                                       self._route_ema.items()},
                 "error": {
                     "n": self._abs_err.count,
                     "abs_p50_us": self._abs_err.percentile(0.50),
@@ -319,6 +348,7 @@ class CostPriorModel:
         with self._lock:
             self._shapes.clear()
             self._lane_ema.clear()
+            self._route_ema.clear()
             self._text_shape.clear()
             self._abs_err = Digest()
             self._rel_err = Digest()

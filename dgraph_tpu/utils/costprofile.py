@@ -49,7 +49,8 @@ from dgraph_tpu.utils.metrics import MAX_LABEL_SETS, METRICS
 __all__ = ["FIELDS", "DIGEST_FIELDS", "FEATURE_FIELDS", "Digest",
            "Recorder", "Aggregator", "COSTS", "profile", "active",
            "note", "note_max", "add", "add_shape", "add_kernel",
-           "add_tablet_cost", "tablet_costs", "recent",
+           "add_tablet_cost", "tablet_costs",
+           "add_shard_cost", "shard_costs", "recent",
            "add_sink", "remove_sink", "set_enabled", "summary",
            "save", "load", "reset"]
 
@@ -90,6 +91,7 @@ FIELDS: dict[str, dict] = {
     "plan_cache_hit":    {"kind": "feature", "doc": "1 = batch plan memo hit"},
     "ell_cache_hit":     {"kind": "feature", "doc": "1 = every ELL build was a snapshot-cache hit"},
     "jit_cache_hits":    {"kind": "feature", "doc": "jit compile-cache hits during the request"},
+    "mesh_shards":       {"kind": "feature", "doc": "mesh shards engaged by the request's expansions (0 = no mesh route)"},
 }
 
 DIGEST_FIELDS = tuple(n for n, d in FIELDS.items() if d["kind"] == "cost")
@@ -399,6 +401,9 @@ COSTS = Aggregator()
 # expansions. Bounded metrics-style (cap + "other"); ships to Zero in
 # the health heartbeat so tablet moves prefer under-loaded groups.
 _TABLET_COSTS: dict[str, int] = {}
+# per-mesh-shard cost sums (same µs-equivalent scale; bounded the same
+# way) — the residency/balance signal for the sharded serving path
+_SHARD_COSTS: dict[str, int] = {}
 _TABLET_LOCK = locks.make_lock("costprofile.tablets")
 _RECENT: list = []            # ring of finished records (lock-guarded)
 _RECENT_LOCK = locks.make_lock("costprofile.recent")
@@ -530,6 +535,27 @@ def tablet_costs() -> dict[str, int]:
         return dict(_TABLET_COSTS)
 
 
+def add_shard_cost(shard, us) -> None:
+    """Charge `us` µs-equivalents of mesh work to one device shard —
+    the shard-keyed twin of add_tablet_cost: tablet sums drive Zero's
+    group placement, shard sums drive the MESH residency/balance view
+    (/debug/scheduler) so admission and placement see mesh work."""
+    if not _ENABLED:
+        return
+    key = str(shard)
+    with _TABLET_LOCK:
+        if key not in _SHARD_COSTS \
+                and len(_SHARD_COSTS) >= MAX_LABEL_SETS:
+            key = OVERFLOW_SHAPE
+        _SHARD_COSTS[key] = _SHARD_COSTS.get(key, 0) + int(us)
+
+
+def shard_costs() -> dict[str, int]:
+    """Per-mesh-shard cost sums since process start (scheduler view)."""
+    with _TABLET_LOCK:
+        return dict(_SHARD_COSTS)
+
+
 def recent(n: int = 100) -> list[dict]:
     with _RECENT_LOCK:
         return _RECENT[-n:]
@@ -567,4 +593,5 @@ def reset() -> None:
         _RECENT.clear()
     with _TABLET_LOCK:
         _TABLET_COSTS.clear()
+        _SHARD_COSTS.clear()
     del _SINKS[:]

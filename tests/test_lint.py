@@ -385,3 +385,50 @@ def test_r6_shape_and_len_branches_are_static():
            "    return x\n")
     a = scan("dgraph_tpu/ops/fake.py", src)
     assert "jit-purity" not in rules_of(a)
+
+
+# ---------------------------------------------------------------------------
+# R7 shard-map-compat
+
+def test_r7_flags_every_direct_spelling():
+    """Both historical spellings, as attribute references and as
+    imports, are findings anywhere outside the shim — the exact
+    regression that parked the whole parallel/ layer."""
+    src = ("import jax\n"
+           "fn = jax.shard_map(f, mesh=m, in_specs=s, out_specs=s)\n")
+    a = scan("dgraph_tpu/parallel/fake.py", src)
+    assert "shard-map-compat" in rules_of(a)
+
+    src = "from jax.experimental.shard_map import shard_map\n"
+    a = scan("dgraph_tpu/parallel/fake.py", src)
+    assert "shard-map-compat" in rules_of(a)
+
+    src = "from jax import shard_map\n"
+    a = scan("dgraph_tpu/engine/fake.py", src)
+    assert "shard-map-compat" in rules_of(a)
+
+    src = "import jax.experimental.shard_map as sm\n"
+    a = scan("bench.py", src)
+    assert "shard-map-compat" in rules_of(a)
+
+
+def test_r7_allows_the_shim_and_the_resolver_import():
+    # the shim itself is the one place allowed to touch the raw API
+    src = ("import jax\n"
+           "impl = getattr(jax, 'shard_map', None)\n"
+           "from jax.experimental.shard_map import shard_map\n")
+    a = scan("dgraph_tpu/utils/jaxcompat.py", src)
+    assert "shard-map-compat" not in rules_of(a)
+    # and everyone else importing THROUGH the shim is clean
+    src = ("from dgraph_tpu.utils.jaxcompat import shard_map\n"
+           "fn = shard_map(f, mesh=m, in_specs=s, out_specs=s)\n")
+    a = scan("dgraph_tpu/parallel/fake.py", src)
+    assert "shard-map-compat" not in rules_of(a)
+
+
+def test_r7_one_finding_per_line_not_per_attribute():
+    src = ("import jax\n"
+           "fn = jax.experimental.shard_map.shard_map(f)\n")
+    a = scan("dgraph_tpu/parallel/fake.py", src)
+    finds = [f for f in a.findings if f.rule == "shard-map-compat"]
+    assert len(finds) == 1

@@ -46,13 +46,19 @@ store = b.finalize()
 
 host = Engine(store, device_threshold=10**9)
 meshe = Engine(store, device_threshold=0, mesh=make_mesh())
-for q in (
-    '{ q(func: eq(name, "p7")) { name friend { name friend { name } } } }',
-    '{ q(func: uid(0x1)) @recurse(depth: 3, loop: false) { uid friend } }',
-    '{ q(func: has(friend), first: 5) { name count(friend) } }',
-):
-    a, b_ = host.query(q), meshe.query(q)
-    assert a == b_, (q, a, b_)
+try:
+    for q in (
+        '{ q(func: eq(name, "p7")) { name friend { name friend { name } } } }',
+        '{ q(func: uid(0x1)) @recurse(depth: 3, loop: false) { uid friend } }',
+        '{ q(func: has(friend), first: 5) { name count(friend) } }',
+    ):
+        a, b_ = host.query(q), meshe.query(q)
+        assert a == b_, (q, a, b_)
+except Exception as e:  # capability gate, see _run_two_process
+    if "Multiprocess computations aren't implemented" not in str(e):
+        raise
+    print(f"SKIP process={pid} multiprocess-cpu-unsupported", flush=True)
+    raise SystemExit(0)
 print(f"PASS process={pid}", flush=True)
 """
 
@@ -93,23 +99,34 @@ for d, dev in enumerate(mesh.devices.reshape(-1)):
     lptr = np.asarray(full.indptr_s[d])
     local[d] = (lptr, np.asarray(full.indices_s[d, :int(lptr[-1])]))
 del full
-srel = assemble_sharded_rel(mesh, n, local)
-assert not srel.indices_s.is_fully_addressable  # genuinely disjoint
+try:
+    # the assemble itself allgathers per-shard nnz, so the capability
+    # gate must cover it too, not just the hop launch below
+    srel = assemble_sharded_rel(mesh, n, local)
+    assert not srel.indices_s.is_fully_addressable  # genuinely disjoint
 
-# frontier spans rows owned by BOTH processes
-frontier = np.array(sorted({1, 5, n // 2 + 3, n - 7, n - 2}), np.int32)
-fr = ops.pad_to(frontier, 8)
-deg = (rel.indptr[frontier + 1] - rel.indptr[frontier]).astype(np.int64)
-edge_cap = 64
-while edge_cap < max(int(deg.sum()), 1):
-    edge_cap <<= 1
-nbrs_s, seg_s, pos_s, totals, max_e = matrix_hop(mesh, srel, fr, edge_cap)
-assert int(host_np(max_e)) <= edge_cap
+    # frontier spans rows owned by BOTH processes
+    frontier = np.array(sorted({1, 5, n // 2 + 3, n - 7, n - 2}),
+                        np.int32)
+    fr = ops.pad_to(frontier, 8)
+    deg = (rel.indptr[frontier + 1]
+           - rel.indptr[frontier]).astype(np.int64)
+    edge_cap = 64
+    while edge_cap < max(int(deg.sum()), 1):
+        edge_cap <<= 1
+    nbrs_s, seg_s, pos_s, totals, max_e = matrix_hop(mesh, srel, fr,
+                                                     edge_cap)
+    assert int(host_np(max_e)) <= edge_cap
 
-# host_np on SHARDED outputs: the process_allgather branch with
-# genuinely non-replicated data (each process held only its legs)
-nbrs_h, seg_h = host_np(nbrs_s), host_np(seg_s)
-totals_h = host_np(totals)
+    # host_np on SHARDED outputs: the process_allgather branch with
+    # genuinely non-replicated data (each process held only its legs)
+    nbrs_h, seg_h = host_np(nbrs_s), host_np(seg_s)
+    totals_h = host_np(totals)
+except Exception as e:  # capability gate, see _run_two_process
+    if "Multiprocess computations aren't implemented" not in str(e):
+        raise
+    print(f"SKIP process={pid} multiprocess-cpu-unsupported", flush=True)
+    raise SystemExit(0)
 
 parts = []
 for d in range(D):
@@ -148,6 +165,13 @@ def _run_two_process(tmp_path, script_text):
         outs.append(out)
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"process {i} failed:\n{out[-3000:]}"
+    if any("SKIP process=" in out for out in outs):
+        # this jaxlib's CPU backend refuses multi-process SPMD programs
+        # outright ("Multiprocess computations aren't implemented") —
+        # the shard_map layer is fine (the in-process virtual-device
+        # suites cover it); only the DCN leg needs a capable backend
+        pytest.skip("jaxlib CPU backend lacks multiprocess computations")
+    for i, out in enumerate(outs):
         assert f"PASS process={i}" in out
 
 
