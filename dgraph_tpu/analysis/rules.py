@@ -27,6 +27,11 @@ R7 shard-map-compat      `shard_map` resolves ONLY through
                          elsewhere re-pin the mesh layer to one jax
                          version (the exact regression that parked the
                          whole parallel/ layer in the failure set).
+R8 atomic-write          durable files under store/ (and
+                         server/backup.py) land via tmp + fsync +
+                         os.replace — a bare `open(..., "w"/"wb")`
+                         there can tear under a kill where a reader
+                         expects a whole file (ISSUE-11).
 """
 
 from __future__ import annotations
@@ -36,7 +41,8 @@ import ast
 from dgraph_tpu.analysis import FileContext, Finding, Rule
 
 __all__ = ["default_rules", "HotLoopCheckpoint", "DirectIO", "WallClock",
-           "RetryDeadline", "MetricDocs", "JitPurity", "ShardMapCompat"]
+           "RetryDeadline", "MetricDocs", "JitPurity", "ShardMapCompat",
+           "AtomicWrite"]
 
 
 def _dotted(node: ast.AST) -> str:
@@ -448,7 +454,68 @@ class ShardMapCompat(Rule):
         return out
 
 
+# ---------------------------------------------------------------------------
+class AtomicWrite(Rule):
+    name = "atomic-write"
+    doc = ("persistence-layer files (store/, server/backup.py) must be "
+           "written via the tmp+fsync+os.replace pattern "
+           "(vault.atomic_write / write_bytes, or a function that "
+           "itself fsyncs and replaces) — a kill mid-`open(..., 'w')` "
+           "leaves a torn file where recovery expects a whole one")
+
+    SCOPES = ("dgraph_tpu/store/",)
+
+    def applies(self, rel: str) -> bool:
+        return (rel.startswith(self.SCOPES)
+                or rel == "dgraph_tpu/server/backup.py")
+
+    @staticmethod
+    def _atomic_spans(tree: ast.Module) -> list[tuple[int, int]]:
+        """Line spans of functions that ARE the atomic pattern: they
+        call both os.fsync and os.replace themselves, so their write
+        handle is the tmp side of a replace."""
+        spans = []
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            calls = {_dotted(n.func) for n in ast.walk(node)
+                     if isinstance(n, ast.Call)}
+            if "os.replace" in calls and "os.fsync" in calls:
+                spans.append((node.lineno,
+                              getattr(node, "end_lineno", node.lineno)))
+        return spans
+
+    def check_file(self, ctx: FileContext) -> list[Finding]:
+        out = []
+        spans = self._atomic_spans(ctx.tree)
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call)
+                    and _dotted(node.func) == "open"):
+                continue
+            mode = None
+            if len(node.args) >= 2 and isinstance(node.args[1],
+                                                  ast.Constant):
+                mode = node.args[1].value
+            for kw in node.keywords:
+                if kw.arg == "mode" and isinstance(kw.value,
+                                                   ast.Constant):
+                    mode = kw.value.value
+            if not (isinstance(mode, str) and mode.startswith("w")):
+                continue  # reads/appends ("r", "rb", "ab", "r+b") pass
+            if any(lo <= node.lineno <= hi for lo, hi in spans):
+                continue
+            out.append(Finding(
+                self.name, ctx.rel, node.lineno,
+                f"non-atomic file write open(..., {mode!r}) in the "
+                f"persistence layer — route it through "
+                f"vault.atomic_write/write_bytes (tmp+fsync+"
+                f"os.replace), or waive with the reason a torn file "
+                f"is safe here"))
+        return out
+
+
 def default_rules() -> list[Rule]:
     return [HotLoopCheckpoint(), DirectIO(), WallClock(),
             RetryDeadline(), MetricDocs(), JitPurity(),
-            ShardMapCompat()]
+            ShardMapCompat(), AtomicWrite()]

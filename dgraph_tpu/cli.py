@@ -68,6 +68,7 @@ def cmd_alpha(args) -> int:
         "mesh_devices": args.mesh_devices,
         "encryption_key_file": args.encryption_key_file,
         "encryption_strict": args.encryption_strict or None,
+        "memory_budget_mb": args.memory_budget_mb,
         "slow_query_ms": args.slow_query_ms,
         "trace_dir": args.trace_dir,
         "trace_export": args.trace_export,
@@ -386,9 +387,17 @@ def cmd_live(args) -> int:
 def cmd_backup(args) -> int:
     """Binary backup: full or incremental-since-last (reference:
     ee/backup; SURVEY §2.5). --memory_budget_mb opens the source
-    out-of-core so a store larger than RAM backs up streamed."""
-    from dgraph_tpu.server.backup import backup
+    out-of-core so a store larger than RAM backs up streamed.
+    `dgraph_tpu backup verify --dest D` walks the whole chain offline
+    (manifests, per-file digests, delta record counts, contiguity) and
+    exits non-zero on any integrity error."""
     xlog.setup(args.log_level)
+    if args.verb == "verify":
+        from dgraph_tpu.server.backup import verify_chain
+        report = verify_chain(args.dest)
+        print(json.dumps(report, indent=1))
+        return 0 if report["ok"] else 1
+    from dgraph_tpu.server.backup import backup
     m = backup(args.p, args.dest, force_full=args.full,
                memory_budget=(args.memory_budget_mb << 20)
                if args.memory_budget_mb else None)
@@ -398,10 +407,15 @@ def cmd_backup(args) -> int:
 
 def cmd_restore(args) -> int:
     """Rebuild a posting dir from a backup series (reference: ee
-    restore)."""
+    restore). Crash-safe + resumable: a kill leaves the previous store
+    serveable, a re-run resumes from the last verified tablet;
+    --memory_budget_mb streams the fold so a chain bigger than RAM
+    restores under budget."""
     from dgraph_tpu.server.backup import restore
     xlog.setup(args.log_level)
-    ts = restore(args.dest, args.p)
+    ts = restore(args.dest, args.p,
+                 memory_budget=(args.memory_budget_mb << 20)
+                 if args.memory_budget_mb else None)
     print(json.dumps({"restored_max_ts": ts, "p_dir": args.p}))
     return 0
 
@@ -488,7 +502,7 @@ def main(argv=None) -> int:
                    help="seconds between zero liveness heartbeats")
     p.add_argument("--group", type=int, default=0,
                    help="raft-group analog to join (0 = zero picks)")
-    p.add_argument("--memory_budget_mb", type=int, default=0,
+    p.add_argument("--memory_budget_mb", type=int, default=None,
                    help="out-of-core mode: fault predicate tablets from "
                         "the checkpoint on demand, LRU-evict above this "
                         "many MB resident (0 = fully resident)")
@@ -609,6 +623,10 @@ def main(argv=None) -> int:
     p.set_defaults(fn=cmd_live)
 
     p = sub.add_parser("backup", help="binary backup (full/incremental)", parents=[enc])
+    p.add_argument("verb", nargs="?", choices=["verify"], default=None,
+                   help="'verify' walks the chain at --dest offline: "
+                        "manifests, per-file digests, delta record "
+                        "counts, contiguity; exit 1 on any error")
     p.add_argument("--p", default="p", help="posting dir to back up")
     p.add_argument("--dest", required=True, help="backup series dir")
     p.add_argument("--full", action="store_true",
@@ -623,6 +641,11 @@ def main(argv=None) -> int:
     p = sub.add_parser("restore", help="rebuild a posting dir from backups", parents=[enc])
     p.add_argument("--dest", required=True, help="backup series dir")
     p.add_argument("--p", required=True, help="posting dir to write")
+    p.add_argument("--memory_budget_mb", type=int, default=0,
+                   help="stream the restore fold tablet-at-a-time "
+                        "under this budget — a backup chain bigger "
+                        "than RAM restores without materializing "
+                        "(0 = fully resident)")
     p.add_argument("--log_level", default="info")
     p.set_defaults(fn=cmd_restore)
 

@@ -173,12 +173,28 @@ class FaultSchedule:
     FetchLog/tablet_snapshot and serve again. The harness performs both
     through `crash_cb(src, up)`; crash events count
     `peer_crashes_total`. Generation pairs them: a crash on an
-    already-down node regenerates as its restart."""
+    already-down node regenerates as its restart.
+
+    `disk=True` adds DISK-FAULT events (ISSUE 11 — the PR-1/PR-5
+    fault-fuzzing lineage extended from the network to the disk):
+    node `src`'s next durable write is damaged through the `vault` IO
+    hook (store/vault.py `set_io_fault`) — `disk_bitflip` corrupts the
+    written bytes (a bad sector under a WAL record: detected by the
+    frame CRC as a torn tail on restart, healed via FetchLog),
+    `disk_trunc` cuts the write short (a torn sector), and
+    `disk_enospc` raises ENOSPC (the write refuses BEFORE any ack —
+    the commit fails retryably, never applies unlogged). The harness
+    performs the injection + any crash-restart through
+    `disk_cb(src, kind)`; events count `fault_disk_events_total{kind=}`.
+    Off by default — historical (flags, seed) schedules replay
+    byte-identically (the golden-schedule tests pin this); armed, the
+    extended slice re-splits equally with "disk" LAST in the fixed
+    family order."""
 
     def __init__(self, seed: int, n_nodes: int, steps: int = 8,
                  max_delay_s: float = 0.03, wal_trunc: bool = False,
                  deadline: bool = False, crash: bool = False,
-                 clock_free: bool = False):
+                 clock_free: bool = False, disk: bool = False):
         import random
         self.seed = seed
         self.n_nodes = n_nodes
@@ -195,7 +211,8 @@ class FaultSchedule:
         self.events: list[tuple[str, int, int, float]] = []
         families = [f for f, on in (("wal_trunc", wal_trunc),
                                     ("deadline", deadline),
-                                    ("crash", crash)) if on]
+                                    ("crash", crash),
+                                    ("disk", disk)) if on]
         gen_down: set[int] = set()  # crash/restart pairing at generation
         for _ in range(steps):
             src, dst = rng.choice(links)
@@ -224,6 +241,15 @@ class FaultSchedule:
                 else:
                     self.events.append(("crash", src, dst, 0.0))
                     gen_down.add(src)
+            elif extended == "disk":
+                # sub-kind draw happens only inside the disk branch, so
+                # schedules with the flag off never consume it
+                kind = rng.choice(("bitflip", "trunc", "enospc"))
+                self.events.append((f"disk_{kind}", src, dst, 0.0))
+                if kind != "enospc":
+                    # bitflip/trunc damage durable state; the harness
+                    # crash-restarts the node so recovery runs
+                    gen_down.discard(src)
             elif r < 0.40:
                 self.events.append(("drop", src, dst, 0.0))
             elif r < 0.70:
@@ -239,16 +265,24 @@ class FaultSchedule:
 
     def apply_event(self, ev: tuple[str, int, int, float],
                     faulty_groups, addrs, wal_trunc_cb=None,
-                    deadline_cb=None, crash_cb=None) -> None:
+                    deadline_cb=None, crash_cb=None,
+                    disk_cb=None) -> None:
         """Apply one event; `faulty_groups[i]` is node i's FaultyGroups
         wrapper, `addrs[i]` its address. `wal_trunc_cb(src)` performs a
         crash-restart-with-torn-tail of node src; `deadline_cb(src,
         budget_s)` runs the harness's tight-budget read on node src;
         `crash_cb(src, up)` kills (up=False) or rebuilds-from-WAL
-        (up=True) node src (any callback is skipped when the harness
-        passes None)."""
+        (up=True) node src; `disk_cb(src, kind)` injects one
+        bitflip/trunc/enospc write fault on node src through the vault
+        IO hook (any callback is skipped when the harness passes
+        None)."""
         from dgraph_tpu.utils.metrics import METRICS
         op, src, dst, secs = ev
+        if op.startswith("disk_"):
+            if disk_cb is not None and src not in self.crashed:
+                METRICS.inc("fault_disk_events_total", kind=op[5:])
+                disk_cb(src, op[5:])
+            return
         if op == "deadline":
             if deadline_cb is not None:
                 deadline_cb(src, secs)

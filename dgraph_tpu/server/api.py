@@ -173,6 +173,12 @@ class Alpha:
         wal_path = os.path.join(p_dir, "wal.log")
         alpha = cls(base=base, device_threshold=device_threshold,
                     base_ts=base_ts, mesh=mesh)
+        if base is not None and hasattr(base.preds, "heal_cb"):
+            # out-of-core: a tablet fault that fails its integrity
+            # check (StorageCorruption) heals from a group replica once
+            # this alpha joins a cluster; single-node it stays a typed
+            # refusal naming the file
+            base.preds.heal_cb = alpha._heal_corrupt_tablet
         max_ts, max_uid = alpha.attach_wal(wal_path, sync=sync)
         alpha.oracle.bump_ts(max_ts)
         if max_uid:
@@ -1847,6 +1853,35 @@ class Alpha:
         self.groups.zero.report_health(doc)
         return doc
 
+    def _heal_corrupt_tablet(self, pred: str):
+        """Pull a fresh copy of an OWNED tablet from a group replica
+        after its on-disk segments failed an integrity check — the
+        disk-side twin of the PR-1 FetchLog heal. Iterates replicas in
+        PeerTable order (open breakers fail fast); returns the unpacked
+        PredicateData or None when no replica can serve it (the caller
+        then raises the original StorageCorruption)."""
+        if self.groups is None:
+            return None
+        import grpc as _grpc
+
+        from dgraph_tpu.cluster.tablet import unpack_tablet
+        from dgraph_tpu.utils import logging as xlog
+        replicas = [a for a in self.groups.group_addrs(self.groups.gid)
+                    if a != self.groups.my_addr]
+        for addr in replicas:
+            try:
+                blob, _v = self.groups.pool(addr).tablet_snapshot(
+                    pred, self.mvcc.base_ts)
+            except _grpc.RpcError:
+                continue
+            if blob:
+                xlog.get("alpha").warning(
+                    "healed corrupt tablet %s from replica %s "
+                    "(on-disk copy rewrites at the next checkpoint)",
+                    pred, addr)
+                return unpack_tablet(blob, pred, self.mvcc.schema)
+        return None
+
     # -- maintenance --------------------------------------------------------
     def _maybe_gc(self) -> None:
         with self._state_lock:
@@ -1858,6 +1893,13 @@ class Alpha:
         if reads_floor is not None:
             floor = min(floor, reads_floor)
         self.mvcc.gc(floor)
+        # superseded on-disk ckpt dirs whose last referencing fold the
+        # gc above just dropped are reclaimable NOW (PR-3 deferred this
+        # to the next checkpoint, which may never come)
+        from dgraph_tpu.store import stream
+        lazy = stream.lazy_preds(self.mvcc.base)
+        if lazy is not None:
+            stream.gc_superseded(lazy.root_dir, self.mvcc)
 
 
 @dataclass

@@ -373,6 +373,14 @@ def make_http_server(alpha: Alpha, addr: str = "127.0.0.1",
             endpoints share the Alter ACL bar)."""
             if alpha.acl is not None:
                 alpha.acl.check_alter(acl_user)
+            if self.path.startswith("/admin/backup/verify"):
+                # offline chain integrity walk (no scheduler needed —
+                # read-only): manifests, per-file digests, delta record
+                # counts, contiguity; errors name exact files
+                from dgraph_tpu.server.backup import verify_chain
+                req = json.loads(self._body().decode() or "{}")
+                self._send(200, {"data": verify_chain(req["dest"])})
+                return
             if alpha.maintenance is None:
                 self._send(400, {"errors": [{
                     "message": "maintenance scheduler not attached"}]})

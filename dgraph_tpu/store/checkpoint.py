@@ -32,8 +32,9 @@ from dgraph_tpu.store.store import (
 # vs WAL replay) recover identical types
 from dgraph_tpu.store.wal import dec_scalar, enc_scalar
 
-FORMAT_VERSION = 2  # v2: facet persistence (<slug>.facets.json)
-MIN_FORMAT_VERSION = 1  # v1 checkpoints load (they predate facet storage)
+FORMAT_VERSION = 3  # v3: per-file crc32 digests (WAL-style integrity)
+MIN_FORMAT_VERSION = 1  # v1/v2 checkpoints load (no digests recorded —
+#                         integrity checks are skipped for them)
 
 
 def _slug(pred: str) -> str:
@@ -42,16 +43,16 @@ def _slug(pred: str) -> str:
     return f"{safe[:40]}.{h}"
 
 
-def save_uids(uids: np.ndarray, dirname: str, compress: bool) -> None:
+def save_uids(uids: np.ndarray, dirname: str, compress: bool) -> int:
     """Write the uid vocabulary block (`compress` delta-varint packs it
     via native/codec.cpp — the role the reference's codec.UidPack plays
-    for posting storage)."""
+    for posting storage). Returns the block's on-disk crc32 (recorded
+    as `uids_crc` in the manifest and verified on every load)."""
     if compress:
         from dgraph_tpu import native
-        vault.write_bytes(os.path.join(dirname, "uids.duc"),
-                          native.codec_encode(uids))
-    else:
-        vault.save_np(os.path.join(dirname, "uids.npy"), uids)
+        return vault.write_bytes(os.path.join(dirname, "uids.duc"),
+                                 native.codec_encode(uids))
+    return vault.save_np(os.path.join(dirname, "uids.npy"), uids)
 
 
 def save_predicate(dirname: str, pred: str, pd) -> dict:
@@ -70,26 +71,27 @@ def save_predicate(dirname: str, pred: str, pd) -> dict:
     # nbytes: size hint for out-of-core eviction accounting and the
     # tablet-size heartbeat (neither may fault the tablet in)
     meta = {"slug": slug, "langs": sorted(pd.vals), "nbytes": nbytes}
+    # per-file crc32 of the on-disk bytes: the tablet's integrity
+    # digests, verified on every fault/load/restore of this segment set
+    crcs: dict[str, int] = {}
     for side, rel in (("fwd", pd.fwd), ("rev", pd.rev)):
         if rel is not None:
-            vault.save_np(
-                os.path.join(dirname, f"{slug}.{side}.indptr.npy"),
-                rel.indptr)
-            vault.save_np(
-                os.path.join(dirname, f"{slug}.{side}.indices.npy"),
-                rel.indices)
+            for part, arr in (("indptr", rel.indptr),
+                              ("indices", rel.indices)):
+                fname = f"{slug}.{side}.{part}.npy"
+                crcs[fname] = vault.save_np(
+                    os.path.join(dirname, fname), arr)
             meta[side] = True
     for lang, col in pd.vals.items():
         lslug = lang or "_"
-        vault.save_np(
-            os.path.join(dirname, f"{slug}.val.{lslug}.subj.npy"),
-            col.subj)
+        fname = f"{slug}.val.{lslug}.subj.npy"
+        crcs[fname] = vault.save_np(os.path.join(dirname, fname),
+                                    col.subj)
         vals = col.vals
         if vals.dtype == object:  # strings: store as fixed-width UTF
             vals = np.array([str(v) for v in vals], dtype=np.str_)
-        vault.save_np(
-            os.path.join(dirname, f"{slug}.val.{lslug}.vals.npy"),
-            vals)
+        fname = f"{slug}.val.{lslug}.vals.npy"
+        crcs[fname] = vault.save_np(os.path.join(dirname, fname), vals)
     if pd.efacets or pd.vfacets:
         # facets ride in a JSON sidecar (they are sparse; the reference
         # persists them inside each posting — same durability contract)
@@ -101,9 +103,11 @@ def save_predicate(dirname: str, pred: str, pd) -> dict:
                             for r, v in m.items()}
                         for k, m in pd.vfacets.items()},
         }
-        vault.write_bytes(os.path.join(dirname, f"{slug}.facets.json"),
-                          json.dumps(fdoc).encode())
+        fname = f"{slug}.facets.json"
+        crcs[fname] = vault.write_bytes(os.path.join(dirname, fname),
+                                        json.dumps(fdoc).encode())
         meta["facets"] = True
+    meta["crc"] = crcs
     return meta
 
 
@@ -111,15 +115,17 @@ def write_manifest(dirname: str, manifest: dict) -> None:
     """Atomically land the manifest — the commit point of a snapshot.
     The manifest is encrypted too: it carries the schema text and
     predicate names (the reference likewise keeps schema inside the
-    encrypted store, exposing only sizes/timestamps in plaintext)."""
-    tmp = os.path.join(dirname, "manifest.json.tmp")
-    vault.write_bytes(tmp, json.dumps(manifest, indent=1).encode())
-    os.replace(tmp, os.path.join(dirname, "manifest.json"))
+    encrypted store, exposing only sizes/timestamps in plaintext).
+    vault.write_bytes is tmp+fsync+os.replace, so a kill mid-write
+    leaves the previous manifest (or none) — never a torn one."""
+    vault.write_bytes(os.path.join(dirname, "manifest.json"),
+                      json.dumps(manifest, indent=1).encode())
 
 
 def manifest_doc(n_nodes: int, schema_text: str, preds_meta: dict,
-                 base_ts: int, compress: bool) -> dict:
-    return {
+                 base_ts: int, compress: bool,
+                 uids_crc: int | None = None) -> dict:
+    doc = {
         "format_version": FORMAT_VERSION,
         "base_ts": base_ts,
         "n_nodes": n_nodes,
@@ -127,6 +133,9 @@ def manifest_doc(n_nodes: int, schema_text: str, preds_meta: dict,
         "schema": schema_text,
         "predicates": preds_meta,
     }
+    if uids_crc is not None:
+        doc["uids_crc"] = uids_crc
+    return doc
 
 
 def save(store: Store, dirname: str, base_ts: int = 0,
@@ -141,13 +150,13 @@ def save(store: Store, dirname: str, base_ts: int = 0,
     if compress is None:
         compress = native.HAVE_NATIVE
     os.makedirs(dirname, exist_ok=True)
-    save_uids(store.uids, dirname, compress)
+    uids_crc = save_uids(store.uids, dirname, compress)
     preds_meta = {}
     for pred, pd in store.preds.items():
         preds_meta[pred] = save_predicate(dirname, pred, pd)
     write_manifest(dirname, manifest_doc(
         store.n_nodes, store.schema.to_text(), preds_meta, base_ts,
-        compress))
+        compress, uids_crc=uids_crc))
 
 
 def resolve(dirname: str) -> str:
@@ -212,10 +221,18 @@ def save_versioned(store: Store, dirname: str, base_ts: int = 0) -> None:
 
 
 def read_manifest(dirname: str) -> tuple[dict, str]:
-    """(manifest, resolved dir) with the format gate applied."""
+    """(manifest, resolved dir) with the format gate applied. A
+    manifest that won't decode (bit-flip, truncation, tamper) raises a
+    typed StorageCorruption naming the file."""
     dirname = resolve(dirname)
-    manifest = json.loads(
-        vault.read_bytes(os.path.join(dirname, "manifest.json")))
+    mp = os.path.join(dirname, "manifest.json")
+    try:
+        manifest = json.loads(vault.read_bytes(mp))
+    except (ValueError, vault.VaultError) as e:
+        raise vault.corruption(mp, kind="manifest", detail=str(e)) from e
+    if not isinstance(manifest, dict) or "format_version" not in manifest:
+        raise vault.corruption(mp, kind="manifest",
+                               detail="not a manifest document")
     if not (MIN_FORMAT_VERSION <= manifest["format_version"]
             <= FORMAT_VERSION):
         raise ValueError(
@@ -225,12 +242,18 @@ def read_manifest(dirname: str) -> tuple[dict, str]:
 
 
 def load_uids(dirname: str, manifest: dict) -> np.ndarray:
+    crc = manifest.get("uids_crc")
     if manifest.get("uids_codec"):
         from dgraph_tpu import native
-        return native.codec_decode(
-            vault.read_bytes(os.path.join(dirname, "uids.duc")),
-            manifest["n_nodes"])
-    return vault.load_np(os.path.join(dirname, "uids.npy"))
+        raw = vault.read_bytes(os.path.join(dirname, "uids.duc"),
+                               crc=crc, kind="uids")
+        try:
+            return native.codec_decode(raw, manifest["n_nodes"])
+        except Exception as e:  # undecodable varint stream
+            raise vault.corruption(os.path.join(dirname, "uids.duc"),
+                                   kind="uids", detail=str(e)) from e
+    return vault.load_np(os.path.join(dirname, "uids.npy"),
+                         crc=crc, kind="uids")
 
 
 def load_predicate(dirname: str, pred: str, meta: dict,
@@ -239,19 +262,21 @@ def load_predicate(dirname: str, pred: str, meta: dict,
     out-of-core store faults in on first touch (store/outofcore.py) and
     the loop body of a full load()."""
     slug = meta["slug"]
+    crcs = meta.get("crc", {})  # absent on pre-v3 snapshots
+
+    def _load(fname):
+        return vault.load_np(os.path.join(dirname, fname),
+                             crc=crcs.get(fname), kind="segment")
+
     pd = PredicateData(schema=schema.get(pred))
     for side in ("fwd", "rev"):
         if meta.get(side):
-            indptr = vault.load_np(
-                os.path.join(dirname, f"{slug}.{side}.indptr.npy"))
-            indices = vault.load_np(
-                os.path.join(dirname, f"{slug}.{side}.indices.npy"))
+            indptr = _load(f"{slug}.{side}.indptr.npy")
+            indices = _load(f"{slug}.{side}.indices.npy")
             setattr(pd, side, EdgeRel(indptr=indptr, indices=indices))
     for lang in meta["langs"]:
         lslug = lang or "_"
-        vals = vault.load_np(
-            os.path.join(dirname, f"{slug}.val.{lslug}.vals.npy"),
-            allow_pickle=False)
+        vals = _load(f"{slug}.val.{lslug}.vals.npy")
         if vals.dtype.kind == "U":  # restore string columns to object
             vals = vals.astype(object)
         ps = schema.get(pred)
@@ -262,12 +287,17 @@ def load_predicate(dirname: str, pred: str, meta: dict,
             out[:] = [parse_geo(v) for v in vals]
             vals = out
         pd.vals[lang] = ValueColumn(
-            subj=vault.load_np(
-                os.path.join(dirname, f"{slug}.val.{lslug}.subj.npy")),
+            subj=_load(f"{slug}.val.{lslug}.subj.npy"),
             vals=vals)
     if meta.get("facets"):
-        fdoc = json.loads(vault.read_bytes(
-            os.path.join(dirname, f"{slug}.facets.json")))
+        fname = f"{slug}.facets.json"
+        try:
+            fdoc = json.loads(vault.read_bytes(
+                os.path.join(dirname, fname),
+                crc=crcs.get(fname), kind="segment"))
+        except ValueError as e:
+            raise vault.corruption(os.path.join(dirname, fname),
+                                   kind="segment", detail=str(e)) from e
         for k, col in fdoc.get("efacets", {}).items():
             vals = np.empty(len(col["vals"]), dtype=object)
             vals[:] = [dec_scalar(v) for v in col["vals"]]
@@ -277,6 +307,46 @@ def load_predicate(dirname: str, pred: str, meta: dict,
             pd.vfacets[k] = {int(r): dec_scalar(v)
                              for r, v in m.items()}
     return pd
+
+
+def verify_snapshot(dirname: str) -> list[dict]:
+    """Offline integrity walk of one snapshot dir: every file with a
+    recorded digest is re-read raw and crc-checked WITHOUT decoding
+    arrays (cheap — one sequential read per file). Returns a list of
+    {"file", "kind", "detail"} problems, empty when clean. A manifest
+    that won't decode raises StorageCorruption (there is nothing to
+    walk without it). Pre-v3 snapshots (no digests) verify vacuously —
+    reported as a single `undigested` advisory entry."""
+    manifest, dirname = read_manifest(dirname)
+    problems: list[dict] = []
+
+    def check(fname, crc, kind):
+        path = os.path.join(dirname, fname)
+        if not os.path.exists(path):
+            problems.append({"file": path, "kind": kind,
+                             "detail": "missing"})
+        elif crc is not None and not vault.file_crc_ok(path, crc):
+            problems.append({"file": path, "kind": kind,
+                             "detail": "crc mismatch"})
+
+    uids_crc = manifest.get("uids_crc")
+    uids_file = ("uids.duc" if manifest.get("uids_codec")
+                 else "uids.npy")
+    check(uids_file, uids_crc, "uids")
+    digested = uids_crc is not None
+    for _pred, meta in manifest["predicates"].items():
+        crcs = meta.get("crc")
+        if crcs is None:
+            continue
+        digested = True
+        for fname, crc in crcs.items():
+            check(fname, crc, "segment")
+    if not digested and manifest["predicates"]:
+        problems.append({"file": os.path.join(dirname, "manifest.json"),
+                         "kind": "undigested",
+                         "detail": "pre-v3 snapshot carries no digests "
+                                   "(advisory; re-checkpoint to add)"})
+    return problems
 
 
 def load(dirname: str) -> tuple[Store, int]:

@@ -22,6 +22,7 @@ from dataclasses import dataclass, field
 
 from dgraph_tpu.store.schema import Schema
 from dgraph_tpu.utils import locks
+from dgraph_tpu.utils.metrics import METRICS
 from dgraph_tpu.store.store import TYPE_PRED, Store, StoreBuilder
 from dgraph_tpu.store.types import Kind
 
@@ -121,6 +122,119 @@ class Mutation:
 class _Layer:
     commit_ts: int
     mut: Mutation
+
+
+def fold_vocab(base: Store, pending) -> "np.ndarray":
+    """The full-fold uid vocabulary: base vocab ∪ every uid the pending
+    layers mention — O(nodes), resident by the out-of-core contract
+    (the uid dictionary never pages out). Shared by the streaming fold
+    writer (store/stream.py) and the lazily-folding read view, so every
+    per-tablet materialization pins the SAME dense rank space."""
+    import numpy as np
+    extra: set[int] = set()
+    for layer in pending:
+        extra.update(layer.mut.all_uids())
+    if not extra:
+        return base.uids
+    return np.union1d(base.uids,
+                      np.array(sorted(extra), np.int64)).astype(np.int64)
+
+
+def fold_preds(base: Store, pending) -> list[str]:
+    """Stable order over every tablet a fold must visit: base tablets
+    plus predicates the deltas introduce."""
+    names = set(base.preds.keys())
+    for layer in pending:
+        m = layer.mut
+        for e in m.edge_sets + m.edge_dels:
+            names.add(e[1])
+        for v in m.val_sets + m.val_dels:
+            names.add(v[1])
+    return sorted(names)
+
+
+class _LazyFoldPreds:
+    """Predicate mapping of a LAZILY-FOLDING read view over an
+    out-of-core base: each tablet materializes (base tablet + pending
+    delta layers, vocabulary pinned to the full-fold union) on first
+    touch, through the same `_materialize(only=)` path the streaming
+    fold writer uses — per-tablet content is bit-identical to the slice
+    of a full materialize. A mutation-bearing read above the newest
+    fold point therefore faults in only the tablets the query touches
+    instead of the whole store (the second PR-3 in-core cliff). Base
+    tablets this view itself faulted are released after folding, so the
+    serving budget holds; folded tablets are retained on the view (it
+    lives in the MVCC view cache, bounded by _VIEW_CACHE)."""
+
+    def __init__(self, base: Store, pending, schema, vocab):
+        self._base = base
+        self._pending = pending
+        self._schema = schema
+        self._vocab = vocab
+        self._names = set(fold_preds(base, pending))
+        self._done: dict[str, object] = {}
+        self._lock = locks.make_lock("mvcc.lazyview")
+
+    def size_hints(self) -> dict:
+        """Delegate to the base checkpoint's manifest sizes (the
+        tablet-size heartbeat must not fold the view in); pending-layer
+        growth is below the hint's own accuracy."""
+        hints = getattr(self._base.preds, "size_hints", None)
+        return hints() if hints is not None else {}
+
+    # -- mapping surface the engine uses (mirrors outofcore.LazyPreds) --
+    def get(self, pred, default=None):
+        if pred not in self._names:
+            return default
+        with self._lock:
+            if pred in self._done:
+                pd = self._done[pred]
+                return pd if pd is not None else default
+        pd = self._fold(pred)
+        with self._lock:
+            self._done.setdefault(pred, pd)
+            pd = self._done[pred]
+        return pd if pd is not None else default
+
+    def _fold(self, pred):
+        from dgraph_tpu.store.outofcore import LazyPreds
+        lazy = (self._base.preds
+                if isinstance(self._base.preds, LazyPreds) else None)
+        was_resident = lazy.is_resident(pred) if lazy is not None else True
+        folded = _materialize(self._base, self._pending,
+                              schema=self._schema, only={pred},
+                              vocab=self._vocab)
+        if lazy is not None and not was_resident:
+            lazy.release(pred)
+        METRICS.inc("read_view_lazy_tablets_total")
+        return folded.preds.get(pred)
+
+    def __getitem__(self, pred):
+        pd = self.get(pred)
+        if pd is None:
+            raise KeyError(pred)
+        return pd
+
+    def __contains__(self, pred) -> bool:
+        return pred in self._names
+
+    def __iter__(self):
+        return iter(sorted(self._names))
+
+    def __len__(self) -> int:
+        return len(self._names)
+
+    def keys(self):
+        return sorted(self._names)
+
+    def items(self):
+        """Folds EVERY tablet — debug/full-materialize paths only; the
+        serving path uses get()/[] one tablet at a time."""
+        return [(p, self[p]) for p in sorted(self._names)
+                if self.get(p) is not None]
+
+    def values(self):
+        return [pd for _p, pd in self.items()]
 
 
 class MVCCStore:
@@ -229,11 +343,28 @@ class MVCCStore:
             key = (fold_ts, tuple(l.commit_ts for l in pending))
             view = self._views.get(key)
             if view is None:
-                view = _materialize(fold_store, pending)
+                view = self._make_view(fold_store, pending)
                 self._views[key] = view
                 while len(self._views) > _VIEW_CACHE:
                     self._views.pop(next(iter(self._views)))
             return view
+
+    @staticmethod
+    def _make_view(fold_store: Store, pending) -> Store:
+        """A read view over (fold point + pending layers). In-core:
+        the eager full materialize (unchanged). Out-of-core: a
+        LAZILY-FOLDING view — only the tablets a query touches
+        materialize (`_materialize(only=)` with the fold vocabulary
+        pinned), so a mutation-bearing read above the newest fold point
+        no longer faults the whole store into RAM."""
+        from dgraph_tpu.store.outofcore import LazyPreds
+        if not isinstance(fold_store.preds, LazyPreds):
+            return _materialize(fold_store, pending)
+        vocab = fold_vocab(fold_store, pending)
+        schema = fold_store.schema.clone()
+        return Store(uids=vocab, schema=schema,
+                     preds=_LazyFoldPreds(fold_store, pending, schema,
+                                          vocab))
 
     def _fold_at(self, ts: int) -> tuple[int, Store]:
         for fold_ts, store in reversed(self._history):

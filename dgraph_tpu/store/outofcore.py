@@ -36,8 +36,9 @@ from collections import OrderedDict
 
 import numpy as np
 
-from dgraph_tpu.store import checkpoint
+from dgraph_tpu.store import checkpoint, vault
 from dgraph_tpu.utils import locks
+from dgraph_tpu.utils.metrics import METRICS
 from dgraph_tpu.store.schema import parse_schema
 from dgraph_tpu.store.store import PredicateData, Store, build_indexes
 
@@ -92,6 +93,13 @@ class LazyPreds:
         self.faults = 0       # tablets loaded from disk
         self.evictions = 0    # tablets dropped under budget pressure
         self.releases = 0     # tablets dropped by a streaming pass
+        # corruption-heal hook (clustered Alpha): called with the
+        # predicate when a fault fails its integrity check; returns a
+        # replacement PredicateData pulled from a group replica
+        # (TabletSnapshot + PeerTable failover) or None to refuse.
+        # The healed copy serves in memory; the corrupt on-disk segment
+        # is rewritten by the next checkpoint/fold.
+        self.heal_cb = None
 
     def size_hints(self) -> dict[str, int]:
         """Per-tablet byte sizes from the manifest, WITHOUT faulting —
@@ -185,9 +193,20 @@ class LazyPreds:
             # loop: usually resident now; retry covers an eviction race
 
         try:
-            pd = checkpoint.load_predicate(self._dir, pred, meta,
-                                           self._schema)
-            build_indexes({pred: pd})
+            try:
+                pd = checkpoint.load_predicate(self._dir, pred, meta,
+                                               self._schema)
+                build_indexes({pred: pd})
+            except vault.StorageCorruption:
+                # a clustered Alpha heals the bad tablet from a group
+                # replica (TabletSnapshot + PeerTable failover) before
+                # refusing — the PR-1 FetchLog heal, for disk faults
+                heal = self.heal_cb
+                pd = heal(pred) if heal is not None else None
+                if pd is None:
+                    raise
+                build_indexes({pred: pd})
+                METRICS.inc("storage_heals_total")
             size = _pd_nbytes(pd)
             with self._lock:
                 self.faults += 1

@@ -33,10 +33,11 @@ from __future__ import annotations
 
 import os
 
-import numpy as np
-
 from dgraph_tpu.store import checkpoint
-from dgraph_tpu.store.mvcc import MVCCStore, _materialize
+# fold_vocab / fold_preds live in store/mvcc.py (the lazily-folding
+# read view shares them); re-exported here for the existing callers.
+from dgraph_tpu.store.mvcc import (MVCCStore, _materialize, fold_preds,
+                                   fold_vocab)
 from dgraph_tpu.store.store import Store
 from dgraph_tpu.utils import tracing
 from dgraph_tpu.utils.metrics import METRICS
@@ -92,39 +93,15 @@ def save_streaming(store: Store, dirname: str, base_ts: int = 0,
     if compress is None:
         compress = native.HAVE_NATIVE
     os.makedirs(dirname, exist_ok=True)
-    checkpoint.save_uids(store.uids, dirname, compress)
+    uids_crc = checkpoint.save_uids(store.uids, dirname, compress)
     preds_meta = {}
     for pred, pd in iter_tablets(store, pace=pace, job=job):
         preds_meta[pred] = checkpoint.save_predicate(dirname, pred, pd)
     checkpoint.write_manifest(dirname, checkpoint.manifest_doc(
         store.n_nodes, store.schema.to_text(), preds_meta, base_ts,
-        compress))
+        compress, uids_crc=uids_crc))
 
 
-def fold_vocab(base: Store, pending) -> np.ndarray:
-    """The full-fold uid vocabulary: base vocab ∪ every uid the pending
-    layers mention — O(nodes), resident by the out-of-core contract
-    (the uid dictionary never pages out)."""
-    extra: set[int] = set()
-    for layer in pending:
-        extra.update(layer.mut.all_uids())
-    if not extra:
-        return base.uids
-    return np.union1d(base.uids,
-                      np.array(sorted(extra), np.int64)).astype(np.int64)
-
-
-def fold_preds(base: Store, pending) -> list[str]:
-    """Stable order over every tablet the fold must visit: base tablets
-    plus predicates the deltas introduce."""
-    names = set(base.preds.keys())
-    for layer in pending:
-        m = layer.mut
-        for e in m.edge_sets + m.edge_dels:
-            names.add(e[1])
-        for v in m.val_sets + m.val_dels:
-            names.add(v[1])
-    return sorted(names)
 
 
 def write_fold(mvcc: MVCCStore, dirname: str, plan=None,
@@ -153,7 +130,7 @@ def write_fold(mvcc: MVCCStore, dirname: str, plan=None,
     vocab = fold_vocab(base, pending)
     schema = base.schema.clone()
     os.makedirs(dirname, exist_ok=True)
-    checkpoint.save_uids(vocab, dirname, compress)
+    uids_crc = checkpoint.save_uids(vocab, dirname, compress)
     lazy = lazy_preds(base)
     preds_meta = {}
     for pred in fold_preds(base, pending):
@@ -177,7 +154,8 @@ def write_fold(mvcc: MVCCStore, dirname: str, plan=None,
         if pace is not None:
             pace()
     checkpoint.write_manifest(dirname, checkpoint.manifest_doc(
-        int(len(vocab)), schema.to_text(), preds_meta, stamp, compress))
+        int(len(vocab)), schema.to_text(), preds_meta, stamp, compress,
+        uids_crc=uids_crc))
     return new_ts, guard
 
 
@@ -211,6 +189,11 @@ def checkpoint_streaming(mvcc: MVCCStore, root_dir: str,
         write_fold(mvcc, subdir, plan=plan, pace=pace, job=job)
         new_base, _ts = open_out_of_core(subdir, budget_bytes)
         new_base.preds.root_dir = root_dir  # next fold writes beside it
+        # a clustered Alpha's corruption-heal hook (replica
+        # TabletSnapshot) carries onto every new fold point
+        old_lazy = lazy_preds(mvcc.base)
+        if old_lazy is not None:
+            new_base.preds.heal_cb = old_lazy.heal_cb
         mvcc.install_fold(new_ts, new_base, plan[4])
     except BaseException:
         shutil.rmtree(subdir, ignore_errors=True)
@@ -223,3 +206,44 @@ def checkpoint_streaming(mvcc: MVCCStore, root_dir: str,
             keep.add(os.path.basename(lp._dir))
     checkpoint.commit_versioned(root_dir, sub, keep=keep)
     return new_ts
+
+
+_GC_RECLAIMED = 0  # cumulative bytes reclaimed (gauge backing store)
+
+
+def gc_superseded(root_dir: str, mvcc: MVCCStore) -> int:
+    """Remove superseded `ckpt-*` subdirs no retained MVCC fold point
+    faults tablets from anymore (PR-3 kept them alive while an older
+    fold referenced them, but only the NEXT checkpoint swept — a store
+    that stopped checkpointing leaked them forever). Runs from the
+    watermark gc path (Alpha._maybe_gc): once `mvcc.gc` drops a fold,
+    its on-disk dir is reclaimable here. Returns bytes reclaimed;
+    cumulative total in the `checkpoint_gc_reclaimed_bytes` gauge."""
+    import shutil
+    global _GC_RECLAIMED
+
+    cur = os.path.join(root_dir, "CURRENT")
+    if not os.path.exists(cur):
+        return 0
+    with open(cur) as f:
+        keep = {f.read().strip()}
+    for _ts, st in mvcc.history_stores():
+        lp = lazy_preds(st)
+        if lp is not None and os.path.dirname(
+                os.path.abspath(lp._dir)) == os.path.abspath(root_dir):
+            keep.add(os.path.basename(lp._dir))
+    reclaimed = 0
+    for name in os.listdir(root_dir):
+        if not name.startswith("ckpt-") or name in keep:
+            continue
+        d = os.path.join(root_dir, name)
+        if not os.path.isdir(d):
+            continue
+        size = sum(os.path.getsize(os.path.join(d, f))
+                   for f in os.listdir(d))
+        shutil.rmtree(d, ignore_errors=True)
+        reclaimed += size
+    if reclaimed:
+        _GC_RECLAIMED += reclaimed
+        METRICS.set_gauge("checkpoint_gc_reclaimed_bytes", _GC_RECLAIMED)
+    return reclaimed

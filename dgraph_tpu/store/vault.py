@@ -28,6 +28,7 @@ from __future__ import annotations
 import io
 import os
 import struct
+import zlib
 
 import numpy as np
 
@@ -50,6 +51,59 @@ _strict = False  # refuse plaintext once migration is done
 
 class VaultError(Exception):
     """Missing/incorrect key or tampered ciphertext."""
+
+
+class StorageCorruption(Exception):
+    """A durable file failed its integrity check (crc mismatch, torn
+    content, undecodable manifest). Typed and RETRYABLE: on a clustered
+    Alpha the load path first tries to heal the tablet from a replica
+    (TabletSnapshot), and a refused load names the exact file so the
+    operator can repair or restore it — corruption is never served as
+    wrong query results."""
+
+    retryable = True
+
+    def __init__(self, path: str, kind: str = "file", detail: str = ""):
+        self.path = path
+        self.kind = kind
+        msg = f"storage corruption in {kind} {path}"
+        if detail:
+            msg += f": {detail}"
+        super().__init__(msg)
+
+
+def corruption(path: str, kind: str, detail: str = "") -> StorageCorruption:
+    """Build (and meter) a StorageCorruption — the single counting site
+    so `storage_corruption_total{file_kind=}` covers every detection
+    path (checkpoint load, delta replay, restore verify, sidecars)."""
+    from dgraph_tpu.utils.metrics import METRICS
+    METRICS.inc("storage_corruption_total", file_kind=kind)
+    return StorageCorruption(path, kind=kind, detail=detail)
+
+
+# ---- disk-fault injection hook (cluster/fault.py FaultSchedule) ----
+# One process-global write hook: every durable write (atomic file
+# writes below + WAL record appends in store/wal.py) passes its final
+# bytes through it. A fuzz/test hook may mutate the bytes (bit-flip),
+# shorten them (torn write), or raise OSError (ENOSPC) — recorded
+# digests are computed from the INTENDED bytes, so an injected fault is
+# exactly what the integrity checks must catch. None = zero overhead.
+_io_fault = None
+
+
+def set_io_fault(cb) -> None:
+    """Install (or clear, with None) the write-fault hook:
+    ``cb(path, data) -> bytes`` may return mutated/truncated bytes or
+    raise OSError. Test/fuzz only — never armed in production."""
+    global _io_fault
+    _io_fault = cb
+
+
+def io_faulted(path: str, data: bytes) -> bytes:
+    if _io_fault is None:
+        return data
+    out = _io_fault(path, data)
+    return data if out is None else out
 
 
 def set_key(key: bytes | None, strict: bool = False) -> None:
@@ -169,40 +223,102 @@ def decrypt(data: bytes, aad: bytes = b"") -> bytes:
 
 # ---- file IO helpers (checkpoint blocks, sidecars, manifests) ----
 
-def write_bytes(path: str, data: bytes) -> None:
+def atomic_write(path: str, file_bytes: bytes) -> int:
+    """THE durable-file writer: tmp + flush + fsync + os.replace, so a
+    kill at any point leaves either the previous file or the whole new
+    one — never a torn mix (graftlint R8 pins every file-writing open
+    under store/ to this pattern). Returns crc32 of the INTENDED bytes
+    (the integrity digest recorded in manifests); the injected-fault
+    hook mutates only what lands on disk, so a fault is exactly what
+    the digest check later catches."""
+    crc = zlib.crc32(file_bytes)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(io_faulted(path, file_bytes))
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return crc
+
+
+def write_bytes(path: str, data: bytes) -> int:
+    """Seal + atomically write `data`; returns the on-disk crc32."""
     # escape regardless of key state: content beginning with any magic
     # must survive the unconditional MAGIC_P strip in read_bytes
     if data[:len(MAGIC)] in (MAGIC, MAGIC_C, MAGIC_P):
         data = MAGIC_P + data
-    with open(path, "wb") as f:
-        f.write(encrypt(data))
+    return atomic_write(path, encrypt(data))
 
 
-def read_bytes(path: str) -> bytes:
+def _verify_crc(path: str, raw: bytes, crc: int | None,
+                kind: str) -> None:
+    if crc is not None and zlib.crc32(raw) != crc:
+        raise corruption(path, kind=kind,
+                         detail=f"crc mismatch over {len(raw)} bytes")
+
+
+def file_crc_ok(path: str, crc: int) -> bool:
+    """Digest check of a file's raw on-disk bytes without decoding it
+    (backup verify / restore-resume re-verification)."""
+    try:
+        with open(path, "rb") as f:
+            return zlib.crc32(f.read()) == crc
+    except OSError:
+        return False
+
+
+def read_bytes(path: str, crc: int | None = None,
+               kind: str = "file") -> bytes:
+    """Read (+ decrypt) a vault file; `crc` (from the manifest) is
+    verified against the RAW on-disk bytes first — a failed check
+    raises StorageCorruption naming the file."""
     with open(path, "rb") as f:
-        data = decrypt(f.read())
+        raw = f.read()
+    _verify_crc(path, raw, crc, kind)
+    data = decrypt(raw)
     if data[:len(MAGIC_P)] == MAGIC_P:
         return data[len(MAGIC_P):]
     return data
 
 
-def save_np(path: str, arr: np.ndarray) -> None:
-    """np.save through the vault (serialize to memory, encrypt, write)."""
-    if _aead is None:
-        np.save(path, arr)
-        return
+def save_np(path: str, arr: np.ndarray) -> int:
+    """np.save through the vault (serialize to memory, encrypt, write
+    atomically). Returns the on-disk crc32. Plaintext bytes are
+    identical to a direct np.save of the same array."""
     buf = io.BytesIO()
     np.save(buf, arr)
-    write_bytes(path, buf.getvalue())
+    if _aead is None:
+        return atomic_write(path, buf.getvalue())
+    return write_bytes(path, buf.getvalue())
 
 
-def load_np(path: str, allow_pickle: bool = False) -> np.ndarray:
+def load_np(path: str, allow_pickle: bool = False,
+            crc: int | None = None,
+            kind: str = "segment") -> np.ndarray:
+    if crc is None:
+        # fast path: no digest recorded (pre-v3 snapshot) — keep the
+        # zero-copy np.load for plaintext files
+        with open(path, "rb") as f:
+            head = f.read(len(MAGIC))
+            if not is_encrypted(head):
+                if _strict and _aead is not None:
+                    raise VaultError(f"plaintext file rejected in strict "
+                                     f"encryption mode: {path}")
+                return np.load(path, allow_pickle=allow_pickle)
+            data = head + f.read()
+        return np.load(io.BytesIO(decrypt(data)),
+                       allow_pickle=allow_pickle)
     with open(path, "rb") as f:
-        head = f.read(len(MAGIC))
-        if not is_encrypted(head):
-            if _strict and _aead is not None:
-                raise VaultError(f"plaintext file rejected in strict "
-                                 f"encryption mode: {path}")
-            return np.load(path, allow_pickle=allow_pickle)
-        data = head + f.read()
-    return np.load(io.BytesIO(decrypt(data)), allow_pickle=allow_pickle)
+        raw = f.read()
+    _verify_crc(path, raw, crc, kind)
+    if not is_encrypted(raw):
+        if _strict and _aead is not None:
+            raise VaultError(f"plaintext file rejected in strict "
+                             f"encryption mode: {path}")
+        try:
+            return np.load(io.BytesIO(raw), allow_pickle=allow_pickle)
+        except ValueError as e:
+            # crc passed but the block won't decode — a digest recorded
+            # over an already-corrupt write; still a typed refusal
+            raise corruption(path, kind=kind, detail=str(e)) from e
+    return np.load(io.BytesIO(decrypt(raw)), allow_pickle=allow_pickle)

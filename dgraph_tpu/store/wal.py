@@ -163,6 +163,12 @@ class Journal:
         # not interleave record bytes
         with self._wlock:
             rec = self._frame(doc, self._seq)
+            # disk-fault injection seam (cluster/fault.py disk family):
+            # the hook may corrupt/shorten the frame (detected by the
+            # CRC on replay — exactly a torn tail) or raise ENOSPC
+            # (the append fails BEFORE the in-memory apply, so the
+            # commit refuses instead of acking an unlogged record)
+            rec = vault.io_faulted(self.path, rec)
             self._f.write(rec)
             self._f.flush()
             if self.sync:

@@ -303,15 +303,28 @@ class CostPriorModel:
             json.dump(self.to_state(), f)
 
     def load(self, path: str) -> bool:
-        """Merge a persisted model into this one; missing/corrupt files
-        are a no-op (priors are telemetry-derived, never worth failing
-        a boot over)."""
+        """Merge a persisted model into this one. A missing file is a
+        silent no-op; a corrupt/truncated or wrong-shaped one is
+        COUNTED and logged but still never aborts the boot — priors
+        are telemetry-derived, the model refits from digests (ISSUE-11
+        sidecar hardening)."""
         try:
             with open(path) as f:
                 state = json.load(f)
-        except (OSError, ValueError):
+            self.merge_state(state)
+        except OSError:
             return False
-        self.merge_state(state)
+        except Exception:  # noqa: BLE001 — corrupt sidecar: start fresh
+            import os
+
+            from dgraph_tpu.utils import logging as xlog
+            from dgraph_tpu.utils.metrics import METRICS
+            METRICS.inc("sidecar_load_failures_total",
+                        file=os.path.basename(path))
+            xlog.get("costprior").warning(
+                "corrupt cost-prior sidecar %s ignored; refitting "
+                "from digests", path, exc_info=True)
+            return False
         return True
 
     # -- surfacing (/debug/scheduler) ----------------------------------------

@@ -377,14 +377,28 @@ class Aggregator:
 
     def load(self, path: str) -> bool:
         """Merge a persisted aggregate into this one (restart path).
-        Missing/corrupt files are a no-op: cost history is telemetry,
-        never worth failing a boot over."""
+        A missing file is a silent no-op; a corrupt/truncated or
+        wrong-shaped one (a kill mid-write, a bad disk) is COUNTED and
+        logged but still never aborts the boot — cost history is
+        telemetry, the store starts fresh (ISSUE-11 sidecar
+        hardening)."""
         try:
             with open(path) as f:
                 state = json.load(f)
-        except (OSError, ValueError):
+            self.merge(Aggregator.from_state(state))
+        except OSError:
             return False
-        self.merge(Aggregator.from_state(state))
+        except Exception:  # noqa: BLE001 — corrupt sidecar: start fresh
+            import os
+
+            from dgraph_tpu.utils import logging as xlog
+            from dgraph_tpu.utils.metrics import METRICS
+            METRICS.inc("sidecar_load_failures_total",
+                        file=os.path.basename(path))
+            xlog.get("costprofile").warning(
+                "corrupt cost-profile sidecar %s ignored; starting "
+                "with an empty aggregate", path, exc_info=True)
+            return False
         return True
 
     def clear(self) -> None:

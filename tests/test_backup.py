@@ -1,14 +1,24 @@
-"""Binary backup/restore round trips (reference: ee/backup + restore)."""
+"""Binary backup/restore round trips (reference: ee/backup + restore),
+plus the ISSUE-11 hardening matrix: per-file-kind corruption detection
+(typed StorageCorruption naming the file, never silent wrong data),
+kill-at-any-point crash safety + journal resume bit-identity, offline
+chain verification, and sidecar/half-written-dir robustness."""
 
+import glob
 import json
 import os
+import shutil
 import subprocess
 import sys
 
 import pytest
 
 from dgraph_tpu.server.api import Alpha
-from dgraph_tpu.server.backup import _series, backup, restore
+from dgraph_tpu.server.backup import (_series, backup, restore,
+                                      verify_chain)
+from dgraph_tpu.store import checkpoint, vault
+from dgraph_tpu.store.vault import StorageCorruption
+from dgraph_tpu.utils.metrics import METRICS
 
 SCHEMA = "name: string @index(exact) .\nage: int @index(int) .\nfriend: [uid] @reverse ."
 
@@ -120,6 +130,346 @@ def test_cli_backup_restore_roundtrip(tmp_path):
     assert out.returncode == 0, out.stderr
     r = Alpha.open(str(p2), sync=False)
     assert len(r.query('{ q(func: has(name)) { name } }')["q"]) == 3
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 11: integrity, crash safety, resume, verification
+
+
+def _mk_chain(tmp_path):
+    """posting dir + a full→incr backup chain with cross-links."""
+    p, dest = str(tmp_path / "p"), str(tmp_path / "bk")
+    a = _mk_alpha(p, range(4))
+    a.checkpoint_to(p)
+    a.wal.close()
+    backup(p, dest)
+    a2 = Alpha.open(p, sync=False)
+    a2.mutate(set_nquads='_:x <name> "late-arrival" .')
+    uid = a2.query('{ q(func: eq(name, "late-arrival")) { uid } }'
+                   )["q"][0]["uid"]
+    a2.mutate(set_nquads=f'_:y <name> "later-still" .\n'
+                         f'_:y <friend> <{uid}> .')
+    a2.wal.close()
+    backup(p, dest)
+    return p, dest
+
+
+def _flip_byte(path, offset=None):
+    with open(path, "rb") as f:
+        data = bytearray(f.read())
+    i = len(data) // 2 if offset is None else offset
+    data[i] ^= 0x5A
+    with open(path, "wb") as f:
+        f.write(bytes(data))
+
+
+def _full_dir(dest):
+    return _series(dest)[0]["dir"]
+
+
+def _counter(name, **labels):
+    return METRICS.get(name, **labels)
+
+
+def test_corruption_matrix_detected_and_typed(tmp_path):
+    """THE corruption matrix: every injected corruption class — CSR
+    segment, uid block, checkpoint manifest, delta log, backup
+    manifest — is DETECTED at restore and refused with a typed,
+    retryable StorageCorruption naming the file. Zero classes restore
+    silently wrong data."""
+    p, dest = _mk_chain(tmp_path)
+    full = _full_dir(dest)
+    incr = _series(dest)[-1]["dir"]
+    cases = {
+        "segment": glob.glob(os.path.join(full, "*.val._.vals.npy"))[0],
+        "uids": glob.glob(os.path.join(full, "uids.*"))[0],
+        "manifest": os.path.join(full, "manifest.json"),
+        "delta": os.path.join(incr, "delta.log"),
+        "backup_manifest": os.path.join(incr, "backup_manifest.json"),
+    }
+    for kind, victim in cases.items():
+        work = str(tmp_path / f"work_{kind}")
+        shutil.copytree(dest, work)
+        rel = os.path.relpath(victim, dest)
+        target = os.path.join(work, rel)
+        if kind == "delta":
+            # cut the tail mid-record: replay ends early, the
+            # manifest's record count turns it into a typed refusal
+            with open(target, "r+b") as f:
+                f.truncate(os.path.getsize(target) - 7)
+        elif kind.endswith("manifest"):
+            with open(target, "wb") as f:
+                f.write(b'{"torn": tru')
+        else:
+            _flip_byte(target)
+        assert StorageCorruption.retryable
+        with pytest.raises(StorageCorruption) as ei:
+            restore(work, str(tmp_path / f"r_{kind}"))
+        assert os.path.basename(target) in str(ei.value), (
+            f"{kind}: the error must name the corrupt file, "
+            f"got {ei.value}")
+    assert _counter("storage_corruption_total", file_kind="segment") >= 1
+    assert _counter("storage_corruption_total", file_kind="delta") >= 1
+    assert _counter("storage_corruption_total",
+                    file_kind="manifest") >= 1
+
+
+def test_corrupt_checkpoint_load_refuses_typed(tmp_path):
+    """Alpha.open on a checkpoint with a flipped segment byte raises
+    StorageCorruption naming the file — a reload of a bad disk is a
+    typed refusal, not wrong query results."""
+    p = str(tmp_path / "p")
+    a = _mk_alpha(p, range(3))
+    a.checkpoint_to(p)
+    a.wal.close()
+    resolved = checkpoint.resolve(p)
+    victim = glob.glob(os.path.join(resolved, "*.val._.vals.npy"))[0]
+    _flip_byte(victim)
+    with pytest.raises(StorageCorruption) as ei:
+        Alpha.open(p, sync=False)
+    assert os.path.basename(victim) in str(ei.value)
+
+
+class _InjectedKill(Exception):
+    """Stands in for kill -9 at an arbitrary durable-write point."""
+
+
+def _dirs_bit_identical(d1, d2):
+    f1, f2 = sorted(os.listdir(d1)), sorted(os.listdir(d2))
+    assert f1 == f2, (f1, f2)
+    for f in f1:
+        b1 = open(os.path.join(d1, f), "rb").read()
+        b2 = open(os.path.join(d2, f), "rb").read()
+        assert b1 == b2, f"{f} differs"
+
+
+def test_restore_kill_at_any_point_resumes_bit_identical(tmp_path):
+    """THE kill matrix: interrupt restore at every sampled durable
+    write (vault IO hook raising at the Nth write — covers segment
+    writes, journal appends, the WAL reset, manifests). After every
+    kill the target still opens (old state), and re-running restore
+    RESUMES (journal) and produces a store bit-identical to an
+    uninterrupted restore."""
+    _p, dest = _mk_chain(tmp_path)
+    ref = str(tmp_path / "ref")
+    restore(dest, ref)
+    ref_dir = checkpoint.resolve(ref)
+
+    # count the durable writes of one full restore
+    writes = [0]
+    vault.set_io_fault(lambda path, data: (writes.__setitem__(
+        0, writes[0] + 1), data)[1])
+    try:
+        restore(dest, str(tmp_path / "count"))
+    finally:
+        vault.set_io_fault(None)
+    total = writes[0]
+    assert total > 10, f"expected many durable writes, saw {total}"
+
+    resumed0 = _counter("restore_resumed_total")
+    step = max(1, total // 7)
+    for n in sorted({*range(1, total + 1, step), total}):
+        tgt = str(tmp_path / f"t{n}")
+        seen = [0]
+
+        def hook(path, data, n=n):
+            seen[0] += 1
+            if seen[0] == n:
+                raise _InjectedKill(f"kill at write {n}")
+            return data
+
+        vault.set_io_fault(hook)
+        try:
+            with pytest.raises(_InjectedKill):
+                restore(dest, tgt)
+        finally:
+            vault.set_io_fault(None)
+        # re-run: resumes (or completes the flip) and lands bit-
+        # identical to the uninterrupted restore
+        restore(dest, tgt)
+        _dirs_bit_identical(ref_dir, checkpoint.resolve(tgt))
+        assert not os.path.exists(os.path.join(tgt, "restore.journal"))
+        r = Alpha.open(tgt, sync=False)
+        assert len(r.query('{ q(func: has(name)) { name } }')["q"]) == 6
+        r.wal.close()
+    assert _counter("restore_resumed_total") > resumed0, (
+        "at least one kill point must have resumed from the journal")
+
+
+def test_restore_kill_leaves_old_store_serveable(tmp_path):
+    """A restore ONTO a live posting dir killed mid-flight leaves the
+    OLD store serveable (never neither): staging is a versioned subdir,
+    the CURRENT flip is the only commit point."""
+    _p, dest = _mk_chain(tmp_path)
+    tgt = str(tmp_path / "live")
+    old = Alpha.open(tgt, sync=False)
+    old.alter("name: string @index(exact) .")
+    old.mutate(set_nquads='_:o <name> "old-data" .')
+    old.checkpoint_to(tgt)
+    old.wal.close()
+
+    seen = [0]
+
+    def hook(path, data):
+        seen[0] += 1
+        if seen[0] == 4:  # mid-staging, well before the flip
+            raise _InjectedKill("kill mid-restore")
+        return data
+
+    vault.set_io_fault(hook)
+    try:
+        with pytest.raises(_InjectedKill):
+            restore(dest, tgt)
+    finally:
+        vault.set_io_fault(None)
+    a = Alpha.open(tgt, sync=False)
+    assert a.query('{ q(func: eq(name, "old-data")) { name } }') == {
+        "q": [{"name": "old-data"}]}
+    a.wal.close()
+    # the re-run completes; the new store replaces the old atomically
+    restore(dest, tgt)
+    a2 = Alpha.open(tgt, sync=False)
+    assert a2.query('{ q(func: eq(name, "old-data")) { name } }') == {
+        "q": []}
+    assert len(a2.query('{ q(func: has(name)) { name } }')["q"]) == 6
+
+
+def test_half_written_backup_dirs_skipped_and_cleaned(tmp_path):
+    """_series must skip half-written backup dirs (manifest missing or
+    its .tmp still present) instead of crashing, and the next
+    successful backup removes them and reuses the seq slot."""
+    p, dest = _mk_chain(tmp_path)
+    # a killed backup: dir with data but no manifest
+    dead1 = os.path.join(dest, "backup-0003-full")
+    os.makedirs(dead1)
+    open(os.path.join(dead1, "uids.npy"), "wb").write(b"torn")
+    # a killed manifest write: .tmp still beside a manifest
+    dead2 = os.path.join(dest, "backup-0004-incr")
+    os.makedirs(dead2)
+    open(os.path.join(dead2, "backup_manifest.json"), "w").write("{}")
+    open(os.path.join(dead2, "backup_manifest.json.tmp"), "w").write("x")
+    assert [m["seq"] for m in _series(dest)] == [1, 2]
+    m = backup(p, dest)  # must not crash; cleans the carcasses
+    assert m["seq"] == 3
+    assert not os.path.exists(dead1)
+    assert not os.path.exists(dead2)
+    # and the full chain still restores
+    restore(dest, str(tmp_path / "r"))
+
+
+def test_corrupt_backup_manifest_skipped_when_appending(tmp_path):
+    """An undecodable backup manifest must not wedge the WRITER —
+    counted + skipped (restore stays strict, see the matrix test)."""
+    p, dest = _mk_chain(tmp_path)
+    incr = _series(dest)[-1]["dir"]
+    before = _counter("sidecar_load_failures_total",
+                      file="backup_manifest.json")
+    with open(os.path.join(incr, "backup_manifest.json"), "wb") as f:
+        f.write(b"\x00not json")
+    m = backup(p, dest)  # appends despite the corrupt entry
+    assert m["seq"] >= 2
+    assert _counter("sidecar_load_failures_total",
+                    file="backup_manifest.json") > before
+
+
+def test_corrupt_sidecars_never_abort_open(tmp_path):
+    """ISSUE-11 satellite: corrupt/truncated costprofiles.json /
+    costpriors.json must not abort Alpha.open — log + counter, start
+    fresh."""
+    p = str(tmp_path / "p")
+    a = _mk_alpha(p, range(3))
+    a.checkpoint_to(p)  # writes both sidecars beside the checkpoint
+    a.wal.close()
+    for name in ("costprofiles.json", "costpriors.json"):
+        with open(os.path.join(p, name), "wb") as f:
+            f.write(b'{"shapes": {"tr')  # torn mid-write
+    b1 = _counter("sidecar_load_failures_total", file="costprofiles.json")
+    b2 = _counter("sidecar_load_failures_total", file="costpriors.json")
+    r = Alpha.open(p, sync=False)
+    assert len(r.query('{ q(func: has(name)) { name } }')["q"]) == 3
+    r.wal.close()
+    assert _counter("sidecar_load_failures_total",
+                    file="costprofiles.json") == b1 + 1
+    assert _counter("sidecar_load_failures_total",
+                    file="costpriors.json") == b2 + 1
+
+
+def test_verify_chain_clean_and_corrupt(tmp_path):
+    """verify_chain walks the series offline: clean chain is ok; a
+    flipped segment byte / torn delta name the exact file; half-written
+    dirs are warnings, not errors."""
+    _p, dest = _mk_chain(tmp_path)
+    report = verify_chain(dest)
+    assert report["ok"], report["errors"]
+    assert [b["seq"] for b in report["backups"]] == [1, 2]
+    assert all(b["status"] == "ok" for b in report["backups"])
+
+    # half-written dir → warning only
+    os.makedirs(os.path.join(dest, "backup-0009-full"))
+    report = verify_chain(dest)
+    assert report["ok"] and report["warnings"]
+
+    # flipped segment byte in the full → error naming the file
+    victim = glob.glob(os.path.join(_full_dir(dest),
+                                    "*.val._.vals.npy"))[0]
+    _flip_byte(victim)
+    report = verify_chain(dest)
+    assert not report["ok"]
+    assert any(e["file"] == victim for e in report["errors"])
+    assert any(b["status"] == "corrupt" for b in report["backups"])
+
+
+def test_verify_cli_and_admin_endpoint(tmp_path):
+    """`dgraph_tpu backup verify` exits 0/1 by chain health, and POST
+    /admin/backup/verify serves the same report over HTTP."""
+    import urllib.request
+
+    from dgraph_tpu.server.http import make_http_server, serve_background
+
+    p, dest = _mk_chain(tmp_path)
+    out = subprocess.run(
+        [sys.executable, "-m", "dgraph_tpu", "backup", "verify",
+         "--dest", dest], capture_output=True, text=True,
+        cwd="/root/repo", timeout=120)
+    assert out.returncode == 0, out.stderr
+    assert json.loads(out.stdout)["ok"]
+
+    a = Alpha.open(p, sync=False)
+    srv = make_http_server(a)
+    serve_background(srv)
+    port = srv.server_address[1]
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/admin/backup/verify",
+        data=json.dumps({"dest": dest}).encode(), method="POST")
+    with urllib.request.urlopen(req) as r:
+        doc = json.loads(r.read())
+    assert doc["data"]["ok"]
+    srv.shutdown()
+    a.wal.close()
+
+    # corrupt the delta → CLI exits 1 and names the file
+    incr = _series(dest)[-1]["dir"]
+    with open(os.path.join(incr, "delta.log"), "r+b") as f:
+        f.truncate(5)
+    out = subprocess.run(
+        [sys.executable, "-m", "dgraph_tpu", "backup", "verify",
+         "--dest", dest], capture_output=True, text=True,
+        cwd="/root/repo", timeout=120)
+    assert out.returncode == 1
+    assert "delta.log" in out.stdout
+
+
+def test_restore_is_idempotent_after_success(tmp_path):
+    """A re-run over an already-restored target is a no-op (CURRENT
+    already names the restored snapshot)."""
+    _p, dest = _mk_chain(tmp_path)
+    tgt = str(tmp_path / "r")
+    ts1 = restore(dest, tgt)
+    ts2 = restore(dest, tgt)
+    assert ts1 == ts2
+    r = Alpha.open(tgt, sync=False)
+    assert len(r.query('{ q(func: has(name)) { name } }')["q"]) == 6
+    r.wal.close()
 
 
 def test_incremental_carries_trailing_drop(tmp_path):

@@ -432,3 +432,60 @@ def test_r7_one_finding_per_line_not_per_attribute():
     a = scan("dgraph_tpu/parallel/fake.py", src)
     finds = [f for f in a.findings if f.rule == "shard-map-compat"]
     assert len(finds) == 1
+
+
+# ---------------------------------------------------------------------------
+# R8 atomic-write (ISSUE 11)
+
+R8_BAD = """\
+import json
+def persist(path, doc):
+    with open(path, "w") as f:
+        json.dump(doc, f)
+"""
+
+R8_ATOMIC = """\
+import json, os
+def persist(path, doc):
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+"""
+
+
+def test_r8_flags_bare_write_in_store_and_backup():
+    for rel in ("dgraph_tpu/store/fake.py",
+                "dgraph_tpu/server/backup.py"):
+        a = scan(rel, R8_BAD)
+        assert "atomic-write" in rules_of(a), rel
+    # binary mode and mode= kwarg are caught too
+    a = scan("dgraph_tpu/store/fake.py",
+             'f = open("x", mode="wb")\nf.close()\n')
+    assert "atomic-write" in rules_of(a)
+
+
+def test_r8_allows_the_atomic_pattern_and_out_of_scope_files():
+    # a function that itself fsyncs + replaces IS the helper pattern
+    a = scan("dgraph_tpu/store/fake.py", R8_ATOMIC)
+    assert "atomic-write" not in rules_of(a)
+    # reads and appends are not writes-that-tear
+    a = scan("dgraph_tpu/store/fake.py",
+             'f = open("x", "ab")\ng = open("y", "r+b")\n')
+    assert "atomic-write" not in rules_of(a)
+    # outside the persistence layer the rule does not apply
+    a = scan("dgraph_tpu/server/fake.py", R8_BAD)
+    assert "atomic-write" not in rules_of(a)
+
+
+def test_r8_waiver_with_reason():
+    src = ("def persist(path, doc):\n"
+           "    # graftlint: allow(atomic-write): scratch file, "
+           "re-generated on boot\n"
+           "    with open(path, \"w\") as f:\n"
+           "        f.write(doc)\n")
+    a = scan("dgraph_tpu/store/fake.py", src)
+    assert "atomic-write" not in rules_of(a)
+    assert "atomic-write" in rules_of(a, waived=True)

@@ -382,6 +382,100 @@ def test_backup_incremental_chain_from_ooc(seed_ckpt, tmp_path):
         "q": [{"name": "p5"}]}
 
 
+def test_streaming_restore_3x_budget_bit_identity(seed_ckpt, tmp_path,
+                                                  monkeypatch):
+    """ISSUE-11 acceptance: restoring a full→incr chain under a memory
+    budget one third of the full backup's on-disk size produces a
+    posting dir BIT-IDENTICAL to the in-core restore, with peak
+    resident bytes ≤ budget + one tablet (the store's own ledger)."""
+    import dgraph_tpu.store.outofcore as ooc
+
+    d = str(tmp_path / "p")
+    shutil.copytree(seed_ckpt, d)
+    a = Alpha.open(d, device_threshold=10**9, sync=False)
+    dest = str(tmp_path / "bk")
+    backup_alpha(a, d, dest)
+    _mutate_both((a,), round_no=9)
+    m2 = backup_alpha(a, d, dest)
+    assert m2["type"] == "incr"
+    a.wal.close()
+
+    full_dir = [x for x in os.listdir(dest) if x.endswith("full")][0]
+    disk = _disk_bytes(os.path.join(dest, full_dir))
+    budget = disk // 3
+    assert disk >= 3 * budget
+
+    r_ref = str(tmp_path / "r_ref")
+    restore(dest, r_ref)
+
+    captured = {}
+    orig_open = ooc.open_out_of_core
+
+    def spy(dirname, budget_bytes):
+        store, ts = orig_open(dirname, budget_bytes)
+        captured["lazy"] = store.preds
+        return store, ts
+
+    monkeypatch.setattr(ooc, "open_out_of_core", spy)
+    r_ooc = str(tmp_path / "r_ooc")
+    restore(dest, r_ooc, memory_budget=budget)
+
+    _dir_files_identical(checkpoint.resolve(r_ref),
+                         checkpoint.resolve(r_ooc))
+    lazy = captured["lazy"]
+    largest = _max_tablet_bytes(os.path.join(dest, full_dir))
+    assert lazy.peak_resident_bytes > 0, "the restore actually streamed"
+    assert lazy.peak_resident_bytes <= budget + largest, (
+        f"restore defeated the budget: peak {lazy.peak_resident_bytes}"
+        f" > {budget} + {largest}")
+    # both restored dirs open and serve identically
+    ra = Alpha.open(r_ooc, device_threshold=10**9, sync=False)
+    out = ra.query('{ q(func: eq(name, "new-9-0")) { name } }')
+    assert out == {"q": [{"name": "new-9-0"}]}
+    ra.wal.close()
+
+
+def test_gc_reclaims_superseded_ckpt_dirs(seed_ckpt, tmp_path):
+    """ISSUE-11 satellite: once gc drops the last MVCC fold referencing
+    an old `ckpt-*` dir, the watermark gc path reclaims it from disk
+    (PR 3 left them behind until the next checkpoint — forever on a
+    store that stopped checkpointing); reclaimed bytes are gauged."""
+    from dgraph_tpu.store import stream
+    from dgraph_tpu.utils.metrics import METRICS
+
+    d = str(tmp_path / "p")
+    shutil.copytree(seed_ckpt, d)
+    budget = _disk_bytes(d) // 3
+    a = Alpha.open(d, device_threshold=10**9, sync=False,
+                   memory_budget=budget)
+    subdirs = lambda: {x for x in os.listdir(d)  # noqa: E731
+                       if x.startswith("ckpt-")}
+    assert len(subdirs()) == 1
+    # two streamed folds: each writes a new ckpt dir; the older ones
+    # stay on disk while their fold points remain in MVCC history
+    a.mutate(set_nquads='_:g1 <name> "gc-1" .')
+    a.maintenance_rollup(d)
+    a.mutate(set_nquads='_:g2 <name> "gc-2" .')
+    a.maintenance_rollup(d)
+    held = subdirs()
+    assert len(held) >= 2, "older fold's dir must survive while referenced"
+
+    # drop every fold below the newest, then reclaim
+    a.mvcc.gc(a.mvcc.base_ts)
+    g0 = METRICS.snapshot()["gauges"].get(
+        "checkpoint_gc_reclaimed_bytes", 0.0)
+    reclaimed = stream.gc_superseded(d, a.mvcc)
+    assert reclaimed > 0
+    assert METRICS.snapshot()["gauges"][
+        "checkpoint_gc_reclaimed_bytes"] >= g0 + reclaimed
+    left = subdirs()
+    assert len(left) == 1, f"superseded dirs not reclaimed: {left}"
+    # the surviving dir is the serving one; queries still work
+    assert a.query('{ q(func: eq(name, "gc-2")) { name } }') == {
+        "q": [{"name": "gc-2"}]}
+    a.wal.close()
+
+
 def test_streaming_fold_carries_ell_cache(seed_ckpt, tmp_path):
     """ISSUE 9 satellite (carried from PR 7): a STREAMING fold
     (MVCCStore.install_fold via checkpoint_streaming) carries

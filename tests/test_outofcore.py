@@ -213,6 +213,153 @@ def test_release_drops_only_streamer_faults(ckpt_dir):
     assert lazy.faults >= 2
 
 
+def test_lazy_folding_read_view_materializes_only_touched(ckpt_dir,
+                                                          tmp_path):
+    """ISSUE-11 tentpole (the second PR-3 cliff): a mutation-bearing
+    read ABOVE the newest fold point on an out-of-core store folds only
+    the tablets the query touches — never the whole store — and the
+    answers match an in-core reference exactly."""
+    import shutil
+
+    from dgraph_tpu.store.mvcc import _LazyFoldPreds
+    from dgraph_tpu.utils.metrics import METRICS
+
+    d0, a_ref = ckpt_dir
+    d = str(tmp_path / "p")
+    shutil.copytree(d0, d)
+    budget = _disk_bytes(d) // 3
+    a = Alpha.open(d, device_threshold=10**9, sync=False,
+                   memory_budget=budget)
+    # a commit above the fold: reads at newer ts need base + delta
+    a.mutate(set_nquads='_:m <name> "zz_above_fold" .')
+    lazy = a.mvcc.base.preds
+    faults0 = lazy.faults
+    lz0 = METRICS.get("read_view_lazy_tablets_total")
+
+    view = a.mvcc.read_view(a.oracle.read_only_ts())
+    assert isinstance(view.preds, _LazyFoldPreds), \
+        "out-of-core view above the fold must be lazily-folding"
+    # a single-predicate query folds a strict subset of the tablets
+    out = a.query('{ q(func: eq(name, "zz_above_fold")) { name } }')
+    assert out == {"q": [{"name": "zz_above_fold"}]}
+    lz = METRICS.get("read_view_lazy_tablets_total") - lz0
+    assert 1 <= lz < 6, (
+        f"query touching one predicate folded {lz} tablets — the view "
+        f"must not materialize the whole store")
+    # the base faulted only what the fold needed, not every tablet
+    assert lazy.faults - faults0 < 6
+    # and the folded view answers every reference query identically
+    ref = Engine(a_ref.mvcc.read_view(a_ref.oracle.read_only_ts()),
+                 device_threshold=10**9)
+    for q in ('{ q(func: eq(name, "p7")) { name follows { name } } }',
+              '{ q(func: eq(name, "p9")) { likes { name score } } }'):
+        assert a.query(q) == ref.query(q), q
+    if a.wal is not None:
+        a.wal.close()
+
+
+def test_corrupt_tablet_typed_refusal_then_replica_heal(ckpt_dir,
+                                                        tmp_path):
+    """ISSUE-11: a tablet fault whose segment fails its digest raises
+    a typed, retryable StorageCorruption NAMING the file; with a heal
+    source armed (the clustered TabletSnapshot path), the same fault
+    heals from the replica copy and serves — counted in
+    storage_heals_total."""
+    import glob
+    import shutil
+
+    from dgraph_tpu.store.vault import StorageCorruption
+    from dgraph_tpu.utils.metrics import METRICS
+
+    d0, a_ref = ckpt_dir
+    d = str(tmp_path / "p")
+    shutil.copytree(d0, d)
+    resolved = checkpoint.resolve(d)
+    victim = glob.glob(os.path.join(resolved,
+                                    "follows.*.fwd.indices.npy"))[0]
+    with open(victim, "r+b") as f:
+        f.seek(os.path.getsize(victim) // 2)
+        f.write(b"\x13\x37")
+
+    store, _ = open_out_of_core(d, 1 << 30)
+    c0 = METRICS.get("storage_corruption_total", file_kind="segment")
+    with pytest.raises(StorageCorruption) as ei:
+        store.preds.get("follows")
+    assert os.path.basename(victim) in str(ei.value)
+    assert StorageCorruption.retryable
+    assert METRICS.get("storage_corruption_total",
+                       file_kind="segment") > c0
+    # other tablets stay serveable — corruption is per-file, not fatal
+    assert store.preds.get("likes").fwd.nnz > 0
+
+    # arm a heal source (what Alpha._heal_corrupt_tablet provides from
+    # a group replica over TabletSnapshot) and re-fault
+    pristine = a_ref.mvcc.base.preds["follows"]
+    store.preds.heal_cb = lambda pred: (pristine
+                                        if pred == "follows" else None)
+    h0 = METRICS.get("storage_heals_total")
+    pd = store.preds.get("follows")
+    assert pd is not None and pd.fwd.nnz == pristine.fwd.nnz
+    assert METRICS.get("storage_heals_total") == h0 + 1
+    # healed tablet serves queries
+    eng = Engine(store, device_threshold=10**9)
+    ref = Engine(a_ref.mvcc.read_view(a_ref.oracle.read_only_ts()),
+                 device_threshold=10**9)
+    q = '{ q(func: eq(name, "p7")) { name follows { name } } }'
+    assert eng.query(q) == ref.query(q)
+
+
+def test_clustered_heal_pulls_real_tablet_snapshot(ckpt_dir, tmp_path):
+    """ISSUE-11 tentpole, cluster leg: on a clustered Alpha a corrupt
+    tablet fault heals over the REAL TabletSnapshot RPC from a group
+    replica before refusing — the disk-side FetchLog heal."""
+    import glob
+    import shutil
+
+    from dgraph_tpu.cluster import start_cluster_alpha
+    from dgraph_tpu.cluster.zero import (ZeroClient, ZeroState,
+                                         make_zero_server)
+    from dgraph_tpu.utils.metrics import METRICS
+
+    d0, _a_ref = ckpt_dir
+    d = str(tmp_path / "pA")
+    shutil.copytree(d0, d)
+    victim = glob.glob(os.path.join(checkpoint.resolve(d),
+                                    "follows.*.fwd.indices.npy"))[0]
+    with open(victim, "r+b") as f:
+        f.seek(os.path.getsize(victim) // 2)
+        f.write(b"\xde\xad")
+
+    zserver, zport, _zs = make_zero_server(ZeroState(replicas=2))
+    zserver.start()
+    zt = f"127.0.0.1:{zport}"
+    store_a, _ = open_out_of_core(d, 1 << 30)   # corrupt on disk
+    store_b, _ = checkpoint.load(d0)            # pristine replica
+    a, sa, _addr_a = start_cluster_alpha(zt, base=store_a,
+                                         device_threshold=10**9)
+    b, sb, _addr_b = start_cluster_alpha(zt, base=store_b,
+                                         device_threshold=10**9)
+    try:
+        assert a.groups.gid == b.groups.gid, "one replica group"
+        zc = ZeroClient(zt)
+        for pred in ("name", "score", "follows", "likes", "rates",
+                     "knows"):
+            zc.should_serve(pred, a.groups.gid)
+        a.groups.refresh()
+        b.groups.refresh()
+        # the wiring Alpha.open performs for out-of-core boots
+        store_a.preds.heal_cb = a._heal_corrupt_tablet
+        h0 = METRICS.get("storage_heals_total")
+        pd = a.mvcc.base.preds.get("follows")
+        assert pd is not None and pd.fwd.nnz > 0
+        assert METRICS.get("storage_heals_total") == h0 + 1
+        assert pd.fwd.nnz == store_b.preds["follows"].fwd.nnz
+    finally:
+        sa.stop(None)
+        sb.stop(None)
+        zserver.stop(None)
+
+
 def test_alpha_open_with_memory_budget(ckpt_dir, tmp_path):
     """The product path: Alpha.open(memory_budget=...) serves queries
     out-of-core, and mutations still commit through MVCC layers on top

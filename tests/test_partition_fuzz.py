@@ -448,23 +448,78 @@ def test_deadline_fault_fuzz_schedule(bank_trio):
 
 # -- whole-node crash faults (ISSUE 5: crash-restart schedule space) ----------
 
+def _make_disk_cb(nodes, addrs, ztarget, sched):
+    """Disk-fault injector (ISSUE 11): one-shot vault IO hook on node
+    src's WAL path. `bitflip` corrupts the next durable record's bytes
+    and `trunc` cuts them short — both leave an acked-but-torn tail
+    that the node's crash-restart must detect (frame CRC) and heal via
+    FetchLog; `enospc` raises before the write, so the commit refuses
+    cleanly and nothing half-applies."""
+    import errno
+
+    from dgraph_tpu.store import vault
+
+    def disk_cb(src, kind):
+        a = nodes[src][0]
+        if a.wal is None:
+            return
+        wpath = a.wal.path
+        armed = [True]
+
+        def hook(path, data):
+            if not armed[0] or path != wpath:
+                return data
+            armed[0] = False
+            if kind == "enospc":
+                raise OSError(errno.ENOSPC, "injected ENOSPC", path)
+            if kind == "trunc":
+                return data[:max(1, len(data) // 2)]
+            b = bytearray(data)  # bitflip mid-frame
+            b[len(b) // 2] ^= 0x40
+            return bytes(b)
+
+        vault.set_io_fault(hook)
+        try:
+            # drive one durable write through the armed hook; the
+            # partition may refuse it first (then no fault landed)
+            a.mutate(set_nquads=f'_:d <name> "disk-{kind}-{src}" .')
+        except OSError:
+            assert kind == "enospc"  # the only raising kind
+        except (NoQuorum, ReadUnavailable):
+            pass
+        finally:
+            vault.set_io_fault(None)
+        if kind != "enospc" and not armed[0] and src not in sched.crashed:
+            # durable state damaged: crash-restart so recovery runs —
+            # the torn tail is cut at the CRC and healed via FetchLog
+            _kill_node(nodes, src)
+            _restart_node(nodes, addrs, ztarget, src)
+
+    return disk_cb
+
+
 def _run_crash_fuzz(bank_trio, seeds):
     """Seeded schedules mixing CRASH/RESTART with partition, delay,
-    WAL-truncation, and deadline faults. A crashed node refuses all
-    RPCs in both directions (its grpc server is stopped) and loses all
+    WAL-truncation, deadline, and DISK faults (bitflip/trunc/enospc
+    through the vault IO hook). A crashed node refuses all RPCs in
+    both directions (its grpc server is stopped) and loses all
     volatile state; its restart rebuilds from the WAL and must catch up
     via FetchLog before converging. Per seed: minority/dead refusal,
     balance invariant, post-heal convergence, no leaked pends, and
-    crash events visible in peer_crashes_total."""
+    crash/disk events visible in peer_crashes_total /
+    fault_disk_events_total."""
     nodes, addrs, uids = bank_trio
     ztarget = nodes[0][0].groups.zero.targets[0]
     crashes0 = _counter_sum("peer_crashes_total")
+    disk0 = _counter_sum("fault_disk_events_total")
     crash_events = 0
+    disk_events = 0
     for seed in seeds:
         sched = FaultSchedule(seed, len(nodes), crash=True,
-                              wal_trunc=True, deadline=True)
+                              wal_trunc=True, deadline=True, disk=True)
         crash_events += sum(op == "crash" for op, *_ in sched.events)
         rng = random.Random(seed ^ 0x9E3779B9)
+        disk_cb = _make_disk_cb(nodes, addrs, ztarget, sched)
 
         def crash_cb(src, up):
             if up:
@@ -492,10 +547,13 @@ def _run_crash_fuzz(bank_trio, seeds):
             for ev in sched.events:
                 # re-list each event: a restart swaps a node object
                 groups = [a.groups for a, _s in nodes]
+                disk_events += ev[0].startswith("disk_") and \
+                    ev[1] not in sched.crashed
                 sched.apply_event(ev, groups, addrs,
                                   wal_trunc_cb=wal_trunc_cb,
                                   deadline_cb=deadline_cb,
-                                  crash_cb=crash_cb)
+                                  crash_cb=crash_cb,
+                                  disk_cb=disk_cb)
                 for _ in range(2):
                     k = rng.randrange(len(nodes))
                     if k in sched.crashed:
@@ -529,6 +587,9 @@ def _run_crash_fuzz(bank_trio, seeds):
     if crash_events:
         assert _counter_sum("peer_crashes_total") - crashes0 \
             >= crash_events
+    if disk_events:
+        assert _counter_sum("fault_disk_events_total") - disk0 \
+            >= disk_events
 
 
 def test_crash_restart_fuzz_schedule(bank_trio):
@@ -544,7 +605,8 @@ def test_crash_restart_fuzz_schedule(bank_trio):
                    for s in seeds
                    for op, *_ in FaultSchedule(s, 3, crash=True,
                                                wal_trunc=True,
-                                               deadline=True).events)
+                                               deadline=True,
+                                               disk=True).events)
     _run_crash_fuzz(bank_trio, seeds)
     # crash/restart churn must not surface a lock-order inversion either
     from dgraph_tpu.utils import locks
@@ -560,6 +622,30 @@ def test_crash_restart_fuzz_full(bank_trio):
     seeds = ([int(env_seed)] if env_seed
              else [62000 + i for i in range(25)])
     _run_crash_fuzz(bank_trio, seeds)
+
+
+def test_disk_fault_fuzz_smoke(bank_trio):
+    """ISSUE-11 tier-1 smoke: seeds chosen so the schedules contain
+    every DISK sub-kind (bitflip, trunc, enospc — the vault IO hook
+    path) mixed with the full crash/partition space. Each seed rides
+    the standard crash-fuzz invariants: a damaged WAL tail is cut at
+    the frame CRC on restart and healed via FetchLog, an ENOSPC'd
+    commit refuses without half-applying — money never leaks,
+    replicas converge, disk events are metric-visible."""
+    env_seed = os.environ.get("DGRAPH_TPU_FUZZ_SEED")
+    seeds = [int(env_seed)] if env_seed else [71009, 71011, 71061]
+    if not env_seed:
+        kinds = {op for s in seeds
+                 for op, *_ in FaultSchedule(s, 3, crash=True,
+                                             wal_trunc=True,
+                                             deadline=True,
+                                             disk=True).events
+                 if op.startswith("disk_")}
+        assert kinds == {"disk_bitflip", "disk_trunc", "disk_enospc"}, (
+            f"chosen seeds must cover every disk sub-kind, got {kinds}")
+    d0 = _counter_sum("fault_disk_events_total")
+    _run_crash_fuzz(bank_trio, seeds)
+    assert _counter_sum("fault_disk_events_total") > d0
 
 
 # golden schedules captured from the PRE-crash-fault generator: the
@@ -586,6 +672,20 @@ _GOLDEN_SCHEDULES = {
         ("heal", 2, 0, 0.0), ("drop", 1, 2, 0.0),
         ("delay", 2, 0, 0.0153), ("drop", 0, 1, 0.0),
         ("drop", 0, 2, 0.0)],
+    # the PRE-disk crash space (PR 5's generator): the disk extension
+    # must not shift a single rng draw when its flag is off
+    (61000, ("crash", "wal_trunc", "deadline")): [
+        ("drop", 1, 2, 0.0), ("heal", 2, 1, 0.0),
+        ("delay", 1, 2, 0.0068), ("drop", 0, 1, 0.0),
+        ("delay", 0, 1, 0.0134), ("crash", 1, 0, 0.0),
+        ("crash", 2, 1, 0.0), ("heal", 2, 1, 0.0)],
+    # the full space INCLUDING disk (ISSUE 11's generator) — pins the
+    # new family's generation for every future extension
+    (71009, ("crash", "wal_trunc", "deadline", "disk")): [
+        ("disk_enospc", 1, 2, 0.0), ("wal_trunc", 2, 1, 0.0),
+        ("disk_trunc", 0, 2, 0.0), ("heal", 0, 1, 0.0),
+        ("heal", 2, 0, 0.0), ("crash", 2, 0, 0.0),
+        ("disk_trunc", 1, 0, 0.0), ("drop", 2, 0, 0.0)],
 }
 
 
