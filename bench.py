@@ -584,11 +584,18 @@ def run_sched_workload(priors_on: bool, chain_n: int = 2000,
             return False
 
         lane = adm.lanes["read"]
+
+        def lane_state():
+            # under the lane lock: request threads mutate these and the
+            # race sanitizer (rightly) convicts an unlocked poll
+            with lane.lock:
+                return lane.inflight, len(lane.waiters)
+
         submit(exp_q, "expensive")
-        wait_for(lambda: lane.inflight >= 1)
+        wait_for(lambda: lane_state()[0] >= 1)
         for _ in range(n_expensive - 1):
             submit(exp_q, "expensive")
-        wait_for(lambda: len(lane.waiters) >= n_expensive - 1)
+        wait_for(lambda: lane_state()[1] >= n_expensive - 1)
         for q in cheap_qs:
             submit(q, "cheap")
             time.sleep(0.01)

@@ -8,7 +8,8 @@ resilience wrapper for every outbound RPC, monotonic clocks in budget
 arithmetic, retry policies that never re-spend an expired deadline,
 bounded metric label spaces, jit-path purity) — but only as convention.
 This package is the `go vet` analog: a pluggable AST lint framework
-with codebase-specific rules (see `rules.py`, R1–R6), run by tier-1
+with codebase-specific rules (R1–R8 in `rules.py`; the graftrace
+lock-discipline rules R9–R12 in `guards.py`), run by tier-1
 (`tests/test_lint.py`) over the whole package so a perf refactor that
 silently drops an invariant fails the build, not the next incident.
 
@@ -241,8 +242,12 @@ class Analyzer:
 
     def counts(self) -> dict[str, dict[str, int]]:
         """{"findings": {rule: unwaived}, "waived": {rule: waived}} —
-        the shape bench.py embeds into BENCH JSON."""
-        out = {"findings": {}, "waived": {}}
+        the shape bench.py embeds into BENCH JSON. Every active rule
+        is pre-seeded at 0 so the BENCH trajectory shows a clean rule
+        AS clean instead of omitting it (a new rule's debt is visible
+        from its first run)."""
+        out = {"findings": {r.name: 0 for r in self.rules},
+               "waived": {r.name: 0 for r in self.rules}}
         for f in self.findings:
             bucket = "waived" if f.waived else "findings"
             out[bucket][f.rule] = out[bucket].get(f.rule, 0) + 1

@@ -18,7 +18,8 @@ from dgraph_tpu.analysis import Analyzer, default_paths
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m dgraph_tpu.analysis",
-        description="graftlint: AST invariant checker (rules R1-R6)")
+        description="graftlint: AST invariant checker (rules R1-R12, "
+                    "incl. the graftrace lock-discipline rules)")
     ap.add_argument("paths", nargs="*", type=pathlib.Path,
                     help="files/dirs to scan (default: the package "
                          "+ bench.py)")

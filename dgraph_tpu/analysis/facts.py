@@ -15,6 +15,13 @@ so the same pass extracts:
 * **metrics** — every literal registration (name, kind, site).
 * **locks** — every `make_lock/make_rlock/make_condition` order class,
   the static side of the lock sanitizer's graph.
+* **guarded_fields** — the lock-discipline inventory (ISSUE 12,
+  `guards.py`): per class, which fields are written under which lock —
+  what rules R9–R11 enforce statically and what `locks.guarded()` arms
+  dynamically under DGRAPH_TPU_RACE_SANITIZER=1. `guarded_sites` lists
+  every runtime `guarded(self, …)` arming call, so test_lint.py can
+  pin the static inventory and the dynamic registry to each other in
+  BOTH directions (the `cost_record_fields` pattern).
 * **cost_record_fields** — the runtime cost-record schema
   (utils/costprofile.FIELDS, re-exported verbatim): the static
   inventory and the runtime records SHARE this vocabulary, so a
@@ -35,21 +42,50 @@ _LOCK_FNS = {"make_lock": "lock", "make_rlock": "rlock",
              "make_condition": "condition"}
 
 
+def _guarded_sites(ctx) -> list[dict]:
+    """Every `locks.guarded(self, "<lock>")` arming call, tagged with
+    its enclosing class — the dynamic registry's static footprint."""
+    out = []
+    for cls in ast.walk(ctx.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        for node in ast.walk(cls):
+            if not (isinstance(node, ast.Call)
+                    and _dotted(node.func).rsplit(".", 1)[-1]
+                    == "guarded"
+                    and node.args
+                    and isinstance(node.args[0], ast.Name)
+                    and node.args[0].id == "self"):
+                continue
+            lock = (node.args[1].value
+                    if len(node.args) > 1
+                    and isinstance(node.args[1], ast.Constant)
+                    else "?")
+            out.append({"class": cls.name, "file": ctx.rel,
+                        "line": node.lineno, "lock": lock})
+    return out
+
+
 def _dotted(node: ast.AST) -> str:
     from dgraph_tpu.analysis.rules import _dotted as d
     return d(node)
 
 
 def extract_facts(contexts) -> dict:
+    from dgraph_tpu.analysis.guards import class_inventory
     from dgraph_tpu.analysis.rules import JitPurity
 
     kernels, launches, spans, locks = [], [], [], []
     metrics: list[dict] = []
+    guarded_fields: list[dict] = []
+    guarded_sites: list[dict] = []
     jit_rule = JitPurity()
     for ctx in contexts:
         if not (ctx.rel.startswith("dgraph_tpu/")
                 or ctx.rel == "bench.py"):
             continue
+        guarded_fields.extend(class_inventory(ctx))
+        guarded_sites.extend(_guarded_sites(ctx))
         for fn, statics in jit_rule._jitted_functions(ctx.tree):
             kernels.append({
                 "name": fn.name, "file": ctx.rel, "line": fn.lineno,
@@ -100,6 +136,8 @@ def extract_facts(contexts) -> dict:
         "span_sites": spans,
         "metric_sites": metrics,
         "lock_classes": locks,
+        "guarded_fields": guarded_fields,
+        "guarded_sites": guarded_sites,
         "cost_record_fields": cost_fields,
         "cost_prior_features": prior_features,
         "totals": {
@@ -108,6 +146,11 @@ def extract_facts(contexts) -> dict:
             "span_names": len({s["name"] for s in spans}),
             "metric_names": len({m["name"] for m in metrics}),
             "lock_classes": len({x["name"] for x in locks}),
+            "guarded_classes": len({(g["file"], g["class"])
+                                    for g in guarded_fields}),
+            "guarded_fields": sum(len(g["fields"])
+                                  for g in guarded_fields),
+            "guarded_sites": len(guarded_sites),
             "cost_record_fields": len(cost_fields),
             "cost_prior_features": len(prior_features),
         },

@@ -32,6 +32,9 @@ R8 atomic-write          durable files under store/ (and
                          os.replace — a bare `open(..., "w"/"wb")`
                          there can tear under a kill where a reader
                          expects a whole file (ISSUE-11).
+
+R9–R12 (lock discipline / data races) live in `guards.py` — the
+Eraser-style static half of the race sanitizer (ISSUE 12).
 """
 
 from __future__ import annotations
@@ -516,6 +519,7 @@ class AtomicWrite(Rule):
 
 
 def default_rules() -> list[Rule]:
+    from dgraph_tpu.analysis.guards import guard_rules
     return [HotLoopCheckpoint(), DirectIO(), WallClock(),
             RetryDeadline(), MetricDocs(), JitPurity(),
-            ShardMapCompat(), AtomicWrite()]
+            ShardMapCompat(), AtomicWrite()] + guard_rules()

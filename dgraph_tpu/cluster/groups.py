@@ -40,6 +40,7 @@ class Groups:
         self._groups: dict[int, dict[int, str]] = {}
         self._counter = -1
         self.refresh()
+        locks.guarded(self, "groups.pool")
 
     # -- membership ----------------------------------------------------------
     def refresh(self) -> None:

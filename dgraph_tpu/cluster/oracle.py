@@ -57,6 +57,7 @@ class Oracle:
         # sha1 fingerprint of conflict key → commit_ts of the last writer
         self._commits: dict[str, int] = {}
         self._max_assigned = first_ts - 1
+        locks.guarded(self, "oracle.state")
 
     # -- timestamps ---------------------------------------------------------
     def read_ts(self) -> int:

@@ -128,6 +128,7 @@ class PeerTable:
         self._lock = locks.make_lock("resilience.peers")
         self._peers: dict[str, _Peer] = {}
         self._rng = random.Random(0xD6B2E55)  # jitter only, never schedules
+        locks.guarded(self, "resilience.peers")
 
     # -- state machine -------------------------------------------------------
     def _peer(self, addr: str) -> _Peer:

@@ -122,6 +122,7 @@ class ZeroState:
         for nodes in self.groups.values():
             for nid in nodes:
                 self.last_seen.setdefault(nid, now)
+        locks.guarded(self, "zero.state")
 
     def _replay(self, doc: dict) -> None:
         import time as _time
@@ -203,6 +204,17 @@ class ZeroState:
         self._doc_base += drop
         del self.doc_log[:drop]
         del self._blocks_at[:drop]
+
+    def replica_cursor(self) -> tuple:
+        """(applied journal seq, standby?, log identity) read under
+        the lock — what every journal-tail response, election probe,
+        and standby resume needs. These fields are written under the
+        lock by the replay/promote/reset paths on OTHER threads; the
+        race sanitizer caught the former unlocked reads (a restarted
+        standby daemon racing its predecessor's epoch)."""
+        with self._lock:
+            return (self._doc_base + len(self.doc_log), self.standby,
+                    self.log_id)
 
     def persist_leases(self) -> None:
         """Journal the lease watermarks at block granularity — called on
@@ -339,10 +351,15 @@ class ZeroState:
         from dgraph_tpu.utils.metrics import METRICS
         METRICS.inc("election_promoted_total")
         margin = (MAX_UNACKED_BLOCKS + 1) * LEASE_BLOCK
-        floor = max(self.oracle.max_assigned, self._ts_block)
+        # read the replayed lease blocks under the lock (a straggling
+        # apply_remote pull may still be advancing them); the oracle
+        # bumps stay outside — the oracle has its own lock
+        with self._lock:
+            ts_block, uid_block = self._ts_block, self._uid_block
+        floor = max(self.oracle.max_assigned, ts_block)
         self.oracle.bump_ts((floor // LEASE_BLOCK) * LEASE_BLOCK + margin)
         self.oracle.bump_uid(
-            (max(self.oracle.max_uid, self._uid_block) // LEASE_BLOCK)
+            (max(self.oracle.max_uid, uid_block) // LEASE_BLOCK)
             * LEASE_BLOCK + margin)
         import time as _time
         now = _time.monotonic()
@@ -444,7 +461,11 @@ class ZeroState:
         a destination (`zero_moves_skipped_unhealthy_total`)."""
         from dgraph_tpu.utils.metrics import METRICS
         bad = self.unhealthy_addrs()           # takes the lock itself
-        cost = {g: self.group_cost_load(g) for g in list(self.groups)}
+        # snapshot the group ids under the lock; group_cost_load takes
+        # the (non-reentrant) lock itself, so it cannot run inside it
+        with self._lock:
+            gids = list(self.groups)
+        cost = {g: self.group_cost_load(g) for g in gids}
         with self._lock:
             if len(self.groups) < 2:
                 return None
@@ -571,7 +592,7 @@ class ZeroService:
         standby — a client holding both addresses must not split-brain
         the lease space (reference: only the group-0 raft leader
         serves)."""
-        if self.state.standby:
+        if self.state.replica_cursor()[1]:
             ctx.abort(grpc.StatusCode.FAILED_PRECONDITION,
                       "zero is a standby (not promoted)")
 
@@ -629,15 +650,13 @@ class ZeroService:
             # election probe: report applied seq WITHOUT the replication
             # ACK side effect (journal_tail treats `since` as an ack and
             # would pin the lease floor / freshen standby liveness)
-            with self.state._lock:
-                nxt = self.state._doc_base + len(self.state.doc_log)
+            nxt, standby, log_id = self.state.replica_cursor()
             return pb.JournalDocs(docs_json=[], next=nxt,
-                                  standby=self.state.standby,
-                                  log_id=self.state.log_id)
+                                  standby=standby, log_id=log_id)
         docs, nxt = self.state.journal_tail(int(req.since))
+        _seq, standby, log_id = self.state.replica_cursor()
         return pb.JournalDocs(docs_json=docs, next=nxt,
-                              standby=self.state.standby,
-                              log_id=self.state.log_id)
+                              standby=standby, log_id=log_id)
 
     def ReportTablets(self, req: pb.TabletSizes, ctx) -> pb.Payload:
         self.state.report_sizes(int(req.group), dict(req.sizes))
@@ -672,8 +691,9 @@ class ZeroService:
             self.state.oracle.abort(int(req.start_ts))
             return pb.TxnContext(start_ts=req.start_ts, aborted=True)
         self._lease_gate(ctx)
-        if self.state.promote_floor and \
-                int(req.start_ts) <= self.state.promote_floor:
+        with self.state._lock:
+            promote_floor = self.state.promote_floor
+        if promote_floor and int(req.start_ts) <= promote_floor:
             # the txn began under the dead primary: its conflict history
             # (and any concurrent committers it raced) died with that
             # process — abort rather than risk a lost-update
@@ -814,7 +834,7 @@ def elect_better(state: ZeroState, my_addr: str, peers,
     (the ack only ratchets up), so safety holds — the cost is spurious
     RESOURCE_EXHAUSTED retries during a mixed-version rollout."""
     from dgraph_tpu.utils.metrics import METRICS
-    my_seq = state._doc_base + len(state.doc_log)
+    my_seq = state.replica_cursor()[0]
     best = None
     reachable = 1                     # self
     for addr in peers:
@@ -876,8 +896,8 @@ def run_standby(state: ZeroState, primary_addr: str, poll_s: float = 1.0,
             "elections are the default; this opt-out trades that safety "
             "for promotion while the electorate is unreachable.")
     client = ZeroClient(primary_addr)
-    since = state._doc_base + len(state.doc_log)
-    expect_id = state.log_id or None
+    since, _standby_now, my_log_id = state.replica_cursor()
+    expect_id = my_log_id or None
     last_ok = _time.monotonic()
     apply_fails = 0  # consecutive replica-apply failures (backoff)
     # graftlint: allow(hot-loop-checkpoint, retry-deadline): daemon tail
@@ -1155,6 +1175,7 @@ class RemoteOracle:
         self._lock = locks.make_lock("zero.remote_oracle")
         self._local_pending: set[int] = set()
         self._max_seen = 0
+        locks.guarded(self, "zero.remote_oracle")
 
     def read_ts(self) -> int:
         ts = self.zero.read_ts()

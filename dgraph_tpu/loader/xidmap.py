@@ -21,6 +21,7 @@ class XidMap:
             (locks.make_lock("xidmap.shard"), {}) for _ in range(shards)]
         self._pool_lock = locks.make_lock("xidmap.pool")
         self._pool: list[int] = []
+        locks.guarded(self, "xidmap.pool")
 
     def _lease(self) -> int:
         with self._pool_lock:

@@ -121,6 +121,7 @@ class _Lane:
         self._last_activity = time.monotonic()
         # predicted µs currently admitted (cost-aware retry hints)
         self.inflight_cost_us = 0.0
+        locks.guarded(self, "admission.*")
 
     # -- gauges ---------------------------------------------------------------
     def _publish(self) -> None:
@@ -256,6 +257,7 @@ class _Lane:
                     if w.granted:
                         break
                     if not w.displaced:
+                        # graftlint: allow(split-critical-section): the deadline-withdraw path — w.granted/w.displaced are re-validated under THIS acquisition before the waiter removes itself; a grant that raced the timeout wins (the break above)
                         self.waiters.remove(w)
                         self.shed_total += 1
                         self._publish()
@@ -357,12 +359,22 @@ class AdmissionController:
             ln.release(time.perf_counter() - t0, cost_us=cost_us)
 
     def queued(self) -> int:
-        return sum(len(ln.waiters) for ln in self.lanes.values())
+        total = 0
+        for ln in self.lanes.values():
+            with ln.lock:
+                total += len(ln.waiters)
+        return total
 
     def saturated(self) -> bool:
         """True while real traffic is queued — the signal maintenance
-        yields to at tablet boundaries."""
-        return any(ln.waiters for ln in self.lanes.values())
+        yields to at tablet boundaries. Reads the queues under each
+        lane's lock (ISSUE-12 audit): the maintenance thread polls
+        this while request threads append/remove waiters."""
+        for ln in self.lanes.values():
+            with ln.lock:
+                if ln.waiters:
+                    return True
+        return False
 
     def status(self) -> dict:
         return {"lanes": {name: ln.status()

@@ -141,6 +141,7 @@ class Alpha:
         self._gc_tick = 0
         if base is not None and base.n_nodes:
             self.oracle.bump_uid(int(base.uids[-1]))
+        locks.guarded(self, "alpha.state")
 
     @classmethod
     def open(cls, p_dir: str, device_threshold: int = 512,
@@ -260,10 +261,13 @@ class Alpha:
         self._last_sent_ts = max_ts
         # re-arm undecided staged records (still durable, still
         # invisible): a peer's decision marker or catch-up resolves them
-        # post-restart; origin 0 = unknown after restart
-        for ts, mut in pends.items():
-            if not self.mvcc.has_applied(ts):
-                self._pending[ts] = (mut, 0)
+        # post-restart; origin 0 = unknown after restart. Under the
+        # state lock: attach_wal runs at boot, but a cluster restart
+        # can already be receiving chained broadcasts on gRPC threads
+        with self._state_lock:
+            for ts, mut in pends.items():
+                if not self.mvcc.has_applied(ts):
+                    self._pending[ts] = (mut, 0)
         self.wal = WAL(wal_path, sync=sync)
         return max_ts, max_uid
 
@@ -297,6 +301,7 @@ class Alpha:
             checkpoint.save_versioned(store, p_dir, base_ts=ts)
             if self.wal is not None:
                 self.wal.truncate(ts)
+            # graftlint: allow(split-critical-section): exclusive branches — the streaming path RETURNED above; the two acquisitions never run in one call
             self._wal_floor = max(self._wal_floor, ts)
         self._save_costprofiles(p_dir)
         return ts
@@ -478,6 +483,7 @@ class Alpha:
                     or self.mvcc.floor_ts() <= ts):
                 break
             with self._state_lock:
+                # graftlint: allow(split-critical-section): the register/recheck/unregister retry protocol documented above — each acquisition is an independent refcount step, and the gc race it exists to close is re-checked per attempt
                 self._active_reads[ts] -= 1
                 if not self._active_reads[ts]:
                     del self._active_reads[ts]
@@ -485,6 +491,7 @@ class Alpha:
             yield ts
         finally:
             with self._state_lock:
+                # graftlint: allow(split-critical-section): refcount release — the earlier read registered this ts; decrementing in its own acquisition is the protocol, not check-then-act
                 self._active_reads[ts] -= 1
                 if not self._active_reads[ts]:
                     del self._active_reads[ts]
@@ -600,6 +607,7 @@ class Alpha:
                 continue
             pend_origins.discard(node)  # resolved, or truly undecided
             with self._state_lock:
+                # graftlint: allow(split-critical-section): the pop lands only after a COMPLETED catch-up covering everything this gap recorded; a gap recorded concurrently re-arms on the next chained receive or read probe
                 self._origin_gaps.pop(node, None)
             gaps.pop(node, None)
             if seen >= head:
@@ -669,6 +677,7 @@ class Alpha:
                     f"here; retry")
         else:
             with self._state_lock:
+                # graftlint: allow(split-critical-section): monotonic freshness stamp — whichever verification finishes last wins, and any concurrent write only ADVANCES the lease; no decision was made on the earlier read
                 self._read_verified_at = _time.monotonic()
 
     def query(self, dql: str, variables: dict | None = None,
@@ -1276,6 +1285,7 @@ class Alpha:
                 origin, since_ts, e)
         else:
             with self._state_lock:
+                # graftlint: allow(split-critical-section): pop only after this call's own catch_up SUCCEEDED; a concurrently recorded gap re-arms on the next chained receive or read probe
                 self._origin_gaps.pop(origin, None)
 
     def receive_stage(self, mut: Mutation, ts: int, origin: int,
@@ -1362,6 +1372,7 @@ class Alpha:
         with self._state_lock:
             orphans = [t for t in stale if t in self._pending]
             for t in orphans:
+                # graftlint: allow(split-critical-section): re-validated — only ts still in _pending under THIS acquisition are deleted; a decision that raced the fetch already removed its entry
                 del self._pending[t]
         if self.wal is not None:
             for t in orphans:
@@ -1511,8 +1522,10 @@ class Alpha:
         log = xlog.get("alpha")
         replicas = [a for a in self.groups.group_addrs(self.groups.gid)
                     if a != self.groups.my_addr]
+        with self._state_lock:
+            known_versions = set(self.tablet_versions)
         owned = [p for p in set(self.mvcc.base.preds)
-                 | set(self.tablet_versions) if self.groups.serves(p)]
+                 | known_versions if self.groups.serves(p)]
         if not replicas:
             if owned:
                 log.error(
@@ -1641,6 +1654,7 @@ class Alpha:
             # of refetching. Only the latest width is retained.
             for k in [k for k in self._tablet_cache
                       if k[0] == pred and len(k) == 3 and k[2] != n]:
+                # graftlint: allow(split-critical-section): idempotent cache fill — concurrent fillers install equivalent adaptations for the same (pred, version, n) key, and stale widths are simply re-deleted
                 del self._tablet_cache[k]
             self._tablet_cache[(pred, version, n)] = adapted
         return adapted

@@ -184,7 +184,7 @@ def make_http_server(alpha: Alpha, addr: str = "127.0.0.1",
                           "groups": {str(g): group_doc(grp)
                                      for g, grp in ms.groups.items()},
                           "dead": sorted(dead),
-                          "maxUID": alpha.mvcc.max_uid_seen,
+                          "maxUID": alpha.mvcc.uid_high(),
                           "maxTxnTs": alpha.oracle.max_assigned}
                 else:
                     st = {"counter": alpha.oracle.max_assigned,
@@ -195,7 +195,7 @@ def make_http_server(alpha: Alpha, addr: str = "127.0.0.1",
                                           for p in
                                           alpha.mvcc.schema.predicates}}},
                           "dead": [],
-                          "maxUID": alpha.oracle._next_uid - 1,
+                          "maxUID": alpha.oracle.max_uid,
                           "maxTxnTs": alpha.oracle.max_assigned}
                 self._send(200, st)
             elif self.path == "/debug/prometheus_metrics":
@@ -282,6 +282,13 @@ def make_http_server(alpha: Alpha, addr: str = "127.0.0.1",
                 # DGRAPH_TPU_LOCK_SANITIZER=1, else a stub)
                 from dgraph_tpu.utils import locks
                 self._send(200, locks.GRAPH.snapshot())
+            elif self.path.startswith("/debug/races"):
+                # Eraser lockset race sanitizer state (ISSUE 12):
+                # tracked classes + every report, each with both
+                # access stacks (utils/locks.py; enabled under
+                # DGRAPH_TPU_RACE_SANITIZER=1, else a stub)
+                from dgraph_tpu.utils import locks
+                self._send(200, locks.RACES.snapshot())
             elif self.path.startswith("/debug/peers"):
                 # per-peer resilience state: breaker state, EMA
                 # latency, consecutive failures, last error — the

@@ -97,6 +97,7 @@ class MaintenanceScheduler:
         self._last_checkpoint = time.monotonic()
         self.jobs_done = 0
         self.jobs_failed = 0
+        locks.guarded(self, "maintenance.cv")
 
     # -- lifecycle -----------------------------------------------------------
     def start(self) -> "MaintenanceScheduler":
@@ -178,11 +179,18 @@ class MaintenanceScheduler:
                               job=self._running or ""):
                 limit = t0 + self.LOAD_YIELD_MAX_S
                 while (adm.saturated() and self._resume.is_set()
-                       and not self._stop
+                       and not self._stopping()
                        and time.perf_counter() < limit):
                     time.sleep(0.01)
             METRICS.observe("maintenance_pause_wait_us",
                             (time.perf_counter() - t0) * 1e6)
+
+    def _stopping(self) -> bool:
+        """`_stop` read under the cv — the yield loop above polls it
+        from the job thread while stop() flips it under the same lock
+        (10 ms cadence: an uncontended acquire per poll is noise)."""
+        with self._cv:
+            return self._stop
 
     # -- requests ------------------------------------------------------------
     def _submit(self, job: Job) -> Job:

@@ -174,6 +174,7 @@ class _LazyFoldPreds:
         self._names = set(fold_preds(base, pending))
         self._done: dict[str, object] = {}
         self._lock = locks.make_lock("mvcc.lazyview")
+        locks.guarded(self, "mvcc.lazyview")
 
     def size_hints(self) -> dict:
         """Delegate to the base checkpoint's manifest sizes (the
@@ -192,6 +193,7 @@ class _LazyFoldPreds:
                 return pd if pd is not None else default
         pd = self._fold(pred)
         with self._lock:
+            # graftlint: allow(split-critical-section): double-checked fold — setdefault re-validates under the reacquisition; when two threads fold the same tablet concurrently the first install wins and both return it
             self._done.setdefault(pred, pd)
             pd = self._done[pred]
         return pd if pd is not None else default
@@ -255,6 +257,7 @@ class MVCCStore:
         # highest uid this store has ever held — the heartbeat watermark
         # that seeds a promoted standby zero's uid lease floor
         self.max_uid_seen = int(base.uids[-1]) if base.n_nodes else 0
+        locks.guarded(self, "mvcc.store")
 
     # -- current base (newest fold point) ------------------------------------
     @property
@@ -287,6 +290,12 @@ class MVCCStore:
                           key=lambda l: l.commit_ts)
             self.max_uid_seen = max(self.max_uid_seen,
                                     max(mut.all_uids(), default=0))
+
+    def uid_high(self) -> int:
+        """`max_uid_seen` read under the lock — the accessor debug
+        surfaces (`/state`) use while apply threads advance it."""
+        with self._lock:
+            return self.max_uid_seen
 
     def has_applied(self, commit_ts: int) -> bool:
         """Whether a commit_ts is present as a retained delta layer.

@@ -100,6 +100,19 @@ class LazyPreds:
         # The healed copy serves in memory; the corrupt on-disk segment
         # is rewritten by the next checkpoint/fold.
         self.heal_cb = None
+        locks.guarded(self, "outofcore.residency")
+
+    def stats(self) -> dict[str, int]:
+        """Residency counters read under the lock — the ONLY way other
+        threads (streaming maintenance accounting, debug surfaces) may
+        observe them: fault/evict mutate the set pairwise and an
+        unlocked peek is exactly the race the sanitizer flags."""
+        with self._lock:
+            return {"resident_bytes": self.resident_bytes,
+                    "peak_resident_bytes": self.peak_resident_bytes,
+                    "faults": self.faults,
+                    "evictions": self.evictions,
+                    "releases": self.releases}
 
     def size_hints(self) -> dict[str, int]:
         """Per-tablet byte sizes from the manifest, WITHOUT faulting —
@@ -107,12 +120,13 @@ class LazyPreds:
         the whole store in. Old checkpoints without recorded sizes
         report resident tablets only."""
         out = {}
-        for pred, meta in self._meta.items():
-            nb = meta.get("nbytes")
-            if nb is not None:
-                out[pred] = int(nb)
-            elif pred in self._sizes:
-                out[pred] = self._sizes[pred]
+        with self._lock:  # fault/evict threads mutate _sizes pairwise
+            for pred, meta in self._meta.items():
+                nb = meta.get("nbytes")
+                if nb is not None:
+                    out[pred] = int(nb)
+                elif pred in self._sizes:
+                    out[pred] = self._sizes[pred]
         return out
 
     # -- mapping surface the engine uses -------------------------------------
@@ -215,6 +229,7 @@ class LazyPreds:
                     # a concurrent path re-installed this tablet while we
                     # were loading: replacing must not double-charge the
                     # budget — retire the old accounting first
+                    # graftlint: allow(split-critical-section): the in-flight-event protocol — the cold load runs outside the lock BY DESIGN (a seconds-long load must not freeze readers), and this reacquisition re-validates _sizes/_resident before installing
                     self._resident.pop(pred, None)
                     self.resident_bytes -= prev
                 self._resident[pred] = pd
@@ -239,6 +254,7 @@ class LazyPreds:
             return pd
         finally:
             with self._lock:
+                # graftlint: allow(split-critical-section): the in-flight event this same thread INSTALLED in the first acquisition is retired here; waiters re-loop and re-validate residency themselves
                 self._inflight.pop(pred, None)
             ev.set()
 

@@ -50,9 +50,16 @@ def lazy_preds(store: Store):
     return preds if isinstance(preds, LazyPreds) else None
 
 
+def _evicted(lazy) -> int:
+    st = lazy.stats()  # locked accessor: serving threads fault/evict
+    return st["evictions"] + st["releases"]
+
+
 def _account(lazy, evicted_before: int) -> None:
-    METRICS.set_gauge("maintenance_resident_bytes", lazy.resident_bytes)
-    delta = (lazy.evictions + lazy.releases) - evicted_before
+    st = lazy.stats()
+    METRICS.set_gauge("maintenance_resident_bytes",
+                      st["resident_bytes"])
+    delta = (st["evictions"] + st["releases"]) - evicted_before
     if delta > 0:
         METRICS.inc("maintenance_evictions_total", float(delta))
 
@@ -69,7 +76,7 @@ def iter_tablets(store: Store, release: bool = True, pace=None,
     lazy = lazy_preds(store)
     for pred in sorted(store.preds.keys()):
         was_resident = lazy.is_resident(pred) if lazy is not None else True
-        evicted0 = (lazy.evictions + lazy.releases) if lazy else 0
+        evicted0 = _evicted(lazy) if lazy else 0
         with tracing.span("maintenance.tablet", pred=pred, job=job):
             pd = store.preds.get(pred)
             if pd is not None:
@@ -135,7 +142,7 @@ def write_fold(mvcc: MVCCStore, dirname: str, plan=None,
     preds_meta = {}
     for pred in fold_preds(base, pending):
         was_resident = lazy.is_resident(pred) if lazy is not None else True
-        evicted0 = (lazy.evictions + lazy.releases) if lazy else 0
+        evicted0 = _evicted(lazy) if lazy else 0
         with tracing.span("maintenance.tablet", pred=pred, job=job):
             # the same fold code path the in-core rollup runs, restricted
             # to one predicate with the vocabulary pinned — per-tablet

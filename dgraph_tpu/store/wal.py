@@ -131,6 +131,7 @@ class Journal:
         self._f = open(path, "ab")
         if needs_reseal:
             self._reseal_legacy()
+        locks.guarded(self, "wal.write")
 
     def _reseal_legacy(self) -> None:
         """Legacy frames (pre-ordinal DGW1, or plaintext written before
@@ -203,7 +204,12 @@ class Journal:
             yield json.loads(_dec_payload(payload, seq, legacy))
 
     def close(self) -> None:
-        self._f.close()
+        # under the write lock: a crash-stop (test harness _kill_node)
+        # closes from another thread while appenders may be mid-frame —
+        # closing out from under an in-flight write tears the tail the
+        # CRC scan then has to cut
+        with self._wlock:
+            self._f.close()
 
 
 class WAL(Journal):

@@ -97,6 +97,7 @@ class CostPriorModel:
         # weighted least-squares fit of p50 cost on feature means
         # (unseen-shape predictor for the batch planner)
         self._fit: dict | None = None
+        locks.guarded(self, "costprior.model")
 
     # -- prediction ----------------------------------------------------------
     def shape_for_text(self, text: str) -> str | None:

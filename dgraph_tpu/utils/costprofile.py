@@ -272,6 +272,7 @@ class Aggregator:
         self._shapes: dict[str, _ShapeStats] = {}
         self.max_shapes = int(max_shapes)
         self.records_total = 0
+        locks.guarded(self, "costprofile.aggregator")
 
     def _guard(self, shape: str) -> str:
         """Admit or collapse a shape key (caller holds the lock) — the

@@ -84,6 +84,7 @@ class Memo:
         self.capacity = capacity
         self._d: "collections.OrderedDict" = collections.OrderedDict()
         self._lock = locks.make_lock(f"jitcache.memo.{name}")
+        locks.guarded(self, "jitcache.memo.*")
 
     def get(self, key):
         with self._lock:

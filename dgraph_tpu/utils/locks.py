@@ -40,22 +40,56 @@ Caveat (documented, accepted): `threading.Lock` allows releasing from a
 different thread than the acquirer; the sanitizer pops by identity and
 ignores an unmatched release, so cross-thread hand-offs degrade to
 unrecorded holds instead of corrupting the graph.
+
+**Race sanitizer (ISSUE 12) — the Eraser lockset half.** Lock ORDER
+catches deadlocks; the classic production failure is an unguarded
+access to shared state. `DGRAPH_TPU_RACE_SANITIZER=1` (requires the
+lock sanitizer too — locksets come from TracedLock's bookkeeping) arms
+`guarded(obj, lock_name)`, called once per `__init__` of every class
+the static inference (dgraph_tpu/analysis/guards.py) lists in its
+lock-discipline inventory. Arming swaps the instance onto a cached
+subclass whose inventory fields are data descriptors; every
+read/write of those fields records (field, thread, currently-held
+lockset) and runs the Eraser state machine per field:
+
+    virgin → exclusive (first thread; no checks — the init window)
+           → shared (second thread reads)      C(v) ∩= held
+           → shared-modified (any later write) C(v) ∩= held, and an
+             EMPTY C(v) here is a data race, reported with BOTH
+             access stacks (the last lockset-relevant access and the
+             racing one).
+
+The lockset-refinement design means benign patterns stay silent:
+lock-handoff (every access under the same lock keeps C(v) nonempty)
+and publish-then-freeze (writes by one thread, then cross-thread
+reads only, never reaches shared-modified). Accesses whose caller
+frame lives under tests/ are exempt — the harness peeks internals at
+quiescent points (`assert not a._pending`) and must not convict the
+package. Off (`guarded()` returns immediately, no subclass swap) the
+fields are plain attributes: zero overhead. `RACES.snapshot()` backs
+`GET /debug/races`; tests/conftest.py arms the whole tier-1 suite and
+fails the session on any report, and both fuzzers assert race-free
+across every historical seed.
 """
 
 from __future__ import annotations
 
 import os
+import sys
 import threading
 import time
 import traceback
 
 __all__ = ["enabled", "make_lock", "make_rlock", "make_condition",
            "GRAPH", "LockGraph", "TracedLock", "TracedRLock",
-           "set_enabled"]
+           "set_enabled", "race_enabled", "guarded", "attach",
+           "RACES", "RaceTable", "set_race_enabled"]
 
 ENV_SWITCH = "DGRAPH_TPU_LOCK_SANITIZER"
+ENV_RACE_SWITCH = "DGRAPH_TPU_RACE_SANITIZER"
 ENV_HOLD_MS = "DGRAPH_TPU_LOCK_HOLD_MS"
 MAX_LONG_HOLDS = 64          # bounded report ring — newest wins
+MAX_RACE_REPORTS = 64        # bounded race list — first wins (root cause)
 _STACK_SKIP = 2              # drop the sanitizer's own frames
 
 
@@ -130,6 +164,10 @@ class LockGraph:
                         else:
                             self.edges[key]["count"] += 1
         held.append((lock, time.monotonic(), reentrant))
+        # bump the per-thread held-set version (the race sanitizer
+        # caches its lockset-by-name off it — one int add here saves
+        # a frozenset build per tracked field access over there)
+        self._tls.ver = getattr(self._tls, "ver", 0) + 1
 
     def note_release(self, lock) -> None:
         held = getattr(self._tls, "held", None)
@@ -138,6 +176,7 @@ class LockGraph:
         for i in range(len(held) - 1, -1, -1):
             if held[i][0] is lock:
                 _, t0, _reent = held.pop(i)
+                self._tls.ver = getattr(self._tls, "ver", 0) + 1
                 if not self.recording:
                     return
                 dt = time.monotonic() - t0
@@ -299,3 +338,310 @@ def make_condition(name: str) -> threading.Condition:
     if enabled():
         return threading.Condition(TracedLock(name))
     return threading.Condition()
+
+
+# ---------------------------------------------------------------------------
+# Eraser lockset race sanitizer (ISSUE 12) — see module docstring
+
+def race_enabled() -> bool:
+    """Is the race sanitizer armed for NEW guarded() calls? Requires
+    the lock sanitizer too: the per-thread lockset IS TracedLock's
+    held bookkeeping — without it every lockset reads empty and every
+    shared field would convict."""
+    return (os.environ.get(ENV_RACE_SWITCH, "") not in ("", "0")
+            and enabled())
+
+
+# Eraser field states
+_EXCLUSIVE, _SHARED, _SHARED_MOD = 0, 1, 2
+_STATE_KEY = "_race_state"   # per-instance {field: state dict}
+
+
+class _RaceField:
+    """Data descriptor standing in for ONE tracked field on a shim
+    subclass: every get/set records the access, then reads/writes the
+    plain value in the instance dict (a data descriptor shadows the
+    instance dict, so storage and interception never recurse).
+    Untracked attributes of the same object ride the normal lookup
+    path untouched."""
+
+    __slots__ = ("name", "table")
+
+    def __init__(self, name: str, table: "RaceTable"):
+        self.name = name
+        self.table = table
+
+    def __get__(self, obj, owner=None):
+        if obj is None:
+            return self
+        self.table.note(obj, self.name, False)
+        try:
+            return obj.__dict__[self.name]
+        except KeyError:
+            raise AttributeError(self.name) from None
+
+    def __set__(self, obj, value):
+        self.table.note(obj, self.name, True)
+        obj.__dict__[self.name] = value
+
+    def __delete__(self, obj):
+        self.table.note(obj, self.name, True)
+        del obj.__dict__[self.name]
+
+
+class RaceTable:
+    """Per-field Eraser lockset state machine + the bounded report
+    list. Field state lives ON the instance (`_race_state` dict) so
+    object death retires its state — id() reuse can never alias two
+    objects' histories into a false race. The report path is the only
+    slow path; candidate-set updates are dict ops under the GIL, and
+    a torn update can only MISS an intersection (a report requires
+    two real accesses with disjoint locksets, which is a discipline
+    violation by itself — no false positive is constructible)."""
+
+    def __init__(self, graph: LockGraph | None = None,
+                 exempt_tests: bool = False):
+        self._glock = threading.Lock()  # reports/registry, never hot
+        self.graph = graph if graph is not None else GRAPH
+        self.reports: list[dict] = []
+        self.races_total = 0
+        self.recording = True
+        # the process-global table skips direct field peeks from test
+        # frames (see note()); private tables in synthetic race tests
+        # must check EVERY access, including the test's own
+        self.exempt_tests = exempt_tests
+        # original class -> shim subclass; (file, class) -> arming info
+        self._shims: dict = {}
+        self.registered: dict = {}
+        # per-thread token: threading.get_ident() RECYCLES after a
+        # thread exits, which would let a later thread alias a dead
+        # owner and park a field in the exclusive state (a missed
+        # race); these tokens are issued once per thread lifetime and
+        # never reused
+        self._tok_tls = threading.local()
+        self._tok_iter = iter(range(1, 1 << 62))
+
+    def _tid(self) -> int:
+        t = getattr(self._tok_tls, "tok", None)
+        if t is None:
+            t = self._tok_tls.tok = next(self._tok_iter)
+        return t
+
+    def set_enabled(self, flag: bool) -> None:
+        """Disarm recording (the <5% overhead guard's off switch) —
+        descriptors stay installed; note() returns immediately."""
+        self.recording = bool(flag)
+
+    # -- hot path -------------------------------------------------------------
+    _EMPTY = frozenset()
+
+    def _held_names(self) -> frozenset:
+        """The calling thread's held lockset by name, cached against
+        the graph's per-thread acquire/release version — a lock
+        section with several tracked accesses builds the set once."""
+        tls = self.graph._tls
+        held = getattr(tls, "held", None)
+        if not held:
+            return self._EMPTY
+        ver = getattr(tls, "ver", 0)
+        cache = getattr(tls, "names_cache", None)
+        if cache is not None and cache[0] == ver:
+            return cache[1]
+        names = frozenset(e[0].name for e in held)
+        tls.names_cache = (ver, names)
+        return names
+
+    def _from_test(self) -> bool:
+        """Harness exemption (global table only): a DIRECT field peek
+        from test code (the fuzz harness asserting `not a._pending`
+        at a quiescent point) is instrumentation, not package
+        discipline — package-internal accesses triggered BY tests
+        still have package frames at the access site and stay fully
+        checked. Only consulted when an access is about to CHANGE
+        state or report, so the steady-state hot path never walks a
+        frame."""
+        caller = sys._getframe(3).f_code.co_filename
+        return "/tests/" in caller or caller.endswith("conftest.py")
+
+    def note(self, obj, field: str, write: bool) -> None:
+        if not self.recording:
+            return
+        tid = self._tid()
+        states = obj.__dict__.get(_STATE_KEY)
+        if states is None:
+            states = obj.__dict__[_STATE_KEY] = {}
+        s = states.get(field)
+        if s is None:
+            if self.exempt_tests and self._from_test():
+                return
+            # first tracked access: exclusive to this thread, no
+            # checks — Eraser's initialization window. Its stack is
+            # kept: it is "the other side" of a race surfacing at the
+            # very first cross-thread write.
+            states[field] = {"mode": _EXCLUSIVE, "owner": tid,
+                             "set": None, "stack": _stack(),
+                             "stack_tid": tid, "stack_held": (),
+                             "reported": False}
+            return
+        mode = s["mode"]
+        if mode == _EXCLUSIVE:
+            if s["owner"] == tid:
+                return  # fast path: still single-threaded
+            if self.exempt_tests and self._from_test():
+                return
+            # second thread arrives: leave the init window
+            held = self._held_names()
+            s["set"] = held
+            s["mode"] = _SHARED_MOD if write else _SHARED
+            if s["mode"] == _SHARED_MOD and not held \
+                    and not s["reported"]:
+                self._report(obj, field, s, tid, held, write)
+                return
+            s["stack"] = _stack()
+            s["stack_tid"] = tid
+            s["stack_held"] = tuple(sorted(held))
+            return
+        held = self._held_names()
+        new = s["set"] & held
+        flip = write and mode == _SHARED
+        if new == s["set"] and not flip:
+            # steady state — nothing would change; the only possible
+            # event is an access racing an already-empty set
+            if mode == _SHARED_MOD and not new and not s["reported"]:
+                if self.exempt_tests and self._from_test():
+                    return
+                self._report(obj, field, s, tid, held, write)
+            return
+        # a shrink and/or the shared→shared-modified flip is imminent:
+        # now (and only now) pay the harness-exemption frame walk
+        if self.exempt_tests and self._from_test():
+            return
+        if flip:
+            s["mode"] = _SHARED_MOD
+        shrank = new != s["set"]
+        if shrank:
+            s["set"] = new
+        if s["mode"] == _SHARED_MOD and not new and not s["reported"]:
+            self._report(obj, field, s, tid, held, write)
+            return
+        if shrank:
+            # this access shrank the candidate set: it is one of the
+            # two accesses that prove any upcoming race
+            s["stack"] = _stack()
+            s["stack_tid"] = tid
+            s["stack_held"] = tuple(sorted(held))
+
+    # -- reporting ------------------------------------------------------------
+    def _report(self, obj, field, s, tid, held, write) -> None:
+        s["reported"] = True  # one report per field, not a flood
+        with self._glock:
+            self.races_total += 1
+            if len(self.reports) >= MAX_RACE_REPORTS:
+                return
+            self.reports.append({
+                "class": type(obj).__name__,
+                "field": field,
+                "lock": getattr(type(obj), "_race_lock_", "?"),
+                "kind": "write" if write else "read",
+                "first": {"thread": s["stack_tid"],
+                          "lockset": list(s["stack_held"]),
+                          "stack": s["stack"] or ""},
+                "second": {"thread": tid,
+                           "lockset": sorted(held),
+                           "stack": _stack()},
+            })
+
+    def snapshot(self) -> dict:
+        with self._glock:
+            reports = [dict(r) for r in self.reports]
+            tracked = sorted(f"{file}:{cls}"
+                             for file, cls in self.registered)
+        return {
+            "enabled": race_enabled(),
+            "recording": self.recording,
+            "races_total": self.races_total,
+            "tracked_classes": tracked,
+            "reports": reports,
+        }
+
+    def reset(self) -> None:
+        """Test hook: forget reports (shims and per-object state
+        survive — live objects keep their histories)."""
+        with self._glock:
+            self.reports.clear()
+            self.races_total = 0
+
+    # -- arming ---------------------------------------------------------------
+    def attach(self, obj, fields, lock_name: str) -> None:
+        """Install the field-access shim on one instance: swap its
+        class for a cached subclass carrying a _RaceField descriptor
+        per tracked field. Values already in the instance dict stay
+        where they are — the descriptor reads/writes the same slot."""
+        cls = type(obj)
+        if getattr(cls, "_race_shim_", False):
+            return  # already armed (re-registration is a no-op)
+        sub = self._shims.get((cls, tuple(fields)))
+        if sub is None:
+            ns = {f: _RaceField(f, self) for f in fields}
+            ns["_race_shim_"] = True
+            ns["_race_lock_"] = lock_name
+            sub = type(cls.__name__, (cls,), ns)
+            with self._glock:
+                self._shims.setdefault((cls, tuple(fields)), sub)
+                sub = self._shims[(cls, tuple(fields))]
+        obj.__class__ = sub
+
+    def register(self, obj, lock_name: str) -> None:
+        """The `guarded()` slow path: resolve the statically-inferred
+        field inventory for this object's class (walking the MRO —
+        `WAL(Journal)` arms Journal's fields) and attach the shim."""
+        from dgraph_tpu.analysis.guards import runtime_inventory
+        inv = runtime_inventory()
+        fields: list = []
+        hit_key = None
+        for klass in type(obj).__mro__:
+            mod = getattr(klass, "__module__", "") or ""
+            if not mod.startswith("dgraph_tpu"):
+                continue
+            key = (mod.replace(".", "/") + ".py", klass.__name__)
+            entry = inv.get(key)
+            if entry is None:
+                continue
+            hit_key = hit_key or key
+            for info in entry["locks"].values():
+                fields.extend(f for f in info["fields"]
+                              if f not in fields)
+        if hit_key is None:
+            return  # no inferred discipline: nothing to arm
+        with self._glock:
+            self.registered[hit_key] = {
+                "lock": lock_name, "fields": tuple(sorted(fields))}
+        self.attach(obj, fields, lock_name)
+
+
+RACES = RaceTable(exempt_tests=True)
+
+
+def set_race_enabled(flag: bool) -> None:
+    RACES.set_enabled(flag)
+
+
+def attach(obj, fields, lock_name: str,
+           table: RaceTable | None = None) -> None:
+    """Test-facing shim installer with an explicit field list and an
+    optional private table (synthetic races must not trip the
+    session gate)."""
+    (table if table is not None else RACES).attach(
+        obj, tuple(fields), lock_name)
+
+
+def guarded(obj, lock_name: str):
+    """Arm one instance for Eraser lockset checking, using the
+    statically-inferred guarded-field inventory for its class. Called
+    once at the end of `__init__` by every class the inventory lists;
+    a PLAIN no-op (and plain attributes) unless
+    DGRAPH_TPU_RACE_SANITIZER=1 and the lock sanitizer is armed.
+    Returns `obj` so call sites can wrap construction."""
+    if race_enabled():
+        RACES.register(obj, lock_name)
+    return obj

@@ -67,6 +67,7 @@ class Registry:
         self._label_limits: dict[str, int] = {}  # per-name cap overrides
         self.max_label_sets = MAX_LABEL_SETS
         self._enabled = True
+        locks.guarded(self, "metrics.registry")
 
     def set_enabled(self, flag: bool) -> None:
         """Disarm recording (render/snapshot still serve what exists) —

@@ -57,6 +57,7 @@ class TelemetryPusher:
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self._backoff_s = 0.0
+        locks.guarded(self, "push.buffer")
 
     # -- request-path sinks (must stay cheap + non-blocking) -----------------
     def _offer(self, buf: list, kind: str, item) -> None:
@@ -95,7 +96,14 @@ class TelemetryPusher:
 
     # -- exporter loop --------------------------------------------------------
     def _run(self) -> None:
-        while not self._stop.wait(self._backoff_s or self.interval_s):
+        while True:
+            # backoff is written by this thread on push failure and
+            # read by status() on HTTP threads: all accesses ride the
+            # buffer lock (ISSUE-12 audit — the pusher-bookkeeping race)
+            with self._lock:
+                delay = self._backoff_s or self.interval_s
+            if self._stop.wait(delay):
+                return
             self._push_once()
 
     def _take(self) -> tuple[list, list]:
@@ -127,14 +135,16 @@ class TelemetryPusher:
             if costs:
                 self._post("/v1/costs", {"records": costs})
             METRICS.inc("telemetry_push_total", outcome="ok")
-            self._backoff_s = 0.0
+            with self._lock:
+                self._backoff_s = 0.0
         except Exception:  # noqa: BLE001 — collector down ≠ serving down
             METRICS.inc("telemetry_push_total", outcome="error")
             self._requeue(self._spans, "span", spans)
             self._requeue(self._costs, "cost", costs)
-            self._backoff_s = min(
-                _BACKOFF_CAP_S,
-                (self._backoff_s or _BACKOFF_BASE_S) * 2)
+            with self._lock:
+                self._backoff_s = min(
+                    _BACKOFF_CAP_S,
+                    (self._backoff_s or _BACKOFF_BASE_S) * 2)
 
     def _post(self, path: str, doc: dict) -> None:
         req = urllib.request.Request(

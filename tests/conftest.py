@@ -15,6 +15,12 @@ import pytest
 # any lock-order cycle was observed anywhere. Must be set before any
 # dgraph_tpu module creates its registry locks at import time.
 os.environ.setdefault("DGRAPH_TPU_LOCK_SANITIZER", "1")
+# ... and the Eraser lockset RACE sanitizer (ISSUE 12): every class in
+# the static lock-discipline inventory (analysis/guards.py) arms its
+# guarded fields via locks.guarded(); an access whose candidate
+# lockset empties after a cross-thread write is a data race, reported
+# with both stacks and failing the session gate below.
+os.environ.setdefault("DGRAPH_TPU_RACE_SANITIZER", "1")
 
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
@@ -52,3 +58,24 @@ def _lock_order_session_gate():
             " -> ".join(c["cycle"] + [c["cycle"][0]])
             + "\n" + "\n".join(e["stack"] for e in c["edges"])
             for c in cycles))
+
+
+@pytest.fixture(autouse=True, scope="session")
+def _race_session_gate():
+    """Session-wide DATA-RACE gate (ISSUE 12): after the LAST test, the
+    Eraser lockset sanitizer must have zero reports. A report means a
+    guarded field of some subsystem object was accessed with an empty
+    candidate lockset after a cross-thread write — an actual unguarded
+    access that happened during this run, with both stacks attached."""
+    yield
+    from dgraph_tpu.utils import locks
+    reports = locks.RACES.snapshot()["reports"]
+    assert not reports, (
+        "data race(s) observed during the test session:\n"
+        + "\n".join(
+            f"{r['class']}.{r['field']} (lock {r['lock']}): "
+            f"{r['kind']} with locksets {r['first']['lockset']} / "
+            f"{r['second']['lockset']}\n--- first access:\n"
+            f"{r['first']['stack']}\n--- racing access:\n"
+            f"{r['second']['stack']}"
+            for r in reports))

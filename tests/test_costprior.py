@@ -258,13 +258,15 @@ def test_idle_lane_ema_decays_to_seed():
         lane.service_ema_s = lane.service_ema_s + 0.2 * (5.0 -
                                                          lane.service_ema_s)
     assert lane.service_ema_s > 1.0
-    stale_hint = lane._retry_after_s(1)   # one slot ahead × stale EMA
+    with lane.lock:  # _retry_after_s is a caller-holds-the-lock helper
+        stale_hint = lane._retry_after_s(1)  # one slot ahead × stale EMA
     # simulate the idle window having elapsed
     lane._last_activity = time.monotonic() - lane.idle_reset_s - 1.0
     started, release = threading.Event(), threading.Event()
     holder = _hold_token(adm, "read", started, release)  # triggers decay
     assert lane.service_ema_s == pytest.approx(_EMA_SEED_S)
-    fresh_hint = lane._retry_after_s(1)
+    with lane.lock:
+        fresh_hint = lane._retry_after_s(1)
     assert fresh_hint < stale_hint / 10
     # queue_depth=0: the next arrival sheds with the DECAYED hint
     with pytest.raises(ServerOverloaded) as ei:
@@ -276,7 +278,8 @@ def test_idle_lane_ema_decays_to_seed():
     # within the idle window nothing decays
     lane.service_ema_s = 3.0
     lane._last_activity = time.monotonic()
-    lane._maybe_decay_ema(time.monotonic())
+    with lane.lock:  # caller-holds-the-lock helper
+        lane._maybe_decay_ema(time.monotonic())
     assert lane.service_ema_s == 3.0
 
 

@@ -489,3 +489,302 @@ def test_r8_waiver_with_reason():
     a = scan("dgraph_tpu/store/fake.py", src)
     assert "atomic-write" not in rules_of(a)
     assert "atomic-write" in rules_of(a, waived=True)
+
+
+# ---------------------------------------------------------------------------
+# R9 guarded-field (ISSUE 12 — graftrace static half)
+
+R9_BAD = """\
+from dgraph_tpu.utils import locks
+class Counter:
+    def __init__(self):
+        self._lock = locks.make_lock("c.lock")
+        self._n = 0
+    def inc(self):
+        with self._lock:
+            self._n += 1
+    def dec(self):
+        with self._lock:
+            self._n -= 1
+    def reset(self):
+        with self._lock:
+            self._n = 0
+    def peek(self):
+        return self._n
+"""
+
+
+def test_r9_flags_unguarded_minority_access():
+    a = scan("dgraph_tpu/server/fake.py", R9_BAD)
+    finds = [f for f in a.findings if f.rule == "guarded-field"]
+    assert len(finds) == 1
+    assert "peek()" in finds[0].msg and "_n" in finds[0].msg
+
+
+def test_r9_clean_when_every_access_locked():
+    src = R9_BAD.replace(
+        "    def peek(self):\n        return self._n\n",
+        "    def peek(self):\n        with self._lock:\n"
+        "            return self._n\n")
+    a = scan("dgraph_tpu/server/fake.py", src)
+    assert "guarded-field" not in rules_of(a)
+
+
+def test_r9_published_pointer_below_belief_bar_not_flagged():
+    """The atomic published-pointer pattern: one locked rebind, many
+    unlocked reads — the lock serializes WRITERS; readers ride atomic
+    reference loads (self.mvcc's real discipline). Below the 3/4
+    belief bar the field is not considered lock-guarded."""
+    src = ("from dgraph_tpu.utils import locks\n"
+           "class Holder:\n"
+           "    def __init__(self):\n"
+           "        self._lock = locks.make_lock('h.lock')\n"
+           "        self.snap = object()\n"
+           "    def swap(self, s):\n"
+           "        with self._lock:\n"
+           "            self.snap = s\n"
+           "    def r1(self):\n"
+           "        return self.snap\n"
+           "    def r2(self):\n"
+           "        return self.snap\n"
+           "    def r3(self):\n"
+           "        return self.snap\n")
+    a = scan("dgraph_tpu/server/fake.py", src)
+    assert "guarded-field" not in rules_of(a)
+
+
+def test_r9_init_window_and_lock_context_helpers_exempt():
+    """__init__ (and methods reachable only from it) plus helpers
+    called only from inside lock scopes inherit the right context."""
+    src = ("from dgraph_tpu.utils import locks\n"
+           "class S:\n"
+           "    def __init__(self):\n"
+           "        self._lock = locks.make_lock('s.lock')\n"
+           "        self._d = {}\n"
+           "        self._boot()\n"
+           "    def _boot(self):\n"
+           "        self._d['seed'] = 1\n"          # init window
+           "    def put(self, k, v):\n"
+           "        with self._lock:\n"
+           "            self._d[k] = v\n"
+           "            self._bump(k)\n"
+           "    def drop(self, k):\n"
+           "        with self._lock:\n"
+           "            self._d.pop(k, None)\n"
+           "    def _bump(self, k):\n"
+           "        self._d[k] = self._d[k] + 1\n")  # caller holds it
+    a = scan("dgraph_tpu/store/fake.py", src)
+    assert "guarded-field" not in rules_of(a)
+
+
+def test_r9_waiver_suppresses_and_disarms_runtime_inventory():
+    """A reasoned R9 waiver suppresses the finding AND drops the field
+    from the guarded-fields inventory — one review disarms the static
+    and dynamic halves together."""
+    src = R9_BAD.replace(
+        "    def peek(self):\n        return self._n\n",
+        "    def peek(self):\n"
+        "        # graftlint: allow(guarded-field): monotonic gauge "
+        "read, torn value acceptable\n"
+        "        return self._n\n")
+    a = scan("dgraph_tpu/server/fake.py", src)
+    assert "guarded-field" not in rules_of(a)
+    assert "guarded-field" in rules_of(a, waived=True)
+    inv = [g for g in a.facts["guarded_fields"]
+           if g["class"] == "Counter"]
+    assert not any("_n" in g["fields"] for g in inv)
+    # without the waiver the field IS inventoried
+    a2 = scan("dgraph_tpu/server/fake.py", R9_BAD.replace(
+        "    def peek(self):\n        return self._n\n", ""))
+    (entry,) = [g for g in a2.facts["guarded_fields"]
+                if g["class"] == "Counter"]
+    assert entry["fields"] == ["_n"] and entry["lock"] == "c.lock"
+
+
+# ---------------------------------------------------------------------------
+# R10 guarded-escape
+
+R10_BAD = """\
+from dgraph_tpu.utils import locks
+class Buf:
+    def __init__(self):
+        self._lock = locks.make_lock("b.lock")
+        self._items = []
+    def add(self, x):
+        with self._lock:
+            self._items.append(x)
+    def worst(self):
+        with self._lock:
+            return self._items
+"""
+
+
+def test_r10_flags_escaping_container_reference():
+    a = scan("dgraph_tpu/server/fake.py", R10_BAD)
+    finds = [f for f in a.findings if f.rule == "guarded-escape"]
+    assert len(finds) == 1 and "_items" in finds[0].msg
+
+
+def test_r10_copy_or_snapshot_is_clean():
+    for fix in ("return list(self._items)",
+                "return self._items[0]",
+                "return len(self._items)"):
+        src = R10_BAD.replace("return self._items", fix)
+        a = scan("dgraph_tpu/server/fake.py", src)
+        assert "guarded-escape" not in rules_of(a), fix
+
+
+def test_r10_scalar_return_under_lock_is_clean():
+    src = ("from dgraph_tpu.utils import locks\n"
+           "class C:\n"
+           "    def __init__(self):\n"
+           "        self._lock = locks.make_lock('c.lock')\n"
+           "        self._n = 0\n"
+           "    def inc(self):\n"
+           "        with self._lock:\n"
+           "            self._n += 1\n"
+           "            return self._n\n")
+    a = scan("dgraph_tpu/server/fake.py", src)
+    assert "guarded-escape" not in rules_of(a)
+
+
+# ---------------------------------------------------------------------------
+# R11 split-critical-section
+
+R11_BAD = """\
+from dgraph_tpu.utils import locks
+class Q:
+    def __init__(self):
+        self._lock = locks.make_lock("q.lock")
+        self._level = 0
+    def set_level(self, v):
+        with self._lock:
+            self._level = v
+    def bump_if_low(self):
+        with self._lock:
+            low = self._level < 10
+        if low:
+            with self._lock:
+                self._level = self._level + 1
+"""
+
+
+def test_r11_flags_check_then_act_across_release():
+    a = scan("dgraph_tpu/server/fake.py", R11_BAD)
+    finds = [f for f in a.findings
+             if f.rule == "split-critical-section"]
+    assert len(finds) == 1 and "_level" in finds[0].msg
+
+
+def test_r11_fused_section_is_clean():
+    src = ("from dgraph_tpu.utils import locks\n"
+           "class Q:\n"
+           "    def __init__(self):\n"
+           "        self._lock = locks.make_lock('q.lock')\n"
+           "        self._level = 0\n"
+           "    def set_level(self, v):\n"
+           "        with self._lock:\n"
+           "            self._level = v\n"
+           "    def bump_if_low(self):\n"
+           "        with self._lock:\n"
+           "            if self._level < 10:\n"
+           "                self._level = self._level + 1\n")
+    a = scan("dgraph_tpu/server/fake.py", src)
+    assert "split-critical-section" not in rules_of(a)
+
+
+# ---------------------------------------------------------------------------
+# R12 untracked-lock
+
+def test_r12_flags_direct_threading_locks_outside_locks_py():
+    src = ("import threading\n"
+           "from threading import Condition\n"
+           "a = threading.Lock()\n"
+           "b = threading.RLock()\n"
+           "c = Condition()\n")
+    a = scan("dgraph_tpu/server/fake.py", src)
+    finds = [f for f in a.findings if f.rule == "untracked-lock"]
+    assert len(finds) == 3
+
+
+def test_r12_allows_locks_py_and_events():
+    src = "import threading\nx = threading.Lock()\n"
+    a = scan("dgraph_tpu/utils/locks.py", src)
+    assert "untracked-lock" not in rules_of(a)
+    # Event/local are not locks: the sanitizers have nothing to see
+    src = ("import threading\n"
+           "e = threading.Event()\nt = threading.local()\n")
+    a = scan("dgraph_tpu/server/fake.py", src)
+    assert "untracked-lock" not in rules_of(a)
+
+
+# ---------------------------------------------------------------------------
+# facts round-trip: static inventory ⟷ runtime guarded() registry
+
+def test_guarded_fields_inventory_shape():
+    """The lock-discipline inventory covers the real threaded
+    surface: the known lock-owning classes with their guarded
+    fields."""
+    a = run(ROOT)
+    inv = {(g["file"], g["class"]): g
+           for g in a.facts["guarded_fields"]}
+    assert ("dgraph_tpu/utils/metrics.py", "Registry") in inv
+    assert ("dgraph_tpu/store/mvcc.py", "MVCCStore") in inv
+    assert ("dgraph_tpu/server/admission.py", "_Lane") in inv
+    assert a.facts["totals"]["guarded_classes"] >= 15
+    assert a.facts["totals"]["guarded_fields"] >= 60
+    reg = inv[("dgraph_tpu/utils/metrics.py", "Registry")]
+    assert "_counters" in reg["fields"]
+    assert reg["lock"] == "metrics.registry"
+
+
+def test_guarded_sites_pin_inventory_both_ways():
+    """Direction 1: every inventoried class carries a
+    `locks.guarded(self, …)` arming call in its file. Direction 2:
+    every arming call's class has inventory entries — an arming call
+    on a class the inference knows nothing about is drift."""
+    a = run(ROOT)
+    inv_keys = {(g["file"], g["class"])
+                for g in a.facts["guarded_fields"]}
+    site_keys = {(s["file"], s["class"])
+                 for s in a.facts["guarded_sites"]}
+    missing_sites = inv_keys - site_keys
+    assert not missing_sites, (
+        f"inventoried classes with NO guarded() arming call: "
+        f"{sorted(missing_sites)}")
+    stray_sites = site_keys - inv_keys
+    assert not stray_sites, (
+        f"guarded() calls on classes with no inferred discipline: "
+        f"{sorted(stray_sites)}")
+    # and the declared lock label matches the inventory's
+    by_key = {}
+    for g in a.facts["guarded_fields"]:
+        by_key.setdefault((g["file"], g["class"]), set()).add(g["lock"])
+    for s in a.facts["guarded_sites"]:
+        assert s["lock"] in by_key[(s["file"], s["class"])], s
+
+
+def test_runtime_registry_matches_static_inventory():
+    """The dynamic half arms EXACTLY the statically-inferred fields:
+    construct real subsystem objects, then compare the runtime
+    registry (what the shim actually tracks) against facts — the
+    cost_record_fields pattern applied to the race sanitizer."""
+    from dgraph_tpu.server.admission import AdmissionController
+    from dgraph_tpu.utils import locks
+    from dgraph_tpu.utils.push import TelemetryPusher
+
+    AdmissionController(max_inflight=1, queue_depth=1)
+    TelemetryPusher("http://127.0.0.1:1")
+    a = run(ROOT)
+    inv: dict = {}
+    for g in a.facts["guarded_fields"]:
+        inv.setdefault((g["file"], g["class"]), set()).update(
+            g["fields"])
+    reg = locks.RACES.registered
+    for key in [("dgraph_tpu/server/admission.py", "_Lane"),
+                ("dgraph_tpu/utils/push.py", "TelemetryPusher"),
+                ("dgraph_tpu/utils/metrics.py", "Registry")]:
+        assert key in reg, f"{key} never registered at runtime"
+        assert set(reg[key]["fields"]) == inv[key], (
+            f"{key}: runtime shim tracks {sorted(reg[key]['fields'])} "
+            f"but static inference says {sorted(inv[key])}")

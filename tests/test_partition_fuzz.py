@@ -198,6 +198,12 @@ def test_partition_fuzz_smoke(bank_trio):
     assert locks.enabled(), "fuzz smoke must run instrumented"
     cycles = locks.GRAPH.cycles()
     assert not cycles, f"lock-order cycle(s) under partition fuzz: {cycles}"
+    # ... and the race sanitizer (ISSUE 12) must stay silent across the
+    # same historical seeds: partition churn interleaves RPC threads
+    # over every guarded subsystem object harder than directed tests
+    assert locks.race_enabled(), "fuzz smoke must run race-instrumented"
+    races = locks.RACES.snapshot()["reports"]
+    assert not races, f"data race(s) under partition fuzz: {races}"
 
 
 def test_election_counters_visible():
@@ -612,6 +618,10 @@ def test_crash_restart_fuzz_schedule(bank_trio):
     from dgraph_tpu.utils import locks
     cycles = locks.GRAPH.cycles()
     assert not cycles, f"lock-order cycle(s) under crash fuzz: {cycles}"
+    # nor a data race: restarts swap whole guarded objects (Alpha, WAL,
+    # stores) while peers keep calling in — the hardest arming test
+    races = locks.RACES.snapshot()["reports"]
+    assert not races, f"data race(s) under crash fuzz: {races}"
 
 
 @pytest.mark.slow
@@ -646,6 +656,10 @@ def test_disk_fault_fuzz_smoke(bank_trio):
     d0 = _counter_sum("fault_disk_events_total")
     _run_crash_fuzz(bank_trio, seeds)
     assert _counter_sum("fault_disk_events_total") > d0
+    # disk-fault churn (heals + crash-restarts) stays race-free too
+    from dgraph_tpu.utils import locks
+    races = locks.RACES.snapshot()["reports"]
+    assert not races, f"data race(s) under disk-fault fuzz: {races}"
 
 
 # golden schedules captured from the PRE-crash-fault generator: the
