@@ -76,6 +76,12 @@ HBM_PEAK_GBPS = 819.0      # v5e single chip
 
 _emitted = threading.Event()
 
+# bench flight-recorder arming (ISSUE 13): generous thresholds — only
+# a stage wedged past its deadline, or a request grossly past its
+# prediction, convicts; the bundle path rides the stage's JSON line
+BENCH_STALL_FACTOR = 50.0
+BENCH_STALL_FLOOR_MS = 5000.0
+
 
 def log(*a):
     print(*a, file=sys.stderr, flush=True)
@@ -125,8 +131,67 @@ def cpu_recurse(indptr, indices, seeds, depth):
 # ---------------------------------------------------------------------------
 # child: staged device measurement; one JSON line per stage on stdout
 
+# the stdout protocol is one JSON line per stage, read by name in the
+# parent — the watchdog's on_dump callback may print from its own
+# thread, so every line goes out under one lock, never interleaved
+_stage_lock = threading.Lock()
+
+
 def _stage(obj) -> None:
-    print(json.dumps(obj), flush=True)
+    with _stage_lock:
+        print(json.dumps(obj), flush=True)
+
+
+def _arm_flight_recorder():
+    """Arm the flight recorder for the whole child (ISSUE 13): any
+    stage that dies leaves a bundle via the error path below, and any
+    stage that WEDGES past its deadline is convicted by the watchdog —
+    whose on_dump hook prints the stage's error line (with the bundle
+    path) so the BENCH JSON still names the evidence even though the
+    stage itself will never print."""
+    from dgraph_tpu.utils import flightrec
+
+    def on_dump(record, bundle):
+        reason = record.get("reason") or {}
+        op = reason.get("op") or {}
+        name = op.get("name", "")
+        if reason.get("kind") == "wedged" and name.startswith("bench."):
+            _stage({"stage": name.split(".", 1)[1],
+                    "error": "stage stalled past its deadline "
+                             "(flight watchdog)",
+                    "bundle": record.get("path")})
+
+    flightrec.arm(diag_dir=os.path.join(ROOT, ".bench_diag"),
+                  stall_factor=BENCH_STALL_FACTOR,
+                  stall_floor_ms=BENCH_STALL_FLOOR_MS,
+                  poll_s=0.5, min_dump_interval_s=10.0,
+                  on_dump=on_dump)
+    return flightrec
+
+
+def _run_stage(flightrec, name: str, fn) -> None:
+    """Run one bench stage under flight-recorder tracking: a raised
+    error dumps a bundle and prints {stage, error, bundle} — the
+    PARTIAL run's telemetry survives in the bundle instead of dying
+    with the stage — and the child continues to the next stage."""
+    mark = len(flightrec.dumps())
+    try:
+        with flightrec.track(f"bench.{name}",
+                             budget_s=STAGE_DEADLINES.get(name)):
+            doc = fn()
+    except Exception as e:  # noqa: BLE001 — a dead stage must not kill the rest
+        out = flightrec.dump(
+            trigger="error",
+            reason={"stage": name,
+                    "error": f"{type(e).__name__}: {e}"})
+        _stage({"stage": name,
+                "error": f"{type(e).__name__}: {e}",
+                "bundle": out["path"]})
+        return
+    new = [d["path"] for d in flightrec.dumps()[mark:] if d["path"]]
+    if new:
+        doc["flight_dumps"] = new
+    _stage(doc)
 
 
 def _stage_telemetry(stage: str) -> dict:
@@ -180,186 +245,191 @@ def child_main(platform: str, expect_path: str) -> None:
     from dgraph_tpu.utils.jitcache import Memo
     from dgraph_tpu.utils.metrics import METRICS
 
+    flightrec = _arm_flight_recorder()
+
     # -- stage0: backend alive + MXU smoke ----------------------------------
-    t0 = time.perf_counter()
-    plat = jax.devices()[0].platform
-    x = jnp.ones((128, 128), jnp.bfloat16)
-    np.asarray(x @ x)
-    _stage({"stage": "stage0", "platform": plat,
-            "secs": round(time.perf_counter() - t0, 2)})
+    def stage0():
+        t0 = time.perf_counter()
+        plat = jax.devices()[0].platform
+        x = jnp.ones((128, 128), jnp.bfloat16)
+        np.asarray(x @ x)
+        return {"stage": "stage0", "platform": plat,
+                "secs": round(time.perf_counter() - t0, 2)}
 
     # -- stage1: small graph, small compile ---------------------------------
-    t0 = time.perf_counter()
-    rel_s = build_graph(SMALL_N, AVG_DEG, seed=5)
-    g_s = build_ell(rel_s.indptr, rel_s.indices)
-    seeds_s = make_seeds(SMALL_N, 256, seed=3)
-    mask_s = pack_seed_masks(g_s, seeds_s)
-    with tracing.span("bench.transfer", stage="stage1"):
-        dev_ell_s = device_ell(g_s)
-        jax.block_until_ready([e for _k, e, _r in dev_ell_s.parts
-                               if e is not None])
-    fn_s = make_ell_recurse(dev_ell_s, g_s.outdeg, g_s.n,
-                            mask_s.shape[1])
-    t_c = time.perf_counter()
-    with tracing.span("bench.compile", stage="stage1"):
-        _l, _s, edges_s = fn_s(jax.device_put(mask_s), DEPTH)
-        edges_s = np.asarray(edges_s)
-    compile_s = time.perf_counter() - t_c
-    want = cpu_recurse(rel_s.indptr, rel_s.indices, seeds_s[17], DEPTH)
-    assert int(edges_s[17]) == want, (int(edges_s[17]), want)
-    ts = []
-    for _ in range(3):
-        t_r = time.perf_counter()
-        with tracing.span("bench.execute", stage="stage1"):
-            _l, _s, e2 = fn_s(jax.device_put(mask_s), DEPTH)
-            np.asarray(e2)
-        ts.append(time.perf_counter() - t_r)
-    small_edges = int(edges_s.astype(np.int64).sum())
-    _stage({"stage": "stage1", "secs": round(time.perf_counter() - t0, 2),
-            "compile_secs": round(compile_s, 2),
-            "run_ms": round(min(ts) * 1e3, 1),
-            "edges_per_sec": round(small_edges / min(ts)),
-            "telemetry": _stage_telemetry("stage1")})
-    del dev_ell_s, fn_s
+    def stage1():
+        t0 = time.perf_counter()
+        rel_s = build_graph(SMALL_N, AVG_DEG, seed=5)
+        g_s = build_ell(rel_s.indptr, rel_s.indices)
+        seeds_s = make_seeds(SMALL_N, 256, seed=3)
+        mask_s = pack_seed_masks(g_s, seeds_s)
+        with tracing.span("bench.transfer", stage="stage1"):
+            dev_ell_s = device_ell(g_s)
+            jax.block_until_ready([e for _k, e, _r in dev_ell_s.parts
+                                   if e is not None])
+        fn_s = make_ell_recurse(dev_ell_s, g_s.outdeg, g_s.n,
+                                mask_s.shape[1])
+        t_c = time.perf_counter()
+        with tracing.span("bench.compile", stage="stage1"):
+            _l, _s, edges_s = fn_s(jax.device_put(mask_s), DEPTH)
+            edges_s = np.asarray(edges_s)
+        compile_s = time.perf_counter() - t_c
+        want = cpu_recurse(rel_s.indptr, rel_s.indices, seeds_s[17],
+                           DEPTH)
+        assert int(edges_s[17]) == want, (int(edges_s[17]), want)
+        ts = []
+        for _ in range(3):
+            t_r = time.perf_counter()
+            with tracing.span("bench.execute", stage="stage1"):
+                _l, _s, e2 = fn_s(jax.device_put(mask_s), DEPTH)
+                np.asarray(e2)
+            ts.append(time.perf_counter() - t_r)
+        small_edges = int(edges_s.astype(np.int64).sum())
+        return {"stage": "stage1",
+                "secs": round(time.perf_counter() - t0, 2),
+                "compile_secs": round(compile_s, 2),
+                "run_ms": round(min(ts) * 1e3, 1),
+                "edges_per_sec": round(small_edges / min(ts)),
+                "telemetry": _stage_telemetry("stage1")}
 
     # -- stage2: full workload ----------------------------------------------
-    # synthetic-graph GENERATION is data-gen, not system cost: billed to
-    # gen_secs, never build_secs (ISSUE 7 satellite)
-    t0 = time.perf_counter()
-    rel = build_graph(N_NODES, AVG_DEG)
-    seeds = make_seeds(N_NODES, B)
-    gen_s = time.perf_counter() - t0
-
-    # ELL/plan amortization, measured the way the serving path caches it
-    # (engine/batch._ell_for per snapshot + the plan memo): a cold build
-    # pays the vectorized CSR-transpose + block fill once; a warm re-plan
-    # of the same relation is a memo hit
-    ell_memo = Memo("bench.ell_plan", capacity=4)
-
-    def ell_plan(r):
-        key = (id(r), r.nnz)
-        hit = ell_memo.get(key)
-        if hit is not None:
-            METRICS.inc("plan_cache_hits_total", cache="bench")
-            return hit
-        METRICS.inc("plan_cache_misses_total", cache="bench")
-        with tracing.span("batch.build_ell", pred="bench"):
-            built = build_ell(r.indptr, r.indices)
-        ell_memo.put(key, built)
-        return built
-
-    t0 = time.perf_counter()
-    g = ell_plan(rel)
-    build_s = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    g2 = ell_plan(rel)
-    build_warm_s = time.perf_counter() - t0
-    assert g2 is g
-
-    # lane words: uint64 where the backend allows x64 (half the gather
-    # elements per row at identical bytes — measured ~1.4x on the CPU
-    # backend); the Pallas hop is uint32-only, so the A/B flag pins 32
-    word_bits = 32
-    x64_ctx = contextlib.nullcontext()
-    if not pallas_enabled():
-        try:
-            from jax.experimental import enable_x64
-            x64_ctx = enable_x64()
-            word_bits = 64
-        except ImportError:
-            pass
-
-    with x64_ctx:
-        mask0 = pack_seed_masks(g, seeds, word_bits=word_bits)
-        W = mask0.shape[1]
+    def stage2():
+        # synthetic-graph GENERATION is data-gen, not system cost:
+        # billed to gen_secs, never build_secs (ISSUE 7 satellite)
+        plat = jax.devices()[0].platform
         t0 = time.perf_counter()
-        with tracing.span("bench.transfer", stage="stage2"):
-            dev = device_ell(g)
-            jax.block_until_ready([e for _k, e, _r in dev.parts
-                                   if e is not None])
-        put_s = time.perf_counter() - t0
+        rel = build_graph(N_NODES, AVG_DEG)
+        seeds = make_seeds(N_NODES, B)
+        gen_s = time.perf_counter() - t0
 
-        # count_edges=False: the exact per-query counters come from ONE
-        # post-hoc matvec over (seen, last) — measurement apparatus, not
-        # traversal, so it no longer rides inside every timed hop
-        fn = make_ell_recurse(dev, g.outdeg, g.n, W, count_edges=False,
-                              word_bits=word_bits)
-        count_fn = make_ell_count(g.outdeg, g.n, W, word_bits=word_bits)
+        # ELL/plan amortization, measured the way the serving path
+        # caches it (engine/batch._ell_for per snapshot + the plan
+        # memo): a cold build pays the vectorized CSR-transpose +
+        # block fill once; a warm re-plan is a memo hit
+        ell_memo = Memo("bench.ell_plan", capacity=4)
+
+        def ell_plan(r):
+            key = (id(r), r.nnz)
+            hit = ell_memo.get(key)
+            if hit is not None:
+                METRICS.inc("plan_cache_hits_total", cache="bench")
+                return hit
+            METRICS.inc("plan_cache_misses_total", cache="bench")
+            with tracing.span("batch.build_ell", pred="bench"):
+                built = build_ell(r.indptr, r.indices)
+            ell_memo.put(key, built)
+            return built
+
         t0 = time.perf_counter()
-        with tracing.span("bench.compile", stage="stage2"):
-            out = fn(jax.device_put(mask0), DEPTH)
-            jax.block_until_ready(out)
-        compile_s = time.perf_counter() - t0
+        g = ell_plan(rel)
+        build_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        g2 = ell_plan(rel)
+        build_warm_s = time.perf_counter() - t0
+        assert g2 is g
 
-        ts = []
-        for _ in range(DEV_REPS):
-            # the kernel DONATES its seed mask (buffer reuse across
-            # hops), so each rep re-puts outside the timed region
-            md = jax.device_put(mask0)
-            jax.block_until_ready(md)
+        # lane words: uint64 where the backend allows x64 (half the
+        # gather elements per row at identical bytes — measured ~1.4x
+        # on the CPU backend); the Pallas hop is uint32-only, so the
+        # A/B flag pins 32
+        word_bits = 32
+        x64_ctx = contextlib.nullcontext()
+        if not pallas_enabled():
+            try:
+                from jax.experimental import enable_x64
+                x64_ctx = enable_x64()
+                word_bits = 64
+            except ImportError:
+                pass
+
+        with x64_ctx:
+            mask0 = pack_seed_masks(g, seeds, word_bits=word_bits)
+            W = mask0.shape[1]
             t0 = time.perf_counter()
-            with tracing.span("bench.execute", stage="stage2"):
-                out = fn(md, DEPTH)
+            with tracing.span("bench.transfer", stage="stage2"):
+                dev = device_ell(g)
+                jax.block_until_ready([e for _k, e, _r in dev.parts
+                                       if e is not None])
+            put_s = time.perf_counter() - t0
+
+            # count_edges=False: the exact per-query counters come
+            # from ONE post-hoc matvec over (seen, last) — measurement
+            # apparatus, not traversal, so it no longer rides inside
+            # every timed hop
+            fn = make_ell_recurse(dev, g.outdeg, g.n, W,
+                                  count_edges=False,
+                                  word_bits=word_bits)
+            count_fn = make_ell_count(g.outdeg, g.n, W,
+                                      word_bits=word_bits)
+            t0 = time.perf_counter()
+            with tracing.span("bench.compile", stage="stage2"):
+                out = fn(jax.device_put(mask0), DEPTH)
                 jax.block_until_ready(out)
-            ts.append(time.perf_counter() - t0)
-        last_d, seen_d, _e = out
-        edges = np.asarray(count_fn(last_d, seen_d)).astype(np.int64)
-    dev_s = min(ts)
+            compile_s = time.perf_counter() - t0
 
-    # identical-work check against the parent's numpy walks
-    expect = np.load(expect_path)["edges"][:B]
-    assert np.array_equal(edges, expect), "device/cpu edge counts diverge"
+            ts = []
+            for _ in range(DEV_REPS):
+                # the kernel DONATES its seed mask (buffer reuse
+                # across hops), so each rep re-puts outside the timed
+                # region
+                md = jax.device_put(mask0)
+                jax.block_until_ready(md)
+                t0 = time.perf_counter()
+                with tracing.span("bench.execute", stage="stage2"):
+                    out = fn(md, DEPTH)
+                    jax.block_until_ready(out)
+                ts.append(time.perf_counter() - t0)
+            last_d, seen_d, _e = out
+            edges = np.asarray(count_fn(last_d,
+                                        seen_d)).astype(np.int64)
+        dev_s = min(ts)
 
-    total_edges = int(edges.sum())
-    snap = METRICS.snapshot()["counters"]
-    plan_cache = {
-        "hits": sum(v for k, v in snap.items()
-                    if k.startswith("plan_cache_hits_total")),
-        "misses": sum(v for k, v in snap.items()
-                      if k.startswith("plan_cache_misses_total"))}
-    # HBM traffic model per hop: level-1 index reads + mask-row gathers
-    # + mask elementwise (4 arrays); the edge counter runs once outside
-    # the timed region and is excluded
-    row_bytes = W * (word_bits // 8)
-    gather_bytes = g.padded_edges * (4 + row_bytes)
-    elem_bytes = 4 * (g.n + 1) * row_bytes
-    bytes_per_run = DEPTH * (gather_bytes + elem_bytes)
-    _stage({"stage": "stage2", "platform": plat, "B": B,
-            "word_bits": word_bits,
-            "gen_secs": round(gen_s, 2),
-            "build_secs": round(build_s, 2),
-            "build_secs_warm": round(build_warm_s, 4),
-            "plan_cache": plan_cache,
-            "device_put_secs": round(put_s, 2),
-            "compile_secs": round(compile_s, 2),
-            "dev_s": round(dev_s, 4),
-            "total_edges": total_edges,
-            "edges_per_sec": round(total_edges / dev_s),
-            "hbm_gbps": round(bytes_per_run / dev_s / 1e9, 1),
-            "hbm_frac_of_peak": round(
-                bytes_per_run / dev_s / 1e9 / HBM_PEAK_GBPS, 3),
-            "padded_edges": g.padded_edges,
-            "padded_frac": round(g.padded_edges / max(total_edges, 1),
-                                 3),
-            "telemetry": _stage_telemetry("stage2")})
+        # identical-work check against the parent's numpy walks
+        expect = np.load(expect_path)["edges"][:B]
+        assert np.array_equal(edges, expect), \
+            "device/cpu edge counts diverge"
 
-    # -- maintenance stage: rollup+checkpoint WHILE an IC-style mix runs ----
-    try:
-        _stage(maintenance_stage())
-    except Exception as e:  # noqa: BLE001 — the stage is additive telemetry
-        _stage({"stage": "maintenance", "error": str(e)})
+        total_edges = int(edges.sum())
+        snap = METRICS.snapshot()["counters"]
+        plan_cache = {
+            "hits": sum(v for k, v in snap.items()
+                        if k.startswith("plan_cache_hits_total")),
+            "misses": sum(v for k, v in snap.items()
+                          if k.startswith("plan_cache_misses_total"))}
+        # HBM traffic model per hop: level-1 index reads + mask-row
+        # gathers + mask elementwise (4 arrays); the edge counter runs
+        # once outside the timed region and is excluded
+        row_bytes = W * (word_bits // 8)
+        gather_bytes = g.padded_edges * (4 + row_bytes)
+        elem_bytes = 4 * (g.n + 1) * row_bytes
+        bytes_per_run = DEPTH * (gather_bytes + elem_bytes)
+        return {"stage": "stage2", "platform": plat, "B": B,
+                "word_bits": word_bits,
+                "gen_secs": round(gen_s, 2),
+                "build_secs": round(build_s, 2),
+                "build_secs_warm": round(build_warm_s, 4),
+                "plan_cache": plan_cache,
+                "device_put_secs": round(put_s, 2),
+                "compile_secs": round(compile_s, 2),
+                "dev_s": round(dev_s, 4),
+                "total_edges": total_edges,
+                "edges_per_sec": round(total_edges / dev_s),
+                "hbm_gbps": round(bytes_per_run / dev_s / 1e9, 1),
+                "hbm_frac_of_peak": round(
+                    bytes_per_run / dev_s / 1e9 / HBM_PEAK_GBPS, 3),
+                "padded_edges": g.padded_edges,
+                "padded_frac": round(
+                    g.padded_edges / max(total_edges, 1), 3),
+                "telemetry": _stage_telemetry("stage2")}
 
-    # -- sched stage: cost-prior scheduling A/B (ISSUE 9) -------------------
-    try:
-        _stage(sched_stage())
-    except Exception as e:  # noqa: BLE001 — additive telemetry
-        _stage({"stage": "sched", "error": str(e)})
-
-    # -- mesh stage: sharded-serving scaling vs device count (ISSUE 10) -----
-    try:
-        _stage(mesh_stage())
-    except Exception as e:  # noqa: BLE001 — additive telemetry
-        _stage({"stage": "mesh", "error": str(e)})
+    # every stage rides _run_stage (ISSUE 13): a raised error dumps a
+    # flight bundle and prints {stage, error, bundle} instead of
+    # losing the partial run's telemetry; the child continues
+    for name, fn in (("stage0", stage0), ("stage1", stage1),
+                     ("stage2", stage2),
+                     ("maintenance", maintenance_stage),
+                     ("sched", sched_stage), ("mesh", mesh_stage)):
+        _run_stage(flightrec, name, fn)
     os._exit(0)
 
 
@@ -784,6 +854,12 @@ def maintenance_stage() -> dict:
 # ---------------------------------------------------------------------------
 # parent: staged child supervision
 
+def _stage_ok(doc) -> bool:
+    """A stage counts as produced only when it ran to completion — an
+    error line (with its bundle path) is evidence, not a result."""
+    return doc is not None and "error" not in doc
+
+
 def run_child_staged(platform: str, expect_path: str,
                      budget_s: float) -> tuple[dict, str | None]:
     """Run the staged child; returns (stages dict, error|None). Reads the
@@ -890,14 +966,14 @@ def main() -> None:
     budget = GLOBAL_DEADLINE_S - elapsed - fallback_reserve - 20.0
     stages, err = run_child_staged("default", expect_path, budget)
     platform = stages.get("stage0", {}).get("platform", "none")
-    if "stage2" not in stages:
+    if not _stage_ok(stages.get("stage2")):
         # always retry at the smaller fallback batch — covers both a dead
         # TPU and a TPU-less host where "default" resolved to cpu but the
         # full-size workload blew its budget
         remaining = GLOBAL_DEADLINE_S - (time.perf_counter() - t_main) - 15.0
         cpu_stages, cpu_err = run_child_staged("cpu", expect_path,
                                                remaining)
-        if "stage2" in cpu_stages:
+        if _stage_ok(cpu_stages.get("stage2")):
             stages, platform = cpu_stages, "cpu"
             err = (f"tpu failed ({err}); measured on XLA cpu backend. "
                    f"Prior real-TPU measurements of this workload are "
@@ -912,8 +988,17 @@ def main() -> None:
     out = {"metric": METRIC, "unit": "edges/s",
            "cpu_edges_per_sec": round(cpu_eps),
            "stages": {k: v for k, v in stages.items()}}
+    # flight-recorder evidence (ISSUE 13): every bundle a stage left —
+    # error-path dumps and watchdog convictions alike — is named in
+    # the BENCH JSON so a dead/stalled stage is diagnosable offline
+    bundles = sorted(
+        {doc["bundle"] for doc in stages.values() if doc.get("bundle")}
+        | {p for doc in stages.values()
+           for p in doc.get("flight_dumps", ())})
+    if bundles:
+        out["flight_dumps"] = bundles
     s2 = stages.get("stage2")
-    if s2 is not None:
+    if _stage_ok(s2):
         b = s2["B"]
         dev_total = s2["total_edges"]
         dev_eps = dev_total / s2["dev_s"]
@@ -934,7 +1019,7 @@ def main() -> None:
                                   ("pause_impact_p50", "pause_impact_p99",
                                    "maintenance_jobs", "pauses")
                                   if k in sm}
-    elif "stage1" in stages:
+    elif _stage_ok(stages.get("stage1")):
         s1 = stages["stage1"]
         out.update(value=s1["edges_per_sec"], platform=platform,
                    vs_baseline=0.0,

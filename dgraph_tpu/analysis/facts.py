@@ -130,6 +130,14 @@ def extract_facts(contexts) -> dict:
     from dgraph_tpu.utils.costprior import FEATURES as PRIOR_FEATURES
     prior_features = [{"name": n, "kind": COST_FIELDS[n]["kind"]}
                       for n in PRIOR_FEATURES]
+    # same discipline for the DEBUG SURFACE (ISSUE 13): the endpoint
+    # inventory server/http.py keys its runtime dispatch on is
+    # re-exported verbatim (import-free module, so the analysis CLI
+    # never pulls the server's jax/grpc chain); tests/test_lint.py
+    # pins inventory ↔ runtime route table in both directions
+    from dgraph_tpu.server.debug_routes import DEBUG_ENDPOINTS
+    debug_endpoints = [{"path": p, "doc": d}
+                       for p, d in sorted(DEBUG_ENDPOINTS.items())]
     return {
         "kernels": kernels,
         "kernel_launch_sites": launches,
@@ -140,6 +148,7 @@ def extract_facts(contexts) -> dict:
         "guarded_sites": guarded_sites,
         "cost_record_fields": cost_fields,
         "cost_prior_features": prior_features,
+        "debug_endpoints": debug_endpoints,
         "totals": {
             "kernels": len(kernels),
             "kernel_launch_sites": len(launches),
@@ -153,5 +162,6 @@ def extract_facts(contexts) -> dict:
             "guarded_sites": len(guarded_sites),
             "cost_record_fields": len(cost_fields),
             "cost_prior_features": len(prior_features),
+            "debug_endpoints": len(debug_endpoints),
         },
     }

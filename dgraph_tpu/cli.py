@@ -81,6 +81,9 @@ def cmd_alpha(args) -> int:
         "cost_priors": args.cost_priors,
         "telemetry_push_url": args.telemetry_push_url,
         "telemetry_push_interval_s": args.telemetry_push_interval_s,
+        "diag_dir": args.diag_dir,
+        "stall_factor": args.stall_factor,
+        "stall_floor_ms": args.stall_floor_ms,
         "rpc_retries": args.rpc_retries,
         "breaker_threshold": args.breaker_threshold,
         "breaker_cooldown_ms": args.breaker_cooldown_ms}
@@ -254,6 +257,28 @@ def cmd_alpha(args) -> int:
                  "checkpoint_every_s=%.1f pacing_ms=%.1f",
                  cfg.rollup_after, cfg.checkpoint_every_s,
                  cfg.maintenance_pacing_ms)
+    # flight recorder (utils/flightrec.py): always-on black box —
+    # bounded event ring + the predicted-cost watchdog. A request
+    # running stall_factor× past its costprior prediction, a wedged
+    # queue head, a stalled maintenance job, or a wedged telemetry
+    # pusher writes a self-contained diagnostic bundle to diag_dir
+    # with NO operator action; SIGUSR2 and POST /debug/flightrecorder
+    # dump on demand
+    import dataclasses as _dc
+    import os as _os
+
+    from dgraph_tpu.utils import flightrec
+    diag_dir = cfg.diag_dir or _os.path.join(cfg.p_dir, "diag")
+    flightrec.arm(
+        diag_dir=diag_dir, stall_factor=cfg.stall_factor,
+        stall_floor_ms=cfg.stall_floor_ms, alpha=alpha, pusher=pusher,
+        signals=True,
+        config={f.name: getattr(cfg, f.name)
+                for f in _dc.fields(cfg)})
+    log.info("flight recorder armed: diag_dir=%s stall_factor=%.1f "
+             "stall_floor_ms=%.0f (SIGUSR2 or POST "
+             "/debug/flightrecorder dumps a bundle)", diag_dir,
+             cfg.stall_factor, cfg.stall_floor_ms)
     http_server = make_http_server(alpha, cfg.http_addr, cfg.http_port)
     serve_background(http_server)
     log.info("alpha up: grpc=%d http=%d", grpc_port,
@@ -436,6 +461,40 @@ def cmd_export(args) -> int:
     return 0
 
 
+def cmd_diagnose(args) -> int:
+    """Pull a one-shot diagnostic bundle from a LIVE server: POST
+    /debug/flightrecorder {"action": "dump"} makes the server build
+    (and, when armed with a diag dir, also persist) the full bundle —
+    all-thread stacks, the flight ring, every debug surface, metrics,
+    config — and return it inline; this verb writes it to --out."""
+    import urllib.request
+    xlog.setup(args.log_level)
+    url = f"http://{args.addr}/debug/flightrecorder"
+    req = urllib.request.Request(
+        url, data=json.dumps({"action": "dump"}).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    if args.token:
+        req.add_header("X-Dgraph-AccessToken", args.token)
+    # graftlint: allow(direct-io): operator CLI pulling a debug bundle
+    # over a server's HTTP surface — not a cluster RPC; no breaker/
+    # retry/budget layer applies to a one-shot diagnostic pull
+    with urllib.request.urlopen(req, timeout=args.timeout) as r:
+        doc = json.loads(r.read())
+    bundle = doc["data"]["bundle"]
+    out = args.out or ("flight-"
+                       + "".join(c if c.isalnum() else "-"
+                                 for c in args.addr) + ".json")
+    with open(out, "w") as f:
+        json.dump(bundle, f)
+    print(json.dumps({
+        "path": out,
+        "server_path": doc["data"].get("path"),
+        "trigger": bundle.get("trigger"),
+        "inflight": len(bundle.get("inflight", [])),
+        "surfaces": sorted(bundle.get("surfaces", {}))}))
+    return 0
+
+
 def cmd_debug(args) -> int:
     """Snapshot inspector (reference: dgraph debug p-dir dump)."""
     from dgraph_tpu.store import checkpoint
@@ -536,6 +595,20 @@ def main(argv=None) -> int:
                    help="flush cadence of the live telemetry pusher "
                         "(bounded buffer; drops are counted in "
                         "telemetry_dropped_total, never block serving)")
+    p.add_argument("--diag_dir", default=None,
+                   help="flight-recorder bundle dir (default: "
+                        "<p_dir>/diag); the watchdog, SIGUSR2, and "
+                        "POST /debug/flightrecorder write one-shot "
+                        "diagnostic bundles here")
+    p.add_argument("--stall_factor", type=float, default=None,
+                   help="watchdog convicts an unbounded request at "
+                        "this multiple of its costprior-predicted "
+                        "cost (fallback: lane EMA, then "
+                        "--stall_floor_ms); deadline-carrying "
+                        "requests are judged against their budget")
+    p.add_argument("--stall_floor_ms", type=float, default=None,
+                   help="prediction fallback AND the floor a stall "
+                        "conviction threshold never drops below")
     p.add_argument("--max_inflight", type=int, default=None,
                    help="admission control: concurrent requests per "
                         "lane (read/mutate); 0 = unbounded (off)")
@@ -661,6 +734,20 @@ def main(argv=None) -> int:
     p = sub.add_parser("debug", help="inspect a snapshot dir", parents=[enc])
     p.add_argument("--p", default="p")
     p.set_defaults(fn=cmd_debug)
+
+    p = sub.add_parser("diagnose",
+                       help="pull a one-shot diagnostic bundle from a "
+                            "live server's flight recorder")
+    p.add_argument("addr", help="host:port of the alpha's HTTP surface")
+    p.add_argument("--out", default=None,
+                   help="bundle output path (default: "
+                        "flight-<addr>.json)")
+    p.add_argument("--token", default=None,
+                   help="ACL access token, when the server enforces "
+                        "ACL (the endpoint shares the Alter bar)")
+    p.add_argument("--timeout", type=float, default=30.0)
+    p.add_argument("--log_level", default="info")
+    p.set_defaults(fn=cmd_diagnose)
 
     args = ap.parse_args(argv)
     if getattr(args, "encryption_key_file", None):

@@ -48,7 +48,7 @@ import time
 import grpc
 
 from dgraph_tpu.utils import deadline as dl
-from dgraph_tpu.utils import locks
+from dgraph_tpu.utils import flightrec, locks
 from dgraph_tpu.utils import tracing
 from dgraph_tpu.utils.metrics import METRICS
 
@@ -143,6 +143,8 @@ class PeerTable:
         if to == "open":
             p.opened += 1
         METRICS.set_gauge("breaker_state", _STATE_GAUGE[to], peer=addr)
+        flightrec.emit("breaker.transition", peer=addr, frm=frm, to=to,
+                       consecutive_failures=p.fails)
         # transitions are rare; a zero-duration span doubles as the
         # event record (/debug/traces, OTLP export)
         with tracing.span("breaker.transition", peer=addr, frm=frm,

@@ -53,7 +53,7 @@ import threading
 import time
 from collections import deque
 
-from dgraph_tpu.utils import costprofile, locks, tracing
+from dgraph_tpu.utils import costprofile, flightrec, locks, tracing
 from dgraph_tpu.utils.metrics import METRICS
 
 __all__ = ["AdmissionController", "ServerOverloaded", "LANES"]
@@ -165,6 +165,8 @@ class _Lane:
         """Caller holds the lock: count one shed and build the error."""
         self.shed_total += 1
         METRICS.inc("shed_total", lane=self.name, reason=reason)
+        flightrec.emit("admission.shed", lane=self.name, reason=reason,
+                       cost_us=cost_us)
         if cost_us is not None:
             METRICS.observe("shed_predicted_cost_us", cost_us,
                             lane=self.name)
@@ -193,6 +195,8 @@ class _Lane:
         self.waiters.remove(victim)
         self.shed_total += 1
         METRICS.inc("shed_total", lane=self.name, reason="displaced")
+        flightrec.emit("admission.shed", lane=self.name,
+                       reason="displaced", cost_us=victim.cost_us)
         METRICS.observe("shed_predicted_cost_us", victim.cost_us,
                         lane=self.name)
         victim.displaced = True
@@ -263,6 +267,10 @@ class _Lane:
                         self._publish()
                         METRICS.inc("shed_total", lane=self.name,
                                     reason="deadline")
+                        flightrec.emit("admission.shed",
+                                       lane=self.name,
+                                       reason="deadline",
+                                       cost_us=w.cost_us)
                 if ctx is not None:
                     ctx.check("admission")
                 raise ServerOverloaded(  # cancel-less fallback
@@ -312,6 +320,17 @@ class _Lane:
             else:
                 self.inflight -= 1
                 self._publish()
+
+    def head_wait_s(self) -> tuple[float, float] | None:
+        """(oldest waiter's wait seconds, service EMA seconds), or
+        None when the queue is empty — the flight-recorder watchdog's
+        queue-head stall signal (utils/flightrec.py)."""
+        with self.lock:
+            if not self.waiters:
+                return None
+            oldest = min(self.waiters, key=lambda w: w.seq)
+            return (time.monotonic() - oldest.enq_mono,
+                    self.service_ema_s)
 
     def status(self) -> dict:
         with self.lock:
@@ -375,6 +394,16 @@ class AdmissionController:
                 if ln.waiters:
                     return True
         return False
+
+    def head_waits(self) -> dict:
+        """Per-lane queue-head wait + service EMA (lanes with empty
+        queues omitted) — what the watchdog judges against its slack."""
+        out = {}
+        for name, ln in self.lanes.items():
+            hw = ln.head_wait_s()
+            if hw is not None:
+                out[name] = {"wait_s": hw[0], "service_ema_s": hw[1]}
+        return out
 
     def status(self) -> dict:
         return {"lanes": {name: ln.status()

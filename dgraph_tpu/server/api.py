@@ -31,7 +31,7 @@ from dgraph_tpu.store.mvcc import MVCCStore, Mutation
 from dgraph_tpu.store.schema import parse_schema
 from dgraph_tpu.store.store import Store
 from dgraph_tpu.store.types import Kind, hash_password
-from dgraph_tpu.utils import costprior, costprofile
+from dgraph_tpu.utils import costprior, costprofile, flightrec
 from dgraph_tpu.utils import deadline as dl
 from dgraph_tpu.utils import tracing
 from dgraph_tpu.utils.metrics import METRICS
@@ -414,14 +414,22 @@ class Alpha:
             t0 = time.perf_counter()
             completed = False
             try:
-                if self.admission is not None:
-                    with self.admission.admit(lane, ctx,
-                                              cost_us=predicted):
-                        # budget may have died while queued
-                        ctx.check("admission")
+                # flight-recorder registration (utils/flightrec.py):
+                # the watchdog walks this entry — a request running
+                # far past `predicted` (or wedged past its deadline)
+                # is convicted and dumped with its stack, with no
+                # operator watching
+                with flightrec.track_request(ctx, lane,
+                                             predicted_us=predicted,
+                                             query=query_text):
+                    if self.admission is not None:
+                        with self.admission.admit(lane, ctx,
+                                                  cost_us=predicted):
+                            # budget may have died while queued
+                            ctx.check("admission")
+                            yield ctx
+                    else:
                         yield ctx
-                else:
-                    yield ctx
                 completed = True
             finally:
                 if predicted is not None:
@@ -1893,6 +1901,7 @@ class Alpha:
                     "healed corrupt tablet %s from replica %s "
                     "(on-disk copy rewrites at the next checkpoint)",
                     pred, addr)
+                flightrec.emit("storage.heal", pred=pred, replica=addr)
                 return unpack_tablet(blob, pred, self.mvcc.schema)
         return None
 

@@ -1,0 +1,56 @@
+"""Debug-endpoint inventory: every `/debug/*` route with a one-liner.
+
+ONE vocabulary, two consumers (the `cost_record_fields` pattern):
+`server/http.py` renders `GET /debug` from this dict and keys its
+runtime dispatch table (`_DEBUG_GET`/`_DEBUG_POST`) on the same paths;
+`analysis/facts.py` re-exports it verbatim as `facts.debug_endpoints`.
+tests/test_lint.py pins the inventory and the runtime route table to
+each other in BOTH directions — a new debug endpoint that isn't
+inventoried, or an inventoried path no handler serves, fails tier-1.
+
+This module is deliberately import-free so the static-analysis CLI can
+read the inventory without pulling the server (and its jax/grpc
+dependency chain) into the process.
+"""
+
+from __future__ import annotations
+
+DEBUG_ENDPOINTS: dict[str, str] = {
+    "/debug":
+        "GET: this index — every debug endpoint with a one-liner",
+    "/debug/prometheus_metrics":
+        "GET: every metric series in Prometheus text exposition format",
+    "/debug/traces":
+        "GET: span JSON; ?trace_id= one request's spans, ?peer= proxies "
+        "a cluster peer's registry, ?n= limits the recent ring",
+    "/debug/events":
+        "GET: the same spans as Chrome trace-event JSON — load the "
+        "body in Perfetto / chrome://tracing",
+    "/debug/costs":
+        "GET: shape-keyed cost digests + feature means + top-N "
+        "expensive shapes; ?recent=true adds the raw record ring",
+    "/debug/slow_queries":
+        "GET: structured slow-query ring; ?trace_id= filters to one "
+        "request (its span tree is one hop away at /debug/traces)",
+    "/debug/profile":
+        "GET: device-capture status; POST {action: start|stop} runs a "
+        "single-flight jax.profiler capture (409 on conflict)",
+    "/debug/scheduler":
+        "GET: cost priors with hit/fallback counts, predicted-vs-"
+        "actual error, lane EMAs, feature fit, admission work ahead",
+    "/debug/admission":
+        "GET: per-lane inflight/queued/shed counts + limits",
+    "/debug/locks":
+        "GET: lock-order sanitizer graph, detected cycles (both "
+        "stacks), long holds",
+    "/debug/races":
+        "GET: Eraser lockset race sanitizer reports, each with both "
+        "access stacks",
+    "/debug/peers":
+        "GET: per-peer circuit-breaker state, EMA latency, last error "
+        "+ zero health",
+    "/debug/flightrecorder":
+        "GET: flight ring + watchdog state + recent dumps; POST "
+        "{action: dump} writes and returns a one-shot diagnostic "
+        "bundle (stacks, ring, every debug surface, metrics, config)",
+}

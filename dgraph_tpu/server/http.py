@@ -23,12 +23,52 @@ from dgraph_tpu.dql.upsert import is_upsert as _is_upsert
 from dgraph_tpu.server.admission import ServerOverloaded
 from dgraph_tpu.server.api import (Alpha, NoQuorum, ReadUnavailable,
                                    TxnAborted)
-from dgraph_tpu.utils import costprofile, locks
+from dgraph_tpu.server.debug_routes import DEBUG_ENDPOINTS
+from dgraph_tpu.utils import costprofile, flightrec, locks
 from dgraph_tpu.utils import deadline as dl
 from dgraph_tpu.utils import logging as xlog
 from dgraph_tpu.utils import tracing
 from dgraph_tpu.utils.deadline import Cancelled, DeadlineExceeded
 from dgraph_tpu.utils.metrics import METRICS
+
+# runtime debug route tables: path → Handler method name. Keyed on the
+# same paths as the DEBUG_ENDPOINTS inventory (server/debug_routes.py);
+# tests/test_lint.py pins table ↔ inventory in both directions, so a
+# handler without an inventory row (or vice versa) fails tier-1.
+_DEBUG_GET = {
+    "/debug": "_dbg_index",
+    "/debug/prometheus_metrics": "_dbg_metrics",
+    "/debug/traces": "_dbg_traces",
+    "/debug/events": "_dbg_events",
+    "/debug/costs": "_dbg_costs",
+    "/debug/slow_queries": "_dbg_slow_queries",
+    "/debug/profile": "_dbg_profile",
+    "/debug/scheduler": "_dbg_scheduler",
+    "/debug/admission": "_dbg_admission",
+    "/debug/locks": "_dbg_locks",
+    "/debug/races": "_dbg_races",
+    "/debug/peers": "_dbg_peers",
+    "/debug/flightrecorder": "_dbg_flightrec",
+}
+_DEBUG_POST = {
+    "/debug/profile": "_post_profile",
+    "/debug/flightrecorder": "_post_flightrec",
+}
+
+
+def _route_of(path: str, table: dict) -> str | None:
+    """Longest-prefix match of a request path against a route table
+    ("/debug" itself matches only exactly — it is the index, not a
+    catch-all)."""
+    p = path.partition("?")[0].rstrip("/") or "/"
+    if p == "/debug" and "/debug" in table:
+        return "/debug"
+    best = None
+    for route in table:
+        if route != "/debug" and p.startswith(route):
+            if best is None or len(route) > len(best):
+                best = route
+    return best
 
 # structured slow-query ring: every --slow_query_ms overrun keeps its
 # trace_id alongside the log line, so GET /debug/slow_queries →
@@ -37,6 +77,19 @@ from dgraph_tpu.utils.metrics import METRICS
 _SLOW_MAX = 256
 _SLOW_LOG: deque = deque(maxlen=_SLOW_MAX)
 _SLOW_LOCK = locks.make_lock("http.slowlog")
+
+
+def slow_queries_snapshot(trace_id: str | None = None) -> list[dict]:
+    """The slow-query ring as served by /debug/slow_queries — shared
+    with the flight-recorder bundle builder (utils/flightrec.py) so a
+    dump carries the same view an operator would have pulled live."""
+    now = dl.monotonic_s()
+    with _SLOW_LOCK:
+        entries = [e for e in _SLOW_LOG
+                   if trace_id is None or e["trace_id"] == trace_id]
+    return [{**{k: v for k, v in e.items() if k != "mono_s"},
+             "age_s": round(now - e["mono_s"], 3)}
+            for e in entries]
 
 # how often the per-request watcher peeks the client socket for a
 # mid-request disconnect (an abandoned request must release its
@@ -198,112 +251,8 @@ def make_http_server(alpha: Alpha, addr: str = "127.0.0.1",
                           "maxUID": alpha.oracle.max_uid,
                           "maxTxnTs": alpha.oracle.max_assigned}
                 self._send(200, st)
-            elif self.path == "/debug/prometheus_metrics":
-                self._send(200, METRICS.render(), "text/plain")
-            elif self.path.startswith("/debug/traces"):
-                # span JSON: ?trace_id=… resolves one request's spans
-                # (the id echoed in that response's extensions); bare
-                # GET returns the recent ring buffer; ?peer=host:port
-                # pulls a CLUSTER PEER's registry over the worker
-                # transport (gRPC-leg spans, not just HTTP-originated)
-                spans = self._debug_spans()
-                self._send(200, {"spans": [s.to_dict() for s in spans]})
-            elif self.path.startswith("/debug/events"):
-                # the same spans as Chrome trace-event JSON — load the
-                # body directly in Perfetto / chrome://tracing
-                spans = self._debug_spans()
-                self._send(200, tracing.to_chrome(spans))
-            elif self.path.startswith("/debug/costs"):
-                # shape-keyed query cost profiles: per-shape percentile
-                # digests + feature means + the top-N most expensive
-                # shapes (utils/costprofile.py — the cost-model dataset)
-                qs = urllib.parse.parse_qs(
-                    urllib.parse.urlsplit(self.path).query)
-                n = int((qs.get("n") or [10])[0])
-                doc = costprofile.summary(top_n=n)
-                if (qs.get("recent") or ["false"])[0] == "true":
-                    doc["recent"] = costprofile.recent(min(n, 100))
-                self._send(200, doc)
-            elif self.path.startswith("/debug/slow_queries"):
-                # the slow-query ring; ?trace_id= filters to one
-                # request, whose span tree is one hop away at
-                # /debug/traces?trace_id=
-                qs = urllib.parse.parse_qs(
-                    urllib.parse.urlsplit(self.path).query)
-                tid = (qs.get("trace_id") or [None])[0]
-                now = dl.monotonic_s()
-                with _SLOW_LOCK:
-                    entries = [e for e in _SLOW_LOG
-                               if tid is None or e["trace_id"] == tid]
-                self._send(200, {"slow_queries": [
-                    {**{k: v for k, v in e.items() if k != "mono_s"},
-                     "age_s": round(now - e["mono_s"], 3)}
-                    for e in entries]})
-            elif self.path.startswith("/debug/profile"):
-                # capture status; POST starts/stops (single-flight)
-                self._send(200, tracing.profile_status())
-            elif self.path.startswith("/debug/scheduler"):
-                # cost-prior scheduling state (utils/costprior.py):
-                # live priors with hit/fallback counts, predicted-vs-
-                # actual error digests, lane-EMA fallbacks, the feature
-                # least-squares fit, and the admission lanes' predicted
-                # inflight/queued work
-                from dgraph_tpu.utils import costprior
-                qs = urllib.parse.parse_qs(
-                    urllib.parse.urlsplit(self.path).query)
-                n = int((qs.get("n") or [10])[0])
-                doc = {"enabled": bool(getattr(alpha, "cost_priors",
-                                               False))
-                       and costprior.enabled(),
-                       **costprior.status(top_n=n)}
-                if alpha.admission is not None:
-                    doc["admission"] = alpha.admission.status()
-                # mesh-route view: shard-keyed cost sums recorded by
-                # mesh expansions (engine/execute.py) — how the
-                # scheduler sees work land across the device mesh
-                from dgraph_tpu.utils import costprofile as _cp
-                shard_cost = _cp.shard_costs()
-                if shard_cost:
-                    doc["mesh"] = {"shard_cost_us": shard_cost}
-                self._send(200, doc)
-            elif self.path.startswith("/debug/admission"):
-                # admission-control status: per-lane inflight/queued/
-                # shed counts + limits (the numbers the overload
-                # acceptance test cross-checks against metrics)
-                if alpha.admission is None:
-                    self._send(200, {"enabled": False})
-                else:
-                    self._send(200, {"enabled": True,
-                                     **alpha.admission.status()})
-            elif self.path.startswith("/debug/locks"):
-                # lock-order sanitizer state: acquisition-graph
-                # edges, detected cycles (each with both stacks),
-                # long holds (utils/locks.py; enabled under
-                # DGRAPH_TPU_LOCK_SANITIZER=1, else a stub)
-                from dgraph_tpu.utils import locks
-                self._send(200, locks.GRAPH.snapshot())
-            elif self.path.startswith("/debug/races"):
-                # Eraser lockset race sanitizer state (ISSUE 12):
-                # tracked classes + every report, each with both
-                # access stacks (utils/locks.py; enabled under
-                # DGRAPH_TPU_RACE_SANITIZER=1, else a stub)
-                from dgraph_tpu.utils import locks
-                self._send(200, locks.RACES.snapshot())
-            elif self.path.startswith("/debug/peers"):
-                # per-peer resilience state: breaker state, EMA
-                # latency, consecutive failures, last error — the
-                # operator's answer to "which replica is dying on us"
-                # (cluster/resilience.py PeerTable.snapshot)
-                if alpha.groups is None:
-                    self._send(200, {"enabled": False})
-                else:
-                    res = getattr(alpha.groups, "resilience", None)
-                    doc = {"enabled": res is not None,
-                           "peers": res.snapshot() if res else {}}
-                    zh = getattr(alpha.groups.zero, "health", None)
-                    if zh is not None:
-                        doc["zero"] = zh.snapshot()
-                    self._send(200, doc)
+            elif (route := _route_of(self.path, _DEBUG_GET)) is not None:
+                getattr(self, _DEBUG_GET[route])()
             elif self.path.startswith("/admin/maintenance"):
                 # scheduler status: running/queued jobs, pause state,
                 # policy knobs (reference: /admin health of background
@@ -316,6 +265,181 @@ def make_http_server(alpha: Alpha, addr: str = "127.0.0.1",
                     self._send(200, alpha.maintenance.status())
             else:
                 self._send(404, {"errors": [{"message": "not found"}]})
+
+        # -- /debug surface (dispatch via _DEBUG_GET; every route has
+        # -- an inventory row in server/debug_routes.py — lint-pinned)
+        def _qs(self):
+            return urllib.parse.parse_qs(
+                urllib.parse.urlsplit(self.path).query)
+
+        def _dbg_index(self):
+            # the operator's map: every debug endpoint with its
+            # one-liner, straight from the lint-pinned inventory
+            self._send(200, {"endpoints": [
+                {"path": p, "doc": d}
+                for p, d in sorted(DEBUG_ENDPOINTS.items())]})
+
+        def _dbg_metrics(self):
+            self._send(200, METRICS.render(), "text/plain")
+
+        def _dbg_traces(self):
+            # span JSON: ?trace_id=… resolves one request's spans
+            # (the id echoed in that response's extensions); bare
+            # GET returns the recent ring buffer; ?peer=host:port
+            # pulls a CLUSTER PEER's registry over the worker
+            # transport (gRPC-leg spans, not just HTTP-originated)
+            spans = self._debug_spans()
+            self._send(200, {"spans": [s.to_dict() for s in spans]})
+
+        def _dbg_events(self):
+            # the same spans as Chrome trace-event JSON — load the
+            # body directly in Perfetto / chrome://tracing
+            spans = self._debug_spans()
+            self._send(200, tracing.to_chrome(spans))
+
+        def _dbg_costs(self):
+            # shape-keyed query cost profiles: per-shape percentile
+            # digests + feature means + the top-N most expensive
+            # shapes (utils/costprofile.py — the cost-model dataset)
+            qs = self._qs()
+            n = int((qs.get("n") or [10])[0])
+            doc = costprofile.summary(top_n=n)
+            if (qs.get("recent") or ["false"])[0] == "true":
+                doc["recent"] = costprofile.recent(min(n, 100))
+            self._send(200, doc)
+
+        def _dbg_slow_queries(self):
+            # the slow-query ring; ?trace_id= filters to one
+            # request, whose span tree is one hop away at
+            # /debug/traces?trace_id=
+            tid = (self._qs().get("trace_id") or [None])[0]
+            self._send(200,
+                       {"slow_queries": slow_queries_snapshot(tid)})
+
+        def _dbg_profile(self):
+            # capture status; POST starts/stops (single-flight)
+            self._send(200, tracing.profile_status())
+
+        def _dbg_scheduler(self):
+            # cost-prior scheduling state (utils/costprior.py):
+            # live priors with hit/fallback counts, predicted-vs-
+            # actual error digests, lane-EMA fallbacks, the feature
+            # least-squares fit, and the admission lanes' predicted
+            # inflight/queued work
+            from dgraph_tpu.utils import costprior
+            n = int((self._qs().get("n") or [10])[0])
+            doc = {"enabled": bool(getattr(alpha, "cost_priors",
+                                           False))
+                   and costprior.enabled(),
+                   **costprior.status(top_n=n)}
+            if alpha.admission is not None:
+                doc["admission"] = alpha.admission.status()
+            # mesh-route view: shard-keyed cost sums recorded by
+            # mesh expansions (engine/execute.py) — how the
+            # scheduler sees work land across the device mesh
+            shard_cost = costprofile.shard_costs()
+            if shard_cost:
+                doc["mesh"] = {"shard_cost_us": shard_cost}
+            self._send(200, doc)
+
+        def _dbg_admission(self):
+            # admission-control status: per-lane inflight/queued/
+            # shed counts + limits (the numbers the overload
+            # acceptance test cross-checks against metrics)
+            if alpha.admission is None:
+                self._send(200, {"enabled": False})
+            else:
+                self._send(200, {"enabled": True,
+                                 **alpha.admission.status()})
+
+        def _dbg_locks(self):
+            # lock-order sanitizer state: acquisition-graph
+            # edges, detected cycles (each with both stacks),
+            # long holds (utils/locks.py; enabled under
+            # DGRAPH_TPU_LOCK_SANITIZER=1, else a stub)
+            self._send(200, locks.GRAPH.snapshot())
+
+        def _dbg_races(self):
+            # Eraser lockset race sanitizer state (ISSUE 12):
+            # tracked classes + every report, each with both
+            # access stacks (utils/locks.py; enabled under
+            # DGRAPH_TPU_RACE_SANITIZER=1, else a stub)
+            self._send(200, locks.RACES.snapshot())
+
+        def _dbg_peers(self):
+            # per-peer resilience state: breaker state, EMA
+            # latency, consecutive failures, last error — the
+            # operator's answer to "which replica is dying on us"
+            # (cluster/resilience.py PeerTable.snapshot)
+            if alpha.groups is None:
+                self._send(200, {"enabled": False})
+            else:
+                res = getattr(alpha.groups, "resilience", None)
+                doc = {"enabled": res is not None,
+                       "peers": res.snapshot() if res else {}}
+                zh = getattr(alpha.groups.zero, "health", None)
+                if zh is not None:
+                    doc["zero"] = zh.snapshot()
+                self._send(200, doc)
+
+        def _dbg_flightrec(self):
+            # flight-recorder state (utils/flightrec.py): ring tail,
+            # watchdog config + conviction counts, recent dumps
+            n = int((self._qs().get("n") or [100])[0])
+            self._send_bytes(200, json.dumps(flightrec.state(n),
+                                             default=str).encode())
+
+        def _post_profile(self, acl_user):
+            # on-demand jax.profiler device capture (admin bar):
+            # {"action": "start"|"stop", "dir"?: path}. start while
+            # one is running → 409 (single-flight, tracing.py);
+            # the XLA timeline lands under <dir>/plugins/profile/
+            if alpha.acl is not None:
+                alpha.acl.check_alter(acl_user)
+            body = self._body().decode()
+            req = json.loads(body) if body.strip() else {}
+            action = req.get("action", "start")
+            try:
+                if action == "start":
+                    d = tracing.profile_start(req.get("dir")
+                                              or None)
+                    self._send(200, {"data": {"profiling": True,
+                                              "dir": d}})
+                elif action == "stop":
+                    d = tracing.profile_stop()
+                    self._send(200, {"data": {"profiling": False,
+                                              "dir": d}})
+                else:
+                    self._send(400, {"errors": [{
+                        "message": f"unknown action {action!r} "
+                                   f"(want start|stop)"}]})
+            except RuntimeError as e:
+                # single-flight conflict / no capture running
+                self._send(409, {"errors": [{"message": str(e)}]})
+
+        def _post_flightrec(self, acl_user):
+            # one-shot diagnostic bundle (admin bar): {"action":
+            # "dump"} builds the full bundle — stacks, flight ring,
+            # every debug surface, metrics, config — writes it under
+            # the armed diag dir (when one is configured) and returns
+            # it inline so `dgraph_tpu diagnose` can pull it from a
+            # live server in one POST
+            if alpha.acl is not None:
+                alpha.acl.check_alter(acl_user)
+            body = self._body().decode()
+            req = json.loads(body) if body.strip() else {}
+            action = req.get("action", "dump")
+            if action != "dump":
+                self._send(400, {"errors": [{
+                    "message": f"unknown action {action!r} "
+                               f"(want dump)"}]})
+                return
+            out = flightrec.dump(trigger="http", alpha=alpha,
+                                 reason=req.get("reason"))
+            self._send_bytes(200, json.dumps(
+                {"data": {"path": out["path"],
+                          "bundle": out["bundle"]}},
+                default=str).encode())
 
         def _debug_spans(self):
             qs = urllib.parse.parse_qs(
@@ -493,33 +617,9 @@ def make_http_server(alpha: Alpha, addr: str = "127.0.0.1",
                 self._send(200, {"data": {"accessJWT": token}})
                 return
             acl_user = self._acl_user()
-            if self.path.startswith("/debug/profile"):
-                # on-demand jax.profiler device capture (admin bar):
-                # {"action": "start"|"stop", "dir"?: path}. start while
-                # one is running → 409 (single-flight, tracing.py);
-                # the XLA timeline lands under <dir>/plugins/profile/
-                if alpha.acl is not None:
-                    alpha.acl.check_alter(acl_user)
-                body = self._body().decode()
-                req = json.loads(body) if body.strip() else {}
-                action = req.get("action", "start")
-                try:
-                    if action == "start":
-                        d = tracing.profile_start(req.get("dir")
-                                                  or None)
-                        self._send(200, {"data": {"profiling": True,
-                                                  "dir": d}})
-                    elif action == "stop":
-                        d = tracing.profile_stop()
-                        self._send(200, {"data": {"profiling": False,
-                                                  "dir": d}})
-                    else:
-                        self._send(400, {"errors": [{
-                            "message": f"unknown action {action!r} "
-                                       f"(want start|stop)"}]})
-                except RuntimeError as e:
-                    # single-flight conflict / no capture running
-                    self._send(409, {"errors": [{"message": str(e)}]})
+            post_route = _route_of(self.path, _DEBUG_POST)
+            if post_route is not None:
+                getattr(self, _DEBUG_POST[post_route])(acl_user)
                 return
             deadline_ms = self._deadline_ms()
             if self.path.startswith("/query/batch"):

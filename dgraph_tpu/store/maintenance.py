@@ -36,7 +36,7 @@ import threading
 import time
 from dataclasses import dataclass, field
 
-from dgraph_tpu.utils import locks
+from dgraph_tpu.utils import flightrec, locks
 from dgraph_tpu.utils import logging as xlog
 from dgraph_tpu.utils import tracing
 from dgraph_tpu.utils.metrics import METRICS
@@ -97,6 +97,11 @@ class MaintenanceScheduler:
         self._last_checkpoint = time.monotonic()
         self.jobs_done = 0
         self.jobs_failed = 0
+        # tablet-boundary progress counter: bumped only by the single
+        # scheduler thread (at job start and every _pace call), read by
+        # the flight-recorder watchdog — a RUNNING job whose progress
+        # stops advancing is the stall signal (utils/flightrec.py)
+        self.progress = 0
         locks.guarded(self, "maintenance.cv")
 
     # -- lifecycle -----------------------------------------------------------
@@ -162,6 +167,7 @@ class MaintenanceScheduler:
         waiters (server/admission.py `saturated()`), the job parks at
         this tablet boundary (bounded by LOAD_YIELD_MAX_S) so overload
         never competes with maintenance for the disk/CPU."""
+        self.progress += 1
         if self.pacing_ms > 0:
             time.sleep(self.pacing_ms / 1e3)
         if not self._resume.is_set():
@@ -220,6 +226,7 @@ class MaintenanceScheduler:
         return {"running": running, "paused": self.paused,
                 "queued": queued, "jobs_done": self.jobs_done,
                 "jobs_failed": self.jobs_failed,
+                "progress": self.progress,
                 "rollup_after": self.rollup_after,
                 "checkpoint_every_s": self.checkpoint_every_s,
                 "pacing_ms": self.pacing_ms}
@@ -293,6 +300,9 @@ class MaintenanceScheduler:
     def _run(self, job: Job) -> None:
         with self._cv:
             self._running = job.name
+        self.progress += 1  # a fresh job is progress (scheduler thread)
+        flightrec.emit("maintenance.job", job=job.name,
+                       outcome="started", attempt=job.attempts)
         t0 = time.perf_counter()
         try:
             with tracing.span("maintenance.job", job=job.name,
@@ -301,6 +311,8 @@ class MaintenanceScheduler:
                 sp.attrs["outcome"] = "ok"
             METRICS.inc("maintenance_jobs_total", job=job.name,
                         outcome="ok")
+            flightrec.emit("maintenance.job", job=job.name,
+                           outcome="ok", attempt=job.attempts)
             METRICS.observe("maintenance_job_us",
                             (time.perf_counter() - t0) * 1e6,
                             job=job.name)
@@ -308,6 +320,10 @@ class MaintenanceScheduler:
             job.done.set()
         except Exception as e:  # noqa: BLE001 — retried below
             job.attempts += 1
+            flightrec.emit("maintenance.job", job=job.name,
+                           outcome=("failed" if job.attempts
+                                    >= MAX_ATTEMPTS else "retry"),
+                           attempt=job.attempts, error=str(e)[:200])
             if job.attempts >= MAX_ATTEMPTS:
                 METRICS.inc("maintenance_jobs_total", job=job.name,
                             outcome="failed")

@@ -78,6 +78,11 @@ def corruption(path: str, kind: str, detail: str = "") -> StorageCorruption:
     path (checkpoint load, delta replay, restore verify, sidecars)."""
     from dgraph_tpu.utils.metrics import METRICS
     METRICS.inc("storage_corruption_total", file_kind=kind)
+    # black-box visibility (lazy import: vault sits below utils'
+    # telemetry modules in the import order)
+    from dgraph_tpu.utils import flightrec
+    flightrec.emit("storage.corruption", file=path, file_kind=kind,
+                   detail=detail[:200])
     return StorageCorruption(path, kind=kind, detail=detail)
 
 

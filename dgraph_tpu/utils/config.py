@@ -74,6 +74,15 @@ class AlphaConfig:
                                         # doubling per re-open)
     trace_export: str = ""        # write the span registry as
                                   # OTLP/JSON here on shutdown
+    # flight recorder + watchdog (utils/flightrec.py): always-on black
+    # box; diagnostic bundles land in diag_dir ("" = <p_dir>/diag)
+    diag_dir: str = ""
+    stall_factor: float = 10.0    # convict a request at factor × its
+                                  # costprior prediction (fallback:
+                                  # lane EMA, then stall_floor_ms)
+    stall_floor_ms: float = 500.0  # prediction fallback + the floor a
+                                   # conviction threshold never drops
+                                   # below
     # live telemetry push (utils/push.py): stream spans + cost records
     # to an OTLP collector while serving (unset = graceful no-op)
     telemetry_push_url: str = ""      # collector base URL (…/v1/traces)

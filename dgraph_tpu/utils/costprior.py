@@ -47,7 +47,8 @@ from dgraph_tpu.utils.costprofile import Digest
 from dgraph_tpu.utils.metrics import MAX_LABEL_SETS, METRICS
 
 __all__ = ["FEATURES", "SAMPLE_FLOOR", "BLEND", "CostPriorModel",
-           "PRIORS", "enabled", "set_enabled", "predict", "learn",
+           "PRIORS", "enabled", "set_enabled", "predict", "lane_ema_us",
+           "learn",
            "refit", "status", "save", "load", "reset"]
 
 # ONE feature vocabulary with the runtime cost records: the prior's
@@ -130,6 +131,14 @@ class CostPriorModel:
             if p is not None and p["n"] >= self.sample_floor:
                 return float(p["predicted_us"])
             return None
+
+    def lane_ema_us(self, lane: str) -> float | None:
+        """The lane's observed-cost EMA, or None before any completed
+        request — the watchdog's prediction fallback for requests that
+        arrived without a costprior prediction (utils/flightrec.py)."""
+        with self._lock:
+            v = self._lane_ema.get(lane)
+            return float(v) if v is not None else None
 
     def predict_features(self, features: dict) -> float | None:
         """Linear-model prediction from plan features (known at launch
@@ -391,6 +400,10 @@ def set_enabled(flag: bool) -> None:
 def predict(lane: str, text: str | None = None,
             shape: str | None = None) -> tuple[float, str]:
     return PRIORS.predict(lane, text=text, shape=shape)
+
+
+def lane_ema_us(lane: str) -> float | None:
+    return PRIORS.lane_ema_us(lane)
 
 
 def learn(lane: str, text: str | None, shape: str | None,

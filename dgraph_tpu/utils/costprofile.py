@@ -49,6 +49,7 @@ from dgraph_tpu.utils.metrics import MAX_LABEL_SETS, METRICS
 __all__ = ["FIELDS", "DIGEST_FIELDS", "FEATURE_FIELDS", "Digest",
            "Recorder", "Aggregator", "COSTS", "profile", "active",
            "note", "note_max", "add", "add_shape", "add_kernel",
+           "note_launch",
            "add_tablet_cost", "tablet_costs",
            "add_shard_cost", "shard_costs", "recent",
            "add_sink", "remove_sink", "set_enabled", "summary",
@@ -92,6 +93,8 @@ FIELDS: dict[str, dict] = {
     "ell_cache_hit":     {"kind": "feature", "doc": "1 = every ELL build was a snapshot-cache hit"},
     "jit_cache_hits":    {"kind": "feature", "doc": "jit compile-cache hits during the request"},
     "mesh_shards":       {"kind": "feature", "doc": "mesh shards engaged by the request's expansions (0 = no mesh route)"},
+    "kernel_launches":   {"kind": "feature", "doc": "separately dispatched device kernel launches (the count whole-query fusion collapses to 1)"},
+    "launch_gap_us":     {"kind": "feature", "doc": "host-side µs between consecutive kernel launches — the dispatch overhead baseline for the fusion item"},
 }
 
 DIGEST_FIELDS = tuple(n for n, d in FIELDS.items() if d["kind"] == "cost")
@@ -186,7 +189,8 @@ class Recorder:
     it is thread-local for its request thread; cross-thread
     contributors (none today) would need their own record."""
 
-    __slots__ = ("lane", "vals", "shapes", "kernels", "t0", "trace_id")
+    __slots__ = ("lane", "vals", "shapes", "kernels", "t0", "trace_id",
+                 "_last_launch_end")
 
     def __init__(self, lane: str):
         self.lane = lane
@@ -194,6 +198,7 @@ class Recorder:
         self.shapes: list[str] = []
         self.kernels: dict[str, dict] = {}
         self.t0 = time.perf_counter()
+        self._last_launch_end: float | None = None
         from dgraph_tpu.utils import tracing
         self.trace_id = tracing.current_trace_id()
 
@@ -217,6 +222,19 @@ class Recorder:
         the request is still open (finish() uses the same rule)."""
         return ("+".join(sorted(self.shapes))
                 or self.lane or UNCLASSIFIED)
+
+    def note_launch(self, start_t: float, end_t: float) -> None:
+        """One device kernel launch spanning [start_t, end_t) on the
+        host's perf_counter clock. Counts launches and accumulates the
+        HOST-SIDE GAP since the previous launch ended — the per-request
+        launch/dispatch overhead the whole-query-fusion ROADMAP item
+        needs a measured baseline for (per-shape means surface at
+        /debug/costs)."""
+        self.add("kernel_launches", 1)
+        last = self._last_launch_end
+        if last is not None and start_t > last:
+            self.add("launch_gap_us", int((start_t - last) * 1e6))
+        self._last_launch_end = end_t
 
     def add_kernel(self, family: str, compile_us: float = 0.0,
                    execute_us: float = 0.0) -> None:
@@ -528,6 +546,12 @@ def add_kernel(family: str, compile_us: float = 0.0,
     if rec is not None:
         rec.add_kernel(family, compile_us=compile_us,
                        execute_us=execute_us)
+
+
+def note_launch(start_t: float, end_t: float) -> None:
+    rec = getattr(_TLS, "rec", None)
+    if rec is not None:
+        rec.note_launch(start_t, end_t)
 
 
 def add_tablet_cost(pred: str, us) -> None:

@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 import urllib.request
 
 from dgraph_tpu.utils import costprofile, locks, tracing
@@ -57,6 +58,10 @@ class TelemetryPusher:
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self._backoff_s = 0.0
+        # exporter-loop liveness for the flight-recorder watchdog: the
+        # loop stamps this every cycle; a stale stamp with a non-empty
+        # buffer means the pusher wedged (utils/flightrec.py)
+        self._last_cycle_mono = time.monotonic()
         locks.guarded(self, "push.buffer")
 
     # -- request-path sinks (must stay cheap + non-blocking) -----------------
@@ -102,9 +107,12 @@ class TelemetryPusher:
             # buffer lock (ISSUE-12 audit — the pusher-bookkeeping race)
             with self._lock:
                 delay = self._backoff_s or self.interval_s
+                self._last_cycle_mono = time.monotonic()
             if self._stop.wait(delay):
                 return
             self._push_once()
+            with self._lock:
+                self._last_cycle_mono = time.monotonic()
 
     def _take(self) -> tuple[list, list]:
         with self._lock:
@@ -158,8 +166,12 @@ class TelemetryPusher:
             r.read()
 
     def status(self) -> dict:
+        alive = self._thread is not None and self._thread.is_alive()
         with self._lock:
             return {"url": self.url, "interval_s": self.interval_s,
                     "buffered_spans": len(self._spans),
                     "buffered_costs": len(self._costs),
-                    "backoff_s": self._backoff_s}
+                    "backoff_s": self._backoff_s,
+                    "alive": alive,
+                    "last_cycle_age_s": round(
+                        time.monotonic() - self._last_cycle_mono, 3)}

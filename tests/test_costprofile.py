@@ -236,6 +236,44 @@ def test_debug_costs_serves_shape_digests_for_batch_workload():
         srv.shutdown()
 
 
+def test_kernel_launch_count_and_dispatch_gap_attribution():
+    """ISSUE-13 satellite: per-request kernel-launch count and the
+    host-side gap µs between consecutive launches land in the cost
+    record (new `kernel_launches`/`launch_gap_us` FIELDS fed from the
+    engine/batch.py + treebatch.py launch sites) and surface as
+    /debug/costs feature means — the measured launch/dispatch-overhead
+    baseline the whole-query-fusion ROADMAP item needs before/after."""
+    a = Alpha(device_threshold=10**9)
+    a.alter("friend: [uid] @reverse .\nfollow: [uid] @reverse .")
+    rng = np.random.default_rng(9)
+    lines = []
+    for i in range(1, 64):
+        for j in rng.integers(1, 64, 3):
+            if i != int(j):
+                lines.append(f"<{i}> <friend> <{int(j)}> .")
+                lines.append(f"<{int(j)}> <follow> <{i}> .")
+    a.mutate(set_nquads="\n".join(lines))
+    # two structurally-distinct recurse groups → two separately
+    # dispatched kernels inside ONE request
+    qs = (["{ q(func: uid(%d)) @recurse(depth: 3) { friend uid } }" % i
+           for i in range(1, 5)]
+          + ["{ q(func: uid(%d)) @recurse(depth: 3) { follow uid } }"
+             % i for i in range(1, 5)])
+    a.query_batch(qs)
+    recs = [r for r in costprofile.recent(10)
+            if r["kernel_launches"] >= 2]
+    assert recs, costprofile.recent(10)
+    rec = recs[-1]
+    # two launches → the host gap between them was measured
+    assert rec["launch_gap_us"] > 0
+    st = costprofile.summary(top_n=5)["shapes"][rec["shape"]]
+    assert st["features"]["kernel_launches"] >= 2
+    assert st["features"]["launch_gap_us"] > 0
+    # the new fields are real schema members, never ad-hoc keys
+    assert FIELDS["kernel_launches"]["kind"] == "feature"
+    assert FIELDS["launch_gap_us"]["kind"] == "feature"
+
+
 # ---------------------------------------------------------------------------
 # acceptance: live push pipeline under fault injection
 

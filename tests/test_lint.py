@@ -143,6 +143,38 @@ def test_cost_prior_features_pinned_to_cost_fields():
     assert set(costprior.FEATURES) == set(costprofile.FEATURE_FIELDS)
 
 
+def test_debug_endpoint_inventory_pinned_both_ways():
+    """ISSUE-13 satellite (the cost_record_fields pattern applied to
+    the debug surface): the static endpoint inventory
+    (server/debug_routes.DEBUG_ENDPOINTS, re-exported by facts) and
+    the RUNTIME route table (server/http._DEBUG_GET/_DEBUG_POST) are
+    pinned to each other in both directions — a new debug endpoint
+    that isn't inventoried, or an inventoried path no handler serves,
+    fails tier-1. GET /debug renders this inventory."""
+    from dgraph_tpu.server import http
+    from dgraph_tpu.server.api import Alpha
+    from dgraph_tpu.server.debug_routes import DEBUG_ENDPOINTS
+    a = run(ROOT)
+    facts_eps = {e["path"]: e["doc"] for e in a.facts["debug_endpoints"]}
+    assert facts_eps == DEBUG_ENDPOINTS
+    assert a.facts["totals"]["debug_endpoints"] == len(DEBUG_ENDPOINTS)
+    # runtime GET table ↔ inventory, both directions; POST routes are
+    # a subset (profile + flightrecorder have POST verbs)
+    assert set(http._DEBUG_GET) == set(DEBUG_ENDPOINTS)
+    assert set(http._DEBUG_POST) <= set(DEBUG_ENDPOINTS)
+    # every routed handler resolves to a real method on the runtime
+    # Handler class (the dispatch table cannot point into the void)
+    srv = http.make_http_server(Alpha(device_threshold=10**9))
+    try:
+        handler_cls = srv.RequestHandlerClass
+        for table in (http._DEBUG_GET, http._DEBUG_POST):
+            for route, meth in table.items():
+                assert callable(getattr(handler_cls, meth, None)), \
+                    (route, meth)
+    finally:
+        srv.server_close()
+
+
 def test_cli_json_runs_clean():
     out = subprocess.run(
         [sys.executable, "-m", "dgraph_tpu.analysis", "--format=json"],

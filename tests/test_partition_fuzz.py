@@ -19,6 +19,7 @@ DGRAPH_TPU_FUZZ_SEED=<seed>. Tier-1 runs the 10-iteration smoke;
 `-m slow` runs the 100-iteration exploration.
 """
 
+import contextlib
 import os
 import random
 
@@ -42,6 +43,32 @@ def _counter_sum(prefix: str) -> float:
 SCHEMA = "name: string @index(exact) .\nbalance: int .\n"
 N_ACCT = 4
 PER = 100
+
+
+@contextlib.contextmanager
+def _armed_watchdog(tmp_path):
+    """ISSUE-13 satellite: run a fuzz body with the flight recorder's
+    watchdog ARMED and assert it produced ZERO spurious stall dumps —
+    fault-injected slowness (partitions, heals, virtual delays) that
+    stays inside each request's (fault-extended) deadline must never
+    convict. The floor is generous (nothing in a smoke legitimately
+    runs 10s) so any dump is a real false positive, not noise."""
+    from dgraph_tpu.utils import flightrec
+    stalls0 = _counter_sum("watchdog_stalls_total")
+    flightrec.arm(diag_dir=str(tmp_path / "flight_diag"), poll_s=0.05,
+                  stall_floor_ms=10_000.0, grace_s=5.0)
+    try:
+        yield flightrec
+        dumps = flightrec.dumps()
+        assert dumps == [], (
+            f"armed watchdog produced spurious dumps under fault "
+            f"injection: {dumps}")
+        stalls = _counter_sum("watchdog_stalls_total") - stalls0
+        assert stalls == 0, (
+            f"armed watchdog convicted {stalls} fault-injected "
+            f"request(s) that stayed inside their deadlines")
+    finally:
+        flightrec.disarm()
 
 
 @pytest.fixture()
@@ -598,11 +625,13 @@ def _run_crash_fuzz(bank_trio, seeds):
             >= disk_events
 
 
-def test_crash_restart_fuzz_schedule(bank_trio):
+def test_crash_restart_fuzz_schedule(bank_trio, tmp_path):
     """Tier-1 smoke over the FULL fault space (crash + partition +
     delay + wal_trunc + deadline); DGRAPH_TPU_FUZZ_SEED replays one
     seed exactly (historical seeds for the narrower spaces are
-    untouched — their flags regenerate the identical schedules)."""
+    untouched — their flags regenerate the identical schedules).
+    Runs with the flight-recorder watchdog ARMED (ISSUE 13): the
+    fault churn must leave zero spurious stall dumps."""
     env_seed = os.environ.get("DGRAPH_TPU_FUZZ_SEED")
     seeds = [int(env_seed)] if env_seed else [61000 + i for i in range(3)]
     if not env_seed:
@@ -613,7 +642,8 @@ def test_crash_restart_fuzz_schedule(bank_trio):
                                                wal_trunc=True,
                                                deadline=True,
                                                disk=True).events)
-    _run_crash_fuzz(bank_trio, seeds)
+    with _armed_watchdog(tmp_path):
+        _run_crash_fuzz(bank_trio, seeds)
     # crash/restart churn must not surface a lock-order inversion either
     from dgraph_tpu.utils import locks
     cycles = locks.GRAPH.cycles()
@@ -634,7 +664,7 @@ def test_crash_restart_fuzz_full(bank_trio):
     _run_crash_fuzz(bank_trio, seeds)
 
 
-def test_disk_fault_fuzz_smoke(bank_trio):
+def test_disk_fault_fuzz_smoke(bank_trio, tmp_path):
     """ISSUE-11 tier-1 smoke: seeds chosen so the schedules contain
     every DISK sub-kind (bitflip, trunc, enospc — the vault IO hook
     path) mixed with the full crash/partition space. Each seed rides
@@ -654,7 +684,10 @@ def test_disk_fault_fuzz_smoke(bank_trio):
         assert kinds == {"disk_bitflip", "disk_trunc", "disk_enospc"}, (
             f"chosen seeds must cover every disk sub-kind, got {kinds}")
     d0 = _counter_sum("fault_disk_events_total")
-    _run_crash_fuzz(bank_trio, seeds)
+    # watchdog armed (ISSUE 13): disk faults slow requests through
+    # heals and retries, but none past a deadline — zero stall dumps
+    with _armed_watchdog(tmp_path):
+        _run_crash_fuzz(bank_trio, seeds)
     assert _counter_sum("fault_disk_events_total") > d0
     # disk-fault churn (heals + crash-restarts) stays race-free too
     from dgraph_tpu.utils import locks
