@@ -501,6 +501,7 @@ def mesh_child_main(n_dev: int) -> None:
     assert resharded == 0, resharded  # the steady-path contract
     per_shard_bytes = int(host_srel.indptr_s[0].nbytes
                           + host_srel.indices_s[0].nbytes + 4)
+    from dgraph_tpu.utils import tracing as _tracing
     print(json.dumps({
         "n_dev": d, "platform": jax.devices()[0].platform,
         "depth": MESH_DEPTH, "total_edges": total_edges,
@@ -510,7 +511,10 @@ def mesh_child_main(n_dev: int) -> None:
         "resharded": resharded,
         "shard_balance": round(float(nnz.max())
                                / max(float(nnz.mean()), 1.0), 3),
-        "shard_bytes": per_shard_bytes}), flush=True)
+        "shard_bytes": per_shard_bytes,
+        # per-node trace health (ISSUE 14): this child is one "node"
+        # of the mesh run; the parent folds these into BENCH "fleet"
+        "spans": _tracing.stats()}), flush=True)
     os._exit(0)
 
 
@@ -550,7 +554,26 @@ def mesh_stage() -> dict:
         out["efficiency_4"] = round(e4 / e1 / 4, 3)
         out["resharded"] = sum(v.get("resharded", 0)
                                for v in devices.values())
+    fleet = _fleet_block({n: v.get("spans") for n, v in devices.items()
+                          if isinstance(v, dict)})
+    if fleet is not None:
+        out["fleet"] = fleet
     return out
+
+
+def _fleet_block(per_node: dict) -> dict | None:
+    """Fold per-node tracing.stats() docs into the BENCH "fleet"
+    summary (ISSUE 14): per-node span counts + the overall
+    propagated-trace fraction, so a chip-window run records cross-node
+    trace health for free."""
+    nodes = {str(n): s for n, s in per_node.items() if s}
+    if not nodes:
+        return None
+    total = sum(s["spans_total"] for s in nodes.values())
+    prop = sum(s["propagated_total"] for s in nodes.values())
+    return {"nodes": nodes, "spans_total": total,
+            "propagated_total": prop,
+            "propagated_frac": round(prop / total, 4) if total else 0.0}
 
 
 def lint_stage() -> dict:
@@ -742,12 +765,17 @@ def sched_stage() -> dict:
     imb = {stage: gauges.get('plan_pack_imbalance{stage="%s"}' % stage)
            for stage in ("count", "predicted")}
 
-    return {"stage": "sched",
-            "secs": round(time.perf_counter() - t0, 2),
-            "priors_off": off, "priors_on": on,
-            "prior_fit": fit,
-            "pack_imbalance": imb,
-            "scheduler": costprior.status(top_n=5)}
+    from dgraph_tpu.utils import tracing as _tracing
+    out = {"stage": "sched",
+           "secs": round(time.perf_counter() - t0, 2),
+           "priors_off": off, "priors_on": on,
+           "prior_fit": fit,
+           "pack_imbalance": imb,
+           "scheduler": costprior.status(top_n=5)}
+    fleet = _fleet_block({"local": _tracing.stats()})
+    if fleet is not None:
+        out["fleet"] = fleet
+    return out
 
 
 def maintenance_stage() -> dict:
@@ -1054,6 +1082,14 @@ def main() -> None:
         out["mesh"] = {k: sme[k] for k in
                        ("devices", "scaling_4v1", "efficiency_4",
                         "resharded") if k in sme}
+    # cross-node trace health (ISSUE 14): per-node span counts +
+    # propagated-trace fraction off the mesh/sched stages — the
+    # chip-window run records fleet trace health for free
+    fleet = {name: doc["fleet"] for name, doc in
+             (("mesh", sme), ("sched", ss)) if isinstance(doc, dict)
+             and doc.get("fleet")}
+    if fleet:
+        out["fleet"] = fleet
     out["lint"] = lint_stage()
     emit(out)
     watchdog.cancel()

@@ -461,14 +461,75 @@ def cmd_export(args) -> int:
     return 0
 
 
+def _safe_name(addr: str) -> str:
+    return "".join(c if c.isalnum() else "-" for c in addr)
+
+
+def _diagnose_fleet(args) -> int:
+    """`dgraph_tpu diagnose --fleet`: one directory of diagnostics for
+    the WHOLE cluster — the addressed server's full bundle (the PR-13
+    verb), the fleet snapshot, and every known peer's flight-recorder
+    snapshot pulled through the server's /debug/fleet/flight proxy
+    (the DebugFlight worker RPC), each file named by node."""
+    import os
+    import urllib.request
+    base = f"http://{args.addr}"
+    out_dir = args.out or ("fleet-" + _safe_name(args.addr))
+    os.makedirs(out_dir, exist_ok=True)
+    req = urllib.request.Request(
+        base + "/debug/flightrecorder",
+        data=json.dumps({"action": "dump"}).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    if args.token:
+        req.add_header("X-Dgraph-AccessToken", args.token)
+    # graftlint: allow(direct-io): operator CLI pulling diagnostics
+    # over a server's HTTP surface — not a cluster RPC; no breaker/
+    # retry/budget layer applies to a one-shot diagnostic pull
+    with urllib.request.urlopen(req, timeout=args.timeout) as r:
+        bundle = json.loads(r.read())["data"]["bundle"]
+    with open(os.path.join(out_dir, "local.json"), "w") as f:
+        json.dump(bundle, f)
+    # graftlint: allow(direct-io): same one-shot operator pull
+    with urllib.request.urlopen(base + "/debug/fleet",
+                                timeout=args.timeout) as r:
+        fleet_doc = json.loads(r.read())
+    with open(os.path.join(out_dir, "fleet.json"), "w") as f:
+        json.dump(fleet_doc, f)
+    nodes = sorted(fleet_doc.get("nodes", {}))
+    written, errors = ["local.json", "fleet.json"], dict(
+        fleet_doc.get("errors", {}))
+    for node in nodes:
+        if node == fleet_doc.get("self"):
+            continue  # the local bundle already covers this node
+        try:
+            # graftlint: allow(direct-io): same one-shot operator pull
+            with urllib.request.urlopen(
+                    base + "/debug/fleet/flight?peer=" + node,
+                    timeout=args.timeout) as r:
+                doc = json.loads(r.read())
+            name = _safe_name(node) + ".json"
+            with open(os.path.join(out_dir, name), "w") as f:
+                json.dump(doc, f)
+            written.append(name)
+        except Exception as e:  # noqa: BLE001 — a dark peer degrades the pull
+            errors[node] = f"{type(e).__name__}: {e}"
+    print(json.dumps({"dir": out_dir, "nodes": nodes,
+                      "written": written, "errors": errors}))
+    return 0 if not errors else 1
+
+
 def cmd_diagnose(args) -> int:
     """Pull a one-shot diagnostic bundle from a LIVE server: POST
     /debug/flightrecorder {"action": "dump"} makes the server build
     (and, when armed with a diag dir, also persist) the full bundle —
     all-thread stacks, the flight ring, every debug surface, metrics,
-    config — and return it inline; this verb writes it to --out."""
+    config — and return it inline; this verb writes it to --out.
+    `--fleet` widens the pull to every known cluster node (one
+    directory, one file per node)."""
     import urllib.request
     xlog.setup(args.log_level)
+    if args.fleet:
+        return _diagnose_fleet(args)
     url = f"http://{args.addr}/debug/flightrecorder"
     req = urllib.request.Request(
         url, data=json.dumps({"action": "dump"}).encode(),
@@ -492,6 +553,41 @@ def cmd_diagnose(args) -> int:
         "trigger": bundle.get("trigger"),
         "inflight": len(bundle.get("inflight", [])),
         "surfaces": sorted(bundle.get("surfaces", {}))}))
+    return 0
+
+
+def cmd_fleet(args) -> int:
+    """One cluster-wide observability snapshot from a live server:
+    GET /debug/fleet fans out over every known node (breaker-aware,
+    budget-bounded, partial on dark peers), merges the cost digests
+    exactly, and instance-labels the metrics. Prints a summary;
+    --out writes the full document."""
+    import urllib.request
+    xlog.setup(args.log_level)
+    url = f"http://{args.addr}/debug/fleet"
+    if args.budget_ms:
+        url += f"?budget_ms={args.budget_ms:g}"
+    # graftlint: allow(direct-io): operator CLI pulling a debug
+    # snapshot over a server's HTTP surface — not a cluster RPC; no
+    # breaker/retry/budget layer applies to a one-shot pull
+    with urllib.request.urlopen(url, timeout=args.timeout) as r:
+        doc = json.loads(r.read())
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(doc, f)
+    nodes = doc.get("nodes", {})
+    print(json.dumps({
+        "self": doc.get("self"),
+        "nodes": {a: {"group": n.get("group"),
+                      "spans": n.get("spans"),
+                      "watchdog_armed":
+                          n.get("watchdog", {}).get("armed", False),
+                      "gates": n.get("gates")}
+                  for a, n in sorted(nodes.items())},
+        "errors": doc.get("errors", {}),
+        "cost_records_total":
+            doc.get("costs", {}).get("records_total"),
+        "out": args.out}, indent=1))
     return 0
 
 
@@ -741,13 +837,34 @@ def main(argv=None) -> int:
     p.add_argument("addr", help="host:port of the alpha's HTTP surface")
     p.add_argument("--out", default=None,
                    help="bundle output path (default: "
-                        "flight-<addr>.json)")
+                        "flight-<addr>.json); with --fleet, the "
+                        "output DIRECTORY (default: fleet-<addr>/)")
+    p.add_argument("--fleet", action="store_true",
+                   help="pull diagnostics from EVERY known cluster "
+                        "node into one directory, named by node: the "
+                        "addressed server's full bundle plus each "
+                        "peer's flight snapshot over the DebugFlight "
+                        "RPC")
     p.add_argument("--token", default=None,
                    help="ACL access token, when the server enforces "
                         "ACL (the endpoint shares the Alter bar)")
     p.add_argument("--timeout", type=float, default=30.0)
     p.add_argument("--log_level", default="info")
     p.set_defaults(fn=cmd_diagnose)
+
+    p = sub.add_parser("fleet",
+                       help="one cluster-wide observability snapshot "
+                            "(GET /debug/fleet) from a live server")
+    p.add_argument("addr", help="host:port of any alpha's HTTP surface")
+    p.add_argument("--out", default=None,
+                   help="write the full fleet document here (the "
+                        "summary always prints)")
+    p.add_argument("--budget_ms", type=float, default=0.0,
+                   help="overall fan-out budget (0 = server default); "
+                        "peers past it degrade to an errors entry")
+    p.add_argument("--timeout", type=float, default=30.0)
+    p.add_argument("--log_level", default="info")
+    p.set_defaults(fn=cmd_fleet)
 
     args = ap.parse_args(argv)
     if getattr(args, "encryption_key_file", None):

@@ -112,6 +112,16 @@ class Groups:
             return sorted({a for nodes in self._groups.values()
                            for a in nodes.values() if a != self.my_addr})
 
+    def known_addrs(self) -> list[str]:
+        """Every node in the cluster INCLUDING this one — the fleet
+        fan-out's target list (server/fleet.py). Re-polls membership
+        first, like other_addrs: a fleet snapshot must see nodes that
+        joined after our last refresh."""
+        self.refresh()
+        with self._lock:
+            return sorted({a for nodes in self._groups.values()
+                           for a in nodes.values()})
+
     def peer_health(self) -> dict[str, dict]:
         """This node's breaker/latency view of every peer it dials —
         the `/debug/peers` data in heartbeat form (ISSUE 9: Zero's

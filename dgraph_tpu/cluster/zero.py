@@ -667,14 +667,19 @@ class ZeroService:
         — {node_id, group, addr, peers: {addr: {state, ema_latency_us}},
         tablet_costs: {pred: µs}} — no proto change needed (Payload is
         the existing opaque envelope). Malformed docs are dropped, never
-        a crashed heartbeat loop."""
+        a crashed heartbeat loop. Re-establishes the caller's trace
+        context from metadata (server/task._inbound_trace) so a traced
+        report shows up as ONE cross-process trace."""
         import json as _json
-        try:
-            doc = _json.loads(req.data.decode() or "{}")
-        except (UnicodeDecodeError, ValueError):
-            return pb.Payload(data=b"bad")
-        self.state.report_health(doc)
-        return pb.Payload(data=b"ok")
+
+        from dgraph_tpu.server.task import _inbound_trace
+        with _inbound_trace(ctx):
+            try:
+                doc = _json.loads(req.data.decode() or "{}")
+            except (UnicodeDecodeError, ValueError):
+                return pb.Payload(data=b"bad")
+            self.state.report_health(doc)
+            return pb.Payload(data=b"ok")
 
     def RemoveTablet(self, req: pb.TabletRequest, ctx) -> pb.Payload:
         self._primary_only(ctx)
@@ -1034,6 +1039,16 @@ class ZeroClient:
 
     def _call(self, method: str, req, resp_cls):
         last_err = None
+        # ambient trace context rides zero legs too (the task.Client
+        # pattern): a traced request whose leg reaches Zero — or a
+        # traced health report — stays one cross-process trace
+        from dgraph_tpu.utils import tracing as _tracing
+        kw = {}
+        tid = _tracing.current_trace_id()
+        if tid and _tracing.enabled():
+            kw["metadata"] = (("x-dgraph-trace-id", tid),
+                              ("x-dgraph-parent-span",
+                               str(_tracing.current_span_id())))
         # rotation order: current-first, but known-dead targets
         # (breaker open inside cool-down) sink to the back
         order = [(self._cur + i) % len(self.targets)
@@ -1052,7 +1067,7 @@ class ZeroClient:
                 response_deserializer=resp_cls.FromString)
             t0 = time.monotonic()
             try:
-                out = rpc(req)
+                out = rpc(req, **kw)
             except grpc.RpcError as e:
                 code = e.code()
                 if code == grpc.StatusCode.UNAVAILABLE:

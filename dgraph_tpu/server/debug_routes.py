@@ -53,4 +53,12 @@ DEBUG_ENDPOINTS: dict[str, str] = {
         "GET: flight ring + watchdog state + recent dumps; POST "
         "{action: dump} writes and returns a one-shot diagnostic "
         "bundle (stacks, ring, every debug surface, metrics, config)",
+    "/debug/fleet":
+        "GET: cluster-wide snapshot — per-node fragments fanned out "
+        "over the worker transport, exactly-merged cost digests, "
+        "instance-labeled metrics; degrades per dark peer, never 500s",
+    "/debug/fleet/flight":
+        "GET: flight-recorder snapshot (in-flight ops with stacks, "
+        "ring, watchdog); ?peer=host:port pulls a cluster peer's over "
+        "the DebugFlight RPC, ?n= limits the ring tail",
 }

@@ -49,6 +49,8 @@ _DEBUG_GET = {
     "/debug/races": "_dbg_races",
     "/debug/peers": "_dbg_peers",
     "/debug/flightrecorder": "_dbg_flightrec",
+    "/debug/fleet": "_dbg_fleet",
+    "/debug/fleet/flight": "_dbg_fleet_flight",
 }
 _DEBUG_POST = {
     "/debug/profile": "_post_profile",
@@ -280,7 +282,44 @@ def make_http_server(alpha: Alpha, addr: str = "127.0.0.1",
                 for p, d in sorted(DEBUG_ENDPOINTS.items())]})
 
         def _dbg_metrics(self):
+            # identity gauges (build_info / process_uptime_s) refresh
+            # at render time so every scrape carries a live uptime
+            from dgraph_tpu.server import fleet
+            fleet.refresh_identity_metrics()
             self._send(200, METRICS.render(), "text/plain")
+
+        def _dbg_fleet(self):
+            # cluster-wide snapshot (server/fleet.py): fan out over
+            # every known node through the pooled, breaker-aware
+            # clients; merge cost digests exactly and instance-label
+            # the metrics. Partial on peer failure — never a 500.
+            from dgraph_tpu.server import fleet
+            qs = self._qs()
+            budget = float((qs.get("budget_ms")
+                            or [fleet.FLEET_BUDGET_MS])[0])
+            self._send_bytes(200, json.dumps(
+                fleet.fleet_snapshot(alpha, budget_ms=budget),
+                default=str).encode())
+
+        def _dbg_fleet_flight(self):
+            # a node's flight-recorder snapshot (in-flight ops with
+            # stacks + ring + watchdog); ?peer=host:port pulls a
+            # cluster peer's over the DebugFlight worker RPC — the
+            # operator's manual form of the watchdog's peer pull
+            qs = self._qs()
+            peer = (qs.get("peer") or [None])[0]
+            n = int((qs.get("n") or [256])[0])
+            if peer:
+                from dgraph_tpu.server.task import Client
+                c = Client(peer)
+                try:
+                    doc = c.debug_flight(n)
+                finally:
+                    c.close()
+            else:
+                doc = flightrec.flight_snapshot(n)
+            self._send_bytes(200, json.dumps(doc,
+                                             default=str).encode())
 
         def _dbg_traces(self):
             # span JSON: ?trace_id=… resolves one request's spans
@@ -501,7 +540,21 @@ def make_http_server(alpha: Alpha, addr: str = "127.0.0.1",
             {"out": …, "format"?: "rdf"|"json"}, /admin/checkpoint,
             /admin/pause, /admin/resume. Jobs queue on the background
             scheduler; `?wait=true` blocks for the outcome (admin
-            endpoints share the Alter ACL bar)."""
+            endpoints share the Alter ACL bar).
+
+            Every admin request opens (or, via an inbound X-Trace-Id,
+            joins) a trace; jobs it queues capture the trace id and
+            the scheduler re-establishes it around `maintenance.job`
+            (store/maintenance.py) — an operator-initiated backup is
+            traceable end to end even though it runs later on the
+            scheduler thread."""
+            with tracing.trace(
+                    "http.admin",
+                    trace_id=self.headers.get("X-Trace-Id") or None,
+                    path=self.path.partition("?")[0]) as tid:
+                self._admin_dispatch(acl_user, tid)
+
+        def _admin_dispatch(self, acl_user, tid):
             if alpha.acl is not None:
                 alpha.acl.check_alter(acl_user)
             if self.path.startswith("/admin/backup/verify"):
@@ -545,10 +598,12 @@ def make_http_server(alpha: Alpha, addr: str = "127.0.0.1",
                 result = job.wait(timeout=600.0)
                 self._send(200, {"data": {"job": job.name,
                                           "outcome": "ok",
-                                          "result": result}})
+                                          "result": result,
+                                          "trace_id": tid}})
             else:
                 self._send(200, {"data": {"job": job.name,
-                                          "queued": True}})
+                                          "queued": True,
+                                          "trace_id": tid}})
 
         def do_POST(self):
             t0 = time.perf_counter()
@@ -622,9 +677,14 @@ def make_http_server(alpha: Alpha, addr: str = "127.0.0.1",
                 getattr(self, _DEBUG_POST[post_route])(acl_user)
                 return
             deadline_ms = self._deadline_ms()
+            # inbound X-Trace-Id joins the caller's trace (the HTTP
+            # twin of the gRPC metadata propagation); the id echoes
+            # back as an X-Trace-Id response header either way
+            inbound_tid = self.headers.get("X-Trace-Id") or None
             if self.path.startswith("/query/batch"):
                 req = json.loads(self._body().decode())
                 with tracing.trace("http.query_batch",
+                                   trace_id=inbound_tid,
                                    queries=len(req["queries"])) as tid:
                     outs = alpha.query_batch(req["queries"],
                                              acl_user=acl_user,
@@ -635,8 +695,12 @@ def make_http_server(alpha: Alpha, addr: str = "127.0.0.1",
                 self._slow_query_check(us, tid,
                                        f"<batch of "
                                        f"{len(req['queries'])}>")
-                self._send(200, {"data": outs,
-                                 "extensions": {"trace_id": tid}})
+                self._send_bytes(
+                    200,
+                    json.dumps({"data": outs,
+                                "extensions": {"trace_id": tid}}
+                               ).encode(),
+                    headers={"X-Trace-Id": tid})
             elif self.path.startswith("/query"):
                 body = self._body().decode()
                 if "application/json" in (
@@ -645,7 +709,8 @@ def make_http_server(alpha: Alpha, addr: str = "127.0.0.1",
                     q, variables = req["query"], req.get("variables")
                 else:
                     q, variables = body, None
-                with tracing.trace("http.query") as tid:
+                with tracing.trace("http.query",
+                                   trace_id=inbound_tid) as tid:
                     raw = alpha.query_raw(q, variables,
                                           acl_user=acl_user,
                                           deadline_ms=deadline_ms)
@@ -658,7 +723,8 @@ def make_http_server(alpha: Alpha, addr: str = "127.0.0.1",
                 self._send_bytes(200, b'{"data":' + raw +
                                  b',"extensions":{"server_latency":'
                                  b'{"total_us":%d},"trace_id":"%s"}}'
-                                 % (us, tid.encode()))
+                                 % (us, tid.encode()),
+                                 headers={"X-Trace-Id": tid})
             elif self.path.startswith("/mutate"):
                 ctype = self.headers.get("Content-Type") or ""
                 body = self._body().decode()

@@ -25,10 +25,33 @@ from dgraph_tpu.server.admission import ServerOverloaded
 from dgraph_tpu.server.api import (Alpha, NoQuorum, ReadUnavailable,
                                    StageRefused, TxnAborted)
 from dgraph_tpu.utils import deadline as dl
-from dgraph_tpu.utils import tracing
+from dgraph_tpu.utils import flightrec, tracing
 
 SERVICE_DGRAPH = "dgraph_tpu.Dgraph"
 SERVICE_WORKER = "dgraph_tpu.Worker"
+
+# gRPC metadata keys the ambient trace context rides on — forwarded by
+# Client._attempt exactly the way the remaining deadline budget rides
+# the gRPC timeout, re-established by every worker-side handler via
+# _inbound_trace so a cross-group hop produces ONE trace whose worker
+# spans are genuine children of the coordinator's request trace
+TRACE_ID_MD = "x-dgraph-trace-id"
+PARENT_SPAN_MD = "x-dgraph-parent-span"
+
+
+def _inbound_trace(ctx):
+    """Re-establish the caller's trace context from gRPC metadata (the
+    budget-forwarding pattern applied to trace identity). Returns a
+    context manager; no metadata = no-op."""
+    if ctx is None:
+        return tracing.attach("")
+    md = {k.lower(): v for k, v in (ctx.invocation_metadata() or ())}
+    tid = md.get(TRACE_ID_MD, "")
+    try:
+        parent = int(md.get(PARENT_SPAN_MD) or 0)
+    except ValueError:
+        parent = 0
+    return tracing.attach(tid, parent)
 
 # read-shaped worker RPCs whose outbound calls FORWARD the remaining
 # request budget as the gRPC timeout (the Go context-propagation
@@ -37,7 +60,8 @@ SERVICE_WORKER = "dgraph_tpu.Worker"
 # protocol must run to completion — a budget interrupt between stage
 # and decide would leak an undecided pend.
 _BUDGET_FORWARDED = {"ServeTask", "FetchLog", "TabletSnapshot",
-                     "ChainHead", "Query", "DebugTraces"}
+                     "ChainHead", "Query", "DebugTraces", "DebugFleet",
+                     "DebugFlight"}
 
 # worker RPCs the resilience layer may RE-ATTEMPT on a transport
 # failure (cluster/resilience.py). Every receive path is idempotent —
@@ -46,7 +70,8 @@ _BUDGET_FORWARDED = {"ServeTask", "FetchLog", "TabletSnapshot",
 # refuses non-transport failures (DEADLINE_EXCEEDED, app errors).
 _RETRYABLE_RPCS = {"ServeTask", "Ping", "ChainHead", "ApplyMutation",
                    "ApplyDecision", "FetchLog", "DebugTraces",
-                   "PullTablet", "TabletSnapshot"}
+                   "DebugFleet", "DebugFlight", "PullTablet",
+                   "TabletSnapshot"}
 
 
 def _grpc_deadline_ms(ctx) -> float | None:
@@ -186,10 +211,13 @@ class WorkerService:
         # caller's remaining budget (gRPC deadline) becomes THIS node's
         # request context, so a forwarded hop keeps checkpointing —
         # context propagation, as the reference's ctx crosses
-        # ProcessTaskOverNetwork. Server-side spans land in this peer's
-        # registry, reachable from any node's /debug/traces?peer=.
+        # ProcessTaskOverNetwork. The caller's trace context rides the
+        # same metadata (_inbound_trace), so this handler's spans are
+        # genuine children of the coordinator's request trace — one
+        # trace end to end, with no ?peer= proxying.
         try:
-            with dl.activate(dl.RequestContext(_grpc_deadline_ms(ctx))):
+            with dl.activate(dl.RequestContext(_grpc_deadline_ms(ctx))), \
+                    _inbound_trace(ctx):
                 with tracing.span("worker.serve_task", attr=req.attr,
                                   frontier=len(req.frontier.uids)):
                     with self.alpha._reading(
@@ -255,9 +283,10 @@ class WorkerService:
         via FetchLog before serving (api.Alpha._verify_read_chains).
         Reuses AssignedIds (start_id=node, end_id=head) — no proto
         regen needed for two uint64s."""
-        a = self.alpha
-        nid = a.groups.node_id if a.groups is not None else 0
-        return pb.AssignedIds(start_id=nid, end_id=a._last_sent_ts)
+        with _inbound_trace(ctx):
+            a = self.alpha
+            nid = a.groups.node_id if a.groups is not None else 0
+            return pb.AssignedIds(start_id=nid, end_id=a._last_sent_ts)
 
     def ApplyMutation(self, req: pb.MutationMsg, ctx) -> pb.Payload:
         """Receive a broadcast (log shipping) — mutation, Alter, or
@@ -265,37 +294,44 @@ class WorkerService:
         catch-up BEFORE applying (the ack then certifies the receiver
         converged through this record's ts)."""
         from dgraph_tpu.store.wal import mut_from_bytes
-        if req.stage:
-            # commit-quorum phase 1: durably log as pending, no apply;
-            # the ack is the durability certificate (raft AppendEntries)
-            try:
-                self.alpha.receive_stage(
-                    mut_from_bytes(req.mut_json), int(req.commit_ts),
-                    int(req.origin), int(req.prev_ts))
-            except StageRefused as e:
-                # no armed WAL: the ack would be a durability lie — the
-                # coordinator must not count this node toward majority
-                ctx.abort(grpc.StatusCode.FAILED_PRECONDITION, str(e))
+        with _inbound_trace(ctx):
+            if req.stage:
+                # commit-quorum phase 1: durably log as pending, no
+                # apply; the ack is the durability certificate (raft
+                # AppendEntries)
+                try:
+                    self.alpha.receive_stage(
+                        mut_from_bytes(req.mut_json), int(req.commit_ts),
+                        int(req.origin), int(req.prev_ts))
+                except StageRefused as e:
+                    # no armed WAL: the ack would be a durability lie —
+                    # the coordinator must not count this node toward
+                    # majority
+                    ctx.abort(grpc.StatusCode.FAILED_PRECONDITION,
+                              str(e))
+                return pb.Payload(data=b"ok")
+            if req.drop_all:
+                kind, obj = "drop", None
+            elif req.drop_attr:
+                kind, obj = "drop_attr", req.drop_attr
+            elif req.schema:
+                kind, obj = "schema", req.schema
+            else:
+                kind, obj = "mut", mut_from_bytes(req.mut_json)
+            self.alpha.receive_broadcast(kind, obj, int(req.commit_ts),
+                                         int(req.origin),
+                                         int(req.prev_ts))
             return pb.Payload(data=b"ok")
-        if req.drop_all:
-            kind, obj = "drop", None
-        elif req.drop_attr:
-            kind, obj = "drop_attr", req.drop_attr
-        elif req.schema:
-            kind, obj = "schema", req.schema
-        else:
-            kind, obj = "mut", mut_from_bytes(req.mut_json)
-        self.alpha.receive_broadcast(kind, obj, int(req.commit_ts),
-                                     int(req.origin), int(req.prev_ts))
-        return pb.Payload(data=b"ok")
 
     def ApplyDecision(self, req: pb.DecisionMsg, ctx) -> pb.Payload:
         """Commit-quorum phase 2: resolve a staged ts (apply on commit,
         drop on abort). Idempotent; unknown ts already resolved by
         catch-up."""
-        self.alpha.receive_decision(int(req.commit_ts), bool(req.commit),
-                                    int(req.origin))
-        return pb.Payload(data=b"ok")
+        with _inbound_trace(ctx):
+            self.alpha.receive_decision(int(req.commit_ts),
+                                        bool(req.commit),
+                                        int(req.origin))
+            return pb.Payload(data=b"ok")
 
     def FetchLog(self, req: pb.FetchLogRequest, ctx) -> pb.LogRecords:
         """Serve the local WAL tail above since_ts (reference: raft log
@@ -304,7 +340,8 @@ class WorkerService:
         can extract its own subset."""
         from dgraph_tpu.store.wal import mut_to_bytes, resolved_replay
         since = int(req.since_ts)
-        with tracing.span("worker.fetch_log", since_ts=since) as sp:
+        with _inbound_trace(ctx), \
+                tracing.span("worker.fetch_log", since_ts=since) as sp:
             out = pb.LogRecords(complete=since >= self.alpha._wal_floor)
             if self.alpha.wal is None:
                 out.complete = False
@@ -338,13 +375,43 @@ class WorkerService:
         the payload is the span-dict JSON /debug/traces already
         serves."""
         import json as _json
-        tid = req.schema
-        if tid:
-            spans = tracing.trace_spans(tid)
-        else:
-            spans = tracing.recent(int(req.drop_attr or 256))
-        return pb.Payload(data=_json.dumps(
-            [s.to_dict() for s in spans]).encode())
+        with _inbound_trace(ctx):
+            tid = req.schema
+            if tid:
+                spans = tracing.trace_spans(tid)
+            else:
+                spans = tracing.recent(int(req.drop_attr or 256))
+            return pb.Payload(data=_json.dumps(
+                [s.to_dict() for s in spans]).encode())
+
+    def DebugFleet(self, req: pb.Operation, ctx) -> pb.Payload:
+        """Serve this node's fleet fragment (server/fleet.py
+        node_snapshot: identity, instance metrics exposition, cost-
+        digest state, breaker states, watchdog status, race/lock-gate
+        counts) over the worker transport — the per-node leg
+        GET /debug/fleet fans out on. Reuses Operation → Payload the
+        way DebugTraces does; the caller's remaining budget rides as
+        the gRPC deadline, so a fleet fan-out never waits on a slow
+        peer past its budget."""
+        import json as _json
+        from dgraph_tpu.server import fleet
+        with dl.activate(dl.RequestContext(_grpc_deadline_ms(ctx))), \
+                _inbound_trace(ctx):
+            doc = fleet.node_snapshot(self.alpha)
+        return pb.Payload(data=_json.dumps(doc, default=str).encode())
+
+    def DebugFlight(self, req: pb.Operation, ctx) -> pb.Payload:
+        """Serve this node's flight-recorder snapshot — every in-flight
+        op with its stack and trace spans, the flight ring, watchdog
+        state (utils/flightrec.flight_snapshot) — so a coordinator's
+        watchdog conviction (or an operator's /debug/fleet/flight
+        pull) can see what the implicated PEER was doing when a DCN
+        hop wedged. Operation.drop_attr carries the ring tail length,
+        as DebugTraces does."""
+        import json as _json
+        with _inbound_trace(ctx):
+            doc = flightrec.flight_snapshot(int(req.drop_attr or 256))
+        return pb.Payload(data=_json.dumps(doc, default=str).encode())
 
     def PullTablet(self, req: pb.PullTabletRequest, ctx) -> pb.Payload:
         """Pull a whole tablet from a peer and install it locally — the
@@ -352,27 +419,32 @@ class WorkerService:
         Stream from the old owner to the new). Committed layers above the
         snapshot compose on top, so writes racing the move survive."""
         from dgraph_tpu.cluster.tablet import unpack_tablet
-        src = Client(req.src_addr)
-        try:
-            blob, version = src.tablet_snapshot(
-                req.attr, self.alpha.oracle.read_only_ts())
-        finally:
-            src.close()
-        if blob:
-            pd = unpack_tablet(blob, req.attr, self.alpha.mvcc.schema)
-            self.alpha.mvcc.install_tablet(req.attr, pd)
-            with self.alpha._state_lock:
-                self.alpha.tablet_versions[req.attr] = max(
-                    self.alpha.tablet_versions.get(req.attr, 0), version)
-                self.alpha._stale_preds.discard(req.attr)
-        return pb.Payload(data=b"ok")
+        with _inbound_trace(ctx):
+            src = Client(req.src_addr)
+            try:
+                blob, version = src.tablet_snapshot(
+                    req.attr, self.alpha.oracle.read_only_ts())
+            finally:
+                src.close()
+            if blob:
+                pd = unpack_tablet(blob, req.attr,
+                                   self.alpha.mvcc.schema)
+                self.alpha.mvcc.install_tablet(req.attr, pd)
+                with self.alpha._state_lock:
+                    self.alpha.tablet_versions[req.attr] = max(
+                        self.alpha.tablet_versions.get(req.attr, 0),
+                        version)
+                    self.alpha._stale_preds.discard(req.attr)
+            return pb.Payload(data=b"ok")
 
     def TabletSnapshot(self, req: pb.TabletSnapshotRequest,
                        ctx) -> pb.TabletSnapshot:
         """Serve a whole-tablet snapshot as-of read_ts (reference: Badger
         Stream snapshot / tablet move source)."""
         from dgraph_tpu.cluster.tablet import pack_tablet
-        with tracing.span("worker.tablet_snapshot", attr=req.attr) as sp:
+        with _inbound_trace(ctx), \
+                tracing.span("worker.tablet_snapshot",
+                             attr=req.attr) as sp:
             with self.alpha._reading(int(req.read_ts) or None) as ts:
                 store = self.alpha.mvcc.read_view(ts)
                 pd = store.preds.get(req.attr)
@@ -412,6 +484,8 @@ def make_server(alpha: Alpha, addr: str = "127.0.0.1:0",
             "ApplyDecision": _unary(w.ApplyDecision, pb.DecisionMsg),
             "FetchLog": _unary(w.FetchLog, pb.FetchLogRequest),
             "DebugTraces": _unary(w.DebugTraces, pb.Operation),
+            "DebugFleet": _unary(w.DebugFleet, pb.Operation),
+            "DebugFlight": _unary(w.DebugFlight, pb.Operation),
             "PullTablet": _unary(w.PullTablet, pb.PullTabletRequest),
             "TabletSnapshot": _unary(w.TabletSnapshot,
                                      pb.TabletSnapshotRequest),
@@ -456,37 +530,52 @@ class Client:
         return self._attempt(rpc, method, req)
 
     def _attempt(self, rpc, method: str, req):
-        """One wire attempt, with fault injection and budget
-        forwarding: a read-shaped leg inside an active request context
-        carries the REMAINING budget as its gRPC timeout, so a peer
-        never works past what the client will wait for. An expired
-        budget refuses before the wire; a deadline that fires mid-call
-        surfaces as DeadlineExceeded (ours), NOT RpcError — the peer
-        is alive, OUR budget died, and callers (and the retry policy)
-        must not mistake that for an unreachable replica."""
-        if self.fault_check is not None:
-            self.fault_check()
-        if method in _BUDGET_FORWARDED:
-            ctx = dl.current()
-            if ctx is not None:
-                rem = ctx.remaining_s()
-                if rem is not None:
-                    ctx.check(f"rpc.{method}")
-                    try:
-                        return rpc(req, timeout=rem)
-                    except grpc.RpcError as e:
-                        code = (e.code() if hasattr(e, "code")
-                                else None)
-                        if code == grpc.StatusCode.DEADLINE_EXCEEDED:
-                            ctx.check(f"rpc.{method}")  # raises if dead
-                            from dgraph_tpu.utils.metrics import METRICS
-                            METRICS.inc("deadline_exceeded_total",
-                                        stage=f"rpc.{method}")
-                            raise dl.DeadlineExceeded(
-                                f"budget expired inside {method} RPC",
-                                stage=f"rpc.{method}") from e
-                        raise
-        return rpc(req)
+        """One wire attempt, with fault injection, budget forwarding,
+        and trace propagation: a read-shaped leg inside an active
+        request context carries the REMAINING budget as its gRPC
+        timeout, so a peer never works past what the client will wait
+        for, and the ambient trace context (trace id + innermost open
+        span id) rides as metadata so the peer's handler spans join
+        THIS request's trace. An expired budget refuses before the
+        wire; a deadline that fires mid-call surfaces as
+        DeadlineExceeded (ours), NOT RpcError — the peer is alive, OUR
+        budget died, and callers (and the retry policy) must not
+        mistake that for an unreachable replica. The whole attempt is
+        marked as an in-flight leg (flightrec.rpc_leg) so a watchdog
+        conviction of a request stuck here names this peer."""
+        kw = {}
+        tid = tracing.current_trace_id()
+        if tid and tracing.enabled():
+            kw["metadata"] = ((TRACE_ID_MD, tid),
+                              (PARENT_SPAN_MD,
+                               str(tracing.current_span_id())))
+        with flightrec.rpc_leg(self.peer_addr, method):
+            if self.fault_check is not None:
+                self.fault_check()
+            if method in _BUDGET_FORWARDED:
+                ctx = dl.current()
+                if ctx is not None:
+                    rem = ctx.remaining_s()
+                    if rem is not None:
+                        ctx.check(f"rpc.{method}")
+                        try:
+                            return rpc(req, timeout=rem, **kw)
+                        except grpc.RpcError as e:
+                            code = (e.code() if hasattr(e, "code")
+                                    else None)
+                            if code == \
+                                    grpc.StatusCode.DEADLINE_EXCEEDED:
+                                ctx.check(f"rpc.{method}")  # raises if dead
+                                from dgraph_tpu.utils.metrics import \
+                                    METRICS
+                                METRICS.inc("deadline_exceeded_total",
+                                            stage=f"rpc.{method}")
+                                raise dl.DeadlineExceeded(
+                                    f"budget expired inside {method} "
+                                    f"RPC",
+                                    stage=f"rpc.{method}") from e
+                            raise
+            return rpc(req, **kw)
 
     def query(self, dql: str, start_ts: int = 0) -> dict:
         import json
@@ -548,6 +637,25 @@ class Client:
         r = self._call(SERVICE_WORKER, "DebugTraces",
                        pb.Operation(schema=trace_id, drop_attr=str(n)),
                        pb.Payload)
+        return _json.loads(bytes(r.data).decode())
+
+    def debug_fleet(self) -> dict:
+        """Pull the peer's fleet fragment (DebugFleet RPC): identity,
+        metrics exposition, cost-digest state, breaker states,
+        watchdog status, gate counts — one node's slice of
+        /debug/fleet."""
+        import json as _json
+        r = self._call(SERVICE_WORKER, "DebugFleet", pb.Operation(),
+                       pb.Payload)
+        return _json.loads(bytes(r.data).decode())
+
+    def debug_flight(self, n: int = 256) -> dict:
+        """Pull the peer's flight-recorder snapshot (DebugFlight RPC):
+        in-flight ops with stacks + spans, flight ring tail, watchdog
+        state."""
+        import json as _json
+        r = self._call(SERVICE_WORKER, "DebugFlight",
+                       pb.Operation(drop_attr=str(n)), pb.Payload)
         return _json.loads(bytes(r.data).decode())
 
     def fetch_log(self, since_ts: int):

@@ -64,6 +64,11 @@ class Job:
     done: threading.Event = field(default_factory=threading.Event)
     result: object = None
     error: BaseException | None = None
+    # trace id of the request that triggered this job ("" for policy
+    # jobs): _run re-establishes it, so an operator-initiated backup's
+    # maintenance.job span JOINS the admin request's trace instead of
+    # starting an anonymous one on the scheduler thread
+    trace_id: str = ""
 
     def wait(self, timeout: float | None = None):
         """Block until the job finished; re-raise its terminal error."""
@@ -209,14 +214,17 @@ class MaintenanceScheduler:
     def request_backup(self, dest: str, force_full: bool = False) -> Job:
         from dgraph_tpu.server.backup import backup_alpha
         return self._submit(Job("backup", lambda: backup_alpha(
-            self.alpha, self.p_dir, dest, force_full=force_full)))
+            self.alpha, self.p_dir, dest, force_full=force_full),
+            trace_id=tracing.current_trace_id()))
 
     def request_export(self, out_path: str, format: str = "rdf") -> Job:
         return self._submit(Job("export", lambda: self.alpha.export_to(
-            out_path, format=format, pace=self._pace)))
+            out_path, format=format, pace=self._pace),
+            trace_id=tracing.current_trace_id()))
 
     def request_checkpoint(self) -> Job:
-        return self._submit(Job("checkpoint", self._run_checkpoint))
+        return self._submit(Job("checkpoint", self._run_checkpoint,
+                                trace_id=tracing.current_trace_id()))
 
     def status(self) -> dict:
         with self._cv:
@@ -305,8 +313,11 @@ class MaintenanceScheduler:
                        outcome="started", attempt=job.attempts)
         t0 = time.perf_counter()
         try:
-            with tracing.span("maintenance.job", job=job.name,
-                              attempt=job.attempts) as sp:
+            # re-join the triggering request's trace (attach is a
+            # no-op for policy jobs, whose trace_id is empty)
+            with tracing.attach(job.trace_id), \
+                    tracing.span("maintenance.job", job=job.name,
+                                 attempt=job.attempts) as sp:
                 job.result = job.fn()
                 sp.attrs["outcome"] = "ok"
             METRICS.inc("maintenance_jobs_total", job=job.name,

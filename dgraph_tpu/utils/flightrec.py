@@ -73,7 +73,8 @@ from dgraph_tpu.utils import tracing
 from dgraph_tpu.utils.metrics import METRICS
 
 __all__ = ["FlightRing", "Watchdog", "arm", "disarm", "armed", "emit",
-           "track", "track_request", "dump", "request_dump", "state",
+           "track", "track_request", "rpc_leg", "rpc_in_flight",
+           "flight_snapshot", "dump", "request_dump", "state",
            "dumps", "RING_MAX", "STALL_FACTOR", "STALL_FLOOR_MS"]
 
 RING_MAX = 2048            # events retained in the flight ring
@@ -85,6 +86,7 @@ GRACE_S = 1.0              # slack past a deadline before "wedged"
 MIN_DUMP_INTERVAL_S = 30.0  # watchdog dump rate limit
 MAINT_STALL_S = 120.0      # maintenance job with no tablet progress
 DUMPS_MAX = 16             # recent-dump records retained
+PEER_FLIGHT_BUDGET_MS = 2000.0  # DebugFlight pull budget per conviction
 
 
 def _now_ms() -> int:
@@ -161,6 +163,13 @@ class _Tracked:
 _OPS_LOCK = locks.make_lock("flightrec.ops")
 _OPS: dict[int, _Tracked] = {}
 _IDS = itertools.count(1)
+
+# outstanding outbound RPC per thread: ident → (peer, rpc, started).
+# Single writer per thread (the calling thread itself) + lock-free
+# watchdog reads — the same CPython-atomic plain-dict discipline
+# utils/deadline.py's _ACTIVE uses. This is how a conviction names the
+# wedged PEER: the convicted request's thread is sitting inside a leg.
+_RPC_INFLIGHT: dict[int, tuple] = {}
 
 # recent dump records (path/trigger/reason), bundle-independent so the
 # HTTP surface and BENCH JSON can list them without re-reading disk
@@ -263,7 +272,8 @@ class Watchdog:
         if deadline is not None:
             if now > deadline + self.grace_s:
                 op.convicted = True
-                return ("wedged", {"op": _op_evidence(op, now)})
+                return ("wedged", {"op": _op_evidence(op, now),
+                                   **_peer_leg(op)})
             return None
         base_us = op.predicted_us
         if base_us is None and op.lane:
@@ -275,7 +285,8 @@ class Watchdog:
         if (now - op.started) * 1e6 > threshold_us:
             op.convicted = True
             return ("request", {"threshold_us": int(threshold_us),
-                                "op": _op_evidence(op, now)})
+                                "op": _op_evidence(op, now),
+                                **_peer_leg(op)})
         return None
 
     def _scan_admission(self, now: float):
@@ -463,6 +474,7 @@ def disarm() -> None:
     _STATE = None
     with _OPS_LOCK:
         _OPS.clear()
+    _RPC_INFLIGHT.clear()
     with _DUMPS_LOCK:
         del _DUMPS[:]
 
@@ -547,6 +559,42 @@ def track_request(ctx, lane: str, predicted_us: float | None = None,
     RequestContext (live deadline) and its costprior prediction."""
     return track(f"request.{lane}", ctx=ctx, lane=lane,
                  predicted_us=predicted_us, query=query)
+
+
+@contextlib.contextmanager
+def rpc_leg(peer: str, rpc: str):
+    """Mark an outbound RPC as in flight on this thread
+    (server/task.py Client._attempt wraps every wire attempt): when
+    the watchdog convicts a request whose thread is sitting inside a
+    leg, the conviction names the wedged PEER — not just the wedged
+    request — and the bundle pulls that peer's flight snapshot over
+    the DebugFlight RPC. One global load + None check when disarmed."""
+    if _STATE is None:
+        yield
+        return
+    ident = threading.get_ident()
+    prev = _RPC_INFLIGHT.get(ident)
+    _RPC_INFLIGHT[ident] = (peer, rpc, dl.monotonic_s())
+    try:
+        yield
+    finally:
+        if prev is None:
+            _RPC_INFLIGHT.pop(ident, None)
+        else:
+            _RPC_INFLIGHT[ident] = prev
+
+
+def rpc_in_flight(ident: int) -> tuple | None:
+    """(peer, rpc, started_mono) of the RPC `ident`'s thread is inside
+    right now (None = no outstanding leg)."""
+    return _RPC_INFLIGHT.get(ident)
+
+
+def _peer_leg(op: _Tracked) -> dict:
+    leg = _RPC_INFLIGHT.get(op.ident)
+    if leg is None:
+        return {}
+    return {"peer": leg[0], "peer_rpc": leg[1]}
 
 
 def request_dump(trigger: str) -> None:
@@ -644,10 +692,70 @@ def _build_bundle(trigger: str, reason: dict | None, alpha,
         "metrics": METRICS.render(),
         "config": st.config if st is not None else {},
     }
+    if reason is not None and reason.get("peer"):
+        # peer-correlated diagnostics: the conviction named the peer
+        # its stuck RPC leg is wedged on — pull THAT node's in-flight
+        # snapshot + flight ring so the bundle answers "wedged on
+        # whom" offline (budget-bounded; a dark peer degrades to an
+        # error field, never a failed dump)
+        bundle["peer_flight"] = _pull_peer_flight(
+            alpha, reason["peer"], reason.get("peer_rpc"))
     if st is not None and st.capture_device \
             and trigger.startswith("watchdog"):
         bundle["device_profile"] = _device_capture()
     return bundle
+
+
+def _pull_peer_flight(alpha, addr: str, rpc: str | None) -> dict:
+    """The implicated peer's flight snapshot over the DebugFlight
+    worker RPC — through the shared pooled client (breaker-aware) when
+    the alpha is clustered, an ad-hoc client otherwise."""
+    out: dict = {"addr": addr}
+    if rpc:
+        out["rpc"] = rpc
+    groups = getattr(alpha, "groups", None) if alpha is not None else None
+    try:
+        with dl.activate(dl.RequestContext(PEER_FLIGHT_BUDGET_MS)):
+            if groups is not None:
+                out["flight"] = groups.pool(addr).debug_flight()
+            else:
+                from dgraph_tpu.server.task import Client
+                c = Client(addr)
+                try:
+                    out["flight"] = c.debug_flight()
+                finally:
+                    c.close()
+        METRICS.inc("peer_flight_pulls_total", outcome="ok")
+    except Exception as e:  # noqa: BLE001 — a dark peer must not fail the dump
+        out["error"] = f"{type(e).__name__}: {e}"[:300]
+        METRICS.inc("peer_flight_pulls_total", outcome="error")
+    return out
+
+
+def flight_snapshot(n: int = 256) -> dict:
+    """The DebugFlight RPC / `/debug/fleet/flight` document: every
+    in-flight op WITH its evidence (stack + trace spans), the threads'
+    outstanding RPC legs, the flight ring tail, watchdog state, and
+    recent dumps — state()'s peer-correlated twin. Works disarmed
+    (ring/watchdog sections then empty), like dump()."""
+    now = dl.monotonic_s()
+    with _OPS_LOCK:
+        ops = list(_OPS.values())
+    doc: dict = {
+        "armed": _STATE is not None,
+        "inflight": [_op_evidence(op, now) for op in ops],
+        "rpcs_in_flight": [
+            {"thread": ident, "peer": leg[0], "rpc": leg[1],
+             "age_s": round(now - leg[2], 3)}
+            for ident, leg in list(_RPC_INFLIGHT.items())],
+        "dumps": dumps(),
+    }
+    st = _STATE
+    doc["ring"] = st.ring.recent(n) if st is not None else []
+    doc["watchdog"] = (st.watchdog.state()
+                       if st is not None and st.watchdog is not None
+                       else {"armed": False})
+    return doc
 
 
 def _surfaces(alpha) -> dict:

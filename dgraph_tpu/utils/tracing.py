@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import contextlib
 import itertools
+import os
 import threading
 import time
 import uuid
@@ -40,9 +41,19 @@ _MAX_TRACES = 256          # retained per-trace span lists
 _MAX_TRACE_SPANS = 4096    # spans retained per trace
 _LOCK = locks.make_lock("tracing.registry")
 _TLS = threading.local()
-_IDS = itertools.count(1)  # CPython: count.__next__ is atomic
+# span ids must stay unique when spans from SEVERAL processes merge into
+# one trace (cross-process propagation, /debug/fleet): the counter is
+# salted with the pid in the high bits, so a worker span's parent_id
+# (a coordinator-issued id forwarded over gRPC metadata) can never
+# collide with a locally-issued id. CPython: count.__next__ is atomic.
+_PID = os.getpid()
+_IDS = itertools.count(((_PID & 0xFFFF) << 40) | 1)
 _ENABLED = True
 _SINKS: list = []          # live-export subscribers (utils/push.py)
+# cross-process trace-health counters (the bench "fleet" block):
+# spans recorded, and spans recorded under a PROPAGATED (attach'd)
+# trace context — both under _LOCK with the registries
+_STAT = {"spans": 0, "propagated": 0}
 
 
 @dataclass
@@ -54,13 +65,15 @@ class Span:
     start_us: int = 0           # wall-clock epoch µs (Chrome `ts`)
     dur_us: int = 0
     tid: int = 0                # OS thread id (Chrome track)
+    pid: int = 0                # OS process id (Chrome process row)
     attrs: dict = field(default_factory=dict)
 
     def to_dict(self) -> dict:
         return {"name": self.name, "span_id": self.span_id,
                 "parent_id": self.parent_id, "trace_id": self.trace_id,
                 "start_us": self.start_us, "dur_us": self.dur_us,
-                "tid": self.tid, "attrs": dict(self.attrs)}
+                "tid": self.tid, "pid": self.pid,
+                "attrs": dict(self.attrs)}
 
 
 # reused sink for disabled spans: callers may still write attrs into it
@@ -150,6 +163,44 @@ def current_trace_id() -> str:
     return getattr(_TLS, "trace_id", "")
 
 
+def current_span_id() -> int:
+    """The innermost open span's id on this thread (0 = none) — what an
+    outbound RPC forwards as the remote child's parent id."""
+    stack = getattr(_TLS, "stack", None)
+    return stack[-1] if stack else 0
+
+
+@contextlib.contextmanager
+def attach(trace_id: str, parent_id: int = 0):
+    """Re-establish a PROPAGATED trace context on this thread: spans
+    opened inside index under `trace_id`, and (when `parent_id` is
+    given) parent to that FOREIGN span id — so a worker-side handler's
+    spans become genuine children of the coordinator's request trace,
+    and a maintenance job joins the admin request that triggered it.
+    Empty `trace_id` is a no-op (the common un-traced RPC path)."""
+    if not trace_id:
+        yield
+        return
+    from dgraph_tpu.utils.metrics import METRICS
+    METRICS.inc("trace_propagated_total")
+    prev = getattr(_TLS, "trace_id", "")
+    _TLS.trace_id = trace_id
+    stack = getattr(_TLS, "stack", None)
+    if stack is None:
+        stack = _TLS.stack = []
+    pushed = bool(parent_id)
+    if pushed:
+        stack.append(parent_id)
+    _TLS.attach_depth = getattr(_TLS, "attach_depth", 0) + 1
+    try:
+        yield
+    finally:
+        _TLS.attach_depth -= 1
+        if pushed:
+            stack.pop()
+        _TLS.trace_id = prev
+
+
 @contextlib.contextmanager
 def trace(name: str = "request", trace_id: str | None = None, **attrs):
     """Establish a trace context: every span opened on this thread while
@@ -189,7 +240,7 @@ def span(name: str, device: bool = False, **attrs):
              # graftlint: allow(wall-clock): span start is an EPOCH timestamp —
              # Perfetto/OTLP exports align traces across processes by wall clock
              start_us=int(time.time() * 1e6),
-             tid=threading.get_ident(), attrs=attrs)
+             tid=threading.get_ident(), pid=_PID, attrs=attrs)
     stack.append(sid)
     t0 = time.perf_counter()
     prof = None
@@ -208,7 +259,11 @@ def span(name: str, device: bool = False, **attrs):
             prof.__exit__(None, None, None)
         stack.pop()
         s.dur_us = int((time.perf_counter() - t0) * 1e6)
+        propagated = getattr(_TLS, "attach_depth", 0) > 0
         with _LOCK:
+            _STAT["spans"] += 1
+            if propagated:
+                _STAT["propagated"] += 1
             _BUF.append(s)
             if s.trace_id:
                 spans = _TRACES.get(s.trace_id)
@@ -252,6 +307,16 @@ def trace_spans(trace_id: str) -> list[Span]:
         return list(_TRACES.get(trace_id, ()))
 
 
+def stats() -> dict:
+    """Cross-process trace health: spans recorded and the fraction
+    recorded under a propagated (attach'd) trace context — the bench
+    "fleet" block and the /debug/fleet per-node fragments read this."""
+    with _LOCK:
+        spans, prop = _STAT["spans"], _STAT["propagated"]
+    return {"spans_total": spans, "propagated_total": prop,
+            "propagated_frac": round(prop / spans, 4) if spans else 0.0}
+
+
 def to_chrome(spans: list[Span]) -> dict:
     """Chrome trace-event JSON (the `ph:"X"` complete-event form) —
     loadable in Perfetto / chrome://tracing. Span attrs ride in `args`;
@@ -261,7 +326,10 @@ def to_chrome(spans: list[Span]) -> dict:
         "traceEvents": [
             {"name": s.name, "cat": "dgraph_tpu", "ph": "X",
              "ts": s.start_us, "dur": max(s.dur_us, 1),
-             "pid": 1, "tid": s.tid,
+             # each originating process is its own Perfetto process row,
+             # so a merged cross-process trace renders both sides on one
+             # timeline (historical spans without a pid fold under 1)
+             "pid": s.pid or 1, "tid": s.tid,
              "args": {**{k: _jsonable(v) for k, v in s.attrs.items()},
                       "span_id": s.span_id, "parent_id": s.parent_id,
                       "trace_id": s.trace_id}}
@@ -325,6 +393,8 @@ def to_otlp(spans: list[Span]) -> dict:
                       "value": {"stringValue": s.trace_id}})
         attrs.append({"key": "dgraph.tid",
                       "value": {"intValue": str(s.tid)}})
+        attrs.append({"key": "dgraph.pid",
+                      "value": {"intValue": str(s.pid)}})
         out.append({
             "traceId": _otlp_trace_id(s.trace_id),
             "spanId": f"{s.span_id:016x}",
@@ -352,13 +422,15 @@ def from_otlp(doc: dict) -> list[Span]:
     for rs in doc.get("resourceSpans", ()):
         for ss in rs.get("scopeSpans", ()):
             for o in ss.get("spans", ()):
-                attrs, tid, os_tid = {}, "", 0
+                attrs, tid, os_tid, os_pid = {}, "", 0, 0
                 for kv in o.get("attributes", ()):
                     v = _from_otlp_any(kv.get("value", {}))
                     if kv["key"] == "dgraph.trace_id":
                         tid = v
                     elif kv["key"] == "dgraph.tid":
                         os_tid = int(v)
+                    elif kv["key"] == "dgraph.pid":
+                        os_pid = int(v)
                     else:
                         attrs[kv["key"]] = v
                 start_us = int(o["startTimeUnixNano"]) // 1000
@@ -370,7 +442,7 @@ def from_otlp(doc: dict) -> list[Span]:
                     trace_id=tid,
                     start_us=start_us,
                     dur_us=int(o["endTimeUnixNano"]) // 1000 - start_us,
-                    tid=os_tid, attrs=attrs))
+                    tid=os_tid, pid=os_pid, attrs=attrs))
     return spans
 
 
@@ -390,3 +462,4 @@ def clear() -> None:
     with _LOCK:
         _BUF.clear()
         _TRACES.clear()
+        _STAT["spans"] = _STAT["propagated"] = 0

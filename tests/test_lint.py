@@ -162,6 +162,10 @@ def test_debug_endpoint_inventory_pinned_both_ways():
     # a subset (profile + flightrecorder have POST verbs)
     assert set(http._DEBUG_GET) == set(DEBUG_ENDPOINTS)
     assert set(http._DEBUG_POST) <= set(DEBUG_ENDPOINTS)
+    # ISSUE-14: the fleet + flight-pull routes are inventoried (and,
+    # via the set equality above, routed) — neither surface can drift
+    assert "/debug/fleet" in DEBUG_ENDPOINTS
+    assert "/debug/fleet/flight" in DEBUG_ENDPOINTS
     # every routed handler resolves to a real method on the runtime
     # Handler class (the dispatch table cannot point into the void)
     srv = http.make_http_server(Alpha(device_threshold=10**9))
