@@ -59,7 +59,14 @@ MAINT_N = 220              # maintenance-stage store size (host-side)
 METRIC = f"edges_traversed_per_sec_{DEPTH}hop_recurse_{B_DEV}q"
 GLOBAL_DEADLINE_S = 780
 STAGE_DEADLINES = {"stage0": 150.0, "stage1": 240.0, "stage2": 330.0,
-                   "maintenance": 60.0, "sched": 90.0, "mesh": 300.0}
+                   "maintenance": 60.0, "sched": 240.0, "mesh": 300.0}
+
+# whole-query fusion A/B (ISSUE 15): the same fixed-seed small-query
+# template mix served with DGRAPH_TPU_FUSED toggled in a child each —
+# small-query p50/p99 + mean kernel_launches/launch_gap_us per shape,
+# and a response digest pinning the two paths bit-identical
+FUSED_AB_REPS = 20
+FUSED_CHILD_TIMEOUT_S = 110.0
 
 # mesh stage: reshard-free chained hops over 1/2/4 host devices
 # (ISSUE 10) — one grandchild per device count, XLA_FLAGS set before
@@ -720,6 +727,115 @@ def run_sched_workload(priors_on: bool, chain_n: int = 2000,
         costprior.PRIORS.sample_floor = floor0
 
 
+def fused_child_main() -> None:
+    """One arm of the whole-query-fusion A/B (ISSUE 15): serve the
+    fixed-seed small-query template mix with DGRAPH_TPU_FUSED as the
+    parent set it (the flag must bind per-process — route selection is
+    sticky-cached per shape). device_threshold=0 forces device kernels
+    at every level, so the staged arm pays the real launch chain the
+    fused arm collapses. Prints ONE JSON line: p50/p99 over the mix,
+    mean kernel_launches + launch_gap_us overall and per shape, route
+    counts, and a sha256 over the raw response bytes (the parent pins
+    the two arms' digests equal — bit-identity is part of the A/B)."""
+    import hashlib
+
+    from dgraph_tpu.server.api import Alpha
+    from dgraph_tpu.utils import costprofile
+    from dgraph_tpu.utils.metrics import METRICS
+
+    fused_on = os.environ.get("DGRAPH_TPU_FUSED", "1") != "0"
+    a = Alpha(device_threshold=0)
+    a.alter("friend: [uid] @reverse .\nname: string @index(exact) .")
+    rng = np.random.default_rng(11)
+    lines = []
+    for i in range(1, 257):
+        lines.append(f'<{i}> <name> "p{i % 23}" .')
+        for j in rng.integers(1, 257, 4):
+            if i != int(j):
+                lines.append(f"<{i}> <friend> <{int(j)}> .")
+    a.mutate(set_nquads="\n".join(lines))
+    qs = [
+        '{ q(func: uid(0x2)) { uid friend { uid friend { uid } } } }',
+        '{ q(func: eq(name, "p7")) { name friend '
+        '@filter(eq(name, "p3")) { name } } }',
+        '{ q(func: uid(0x5)) { friend (first: 3) { uid } '
+        '~friend { uid } } }',
+        '{ q(func: uid(0x9)) @recurse(depth: 3) { uid friend } }',
+        '{ q(func: uid(0x4)) { c as count(friend) friend { uid } } '
+        'm() { max(val(c)) } }',
+    ]
+    # warm both arms identically: parse caches, jit compiles, and the
+    # fused cap memo stay out of the measurement (steady-state serving
+    # is the claim, not first-request compile cost)
+    for q in qs:
+        a.query(q)
+        a.query(q)
+    costprofile.reset()
+    lat: list = []
+    digest = hashlib.sha256()
+    for _ in range(FUSED_AB_REPS):
+        for q in qs:
+            t0 = time.perf_counter()
+            raw = a.query_raw(q)
+            lat.append((time.perf_counter() - t0) * 1e6)
+            digest.update(raw)
+    lat.sort()
+    shapes = {}
+    w_launch = w_gap = w_n = 0.0
+    for shape, st in costprofile.summary(top_n=64)["shapes"].items():
+        feats = st.get("features", {})
+        shapes[shape] = {
+            "count": st["count"],
+            "mean_kernel_launches": feats.get("kernel_launches", 0),
+            "mean_launch_gap_us": feats.get("launch_gap_us", 0)}
+        w_launch += feats.get("kernel_launches", 0) * st["count"]
+        w_gap += feats.get("launch_gap_us", 0) * st["count"]
+        w_n += st["count"]
+    n = len(lat)
+    print(json.dumps({
+        "fused": fused_on,
+        "queries": n,
+        "p50_us": round(lat[n // 2]),
+        "p99_us": round(lat[min(n - 1, int(n * 0.99))]),
+        "mean_kernel_launches": round(w_launch / max(w_n, 1), 2),
+        "mean_launch_gap_us": round(w_gap / max(w_n, 1)),
+        "shapes": shapes,
+        "routes": {r: METRICS.get("fused_route_total", route=r)
+                   for r in ("fused", "staged", "fallback")},
+        "digest": digest.hexdigest(),
+    }), flush=True)
+    os._exit(0)
+
+
+def _run_fused_ab() -> dict:
+    """Spawn the fused ON and OFF arms (same workload, same seed, the
+    flag toggled in each child's env) and join the headline: p50
+    speedup, launch collapse, and the bit-identity digest check."""
+    arms: dict[str, dict] = {}
+    for arm, flag in (("off", "0"), ("on", "1")):
+        env = dict(os.environ)
+        env["DGRAPH_TPU_FUSED"] = flag
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__),
+                 "--fused-child"],
+                capture_output=True, text=True, cwd=ROOT, env=env,
+                timeout=FUSED_CHILD_TIMEOUT_S)
+            arms[arm] = json.loads(proc.stdout.strip().splitlines()[-1])
+        except Exception as e:  # noqa: BLE001 — per-arm isolation
+            arms[arm] = {"error": f"{type(e).__name__}: {e}"}
+    out = {"off": arms["off"], "on": arms["on"]}
+    on, off = arms["on"], arms["off"]
+    if "digest" in on and "digest" in off:
+        out["identical"] = on["digest"] == off["digest"]
+        if on.get("p50_us"):
+            out["p50_speedup"] = round(off["p50_us"] / on["p50_us"], 3)
+        out["launch_collapse"] = {
+            "off_mean": off["mean_kernel_launches"],
+            "on_mean": on["mean_kernel_launches"]}
+    return out
+
+
 def sched_stage() -> dict:
     """Cost-prior scheduling A/B (ISSUE 9 headline): the mixed workload
     with priors on vs off — cheap-query p50/p99 and shed precision —
@@ -771,6 +887,9 @@ def sched_stage() -> dict:
            "priors_off": off, "priors_on": on,
            "prior_fit": fit,
            "pack_imbalance": imb,
+           # whole-query fusion ON/OFF on the same fixed-seed workload
+           # (ISSUE 15): the launch-collapse headline, measured
+           "fused_ab": _run_fused_ab(),
            "scheduler": costprior.status(top_n=5)}
     fleet = _fleet_block({"local": _tracing.stats()})
     if fleet is not None:
@@ -1103,5 +1222,7 @@ if __name__ == "__main__":
                    else os.path.join(ROOT, ".bench_expect.npz"))
     elif len(sys.argv) >= 3 and sys.argv[1] == "--mesh-child":
         mesh_child_main(int(sys.argv[2]))
+    elif len(sys.argv) >= 2 and sys.argv[1] == "--fused-child":
+        fused_child_main()
     else:
         main()

@@ -28,6 +28,13 @@ so the same pass extracts:
   recorded cost joins back to the kernels/spans that incurred it
   (tests/test_lint.py pins the two in sync — the join key for the
   future learned cost model).
+* **fused_stage_kinds** — the whole-query fused-program inventory
+  (ISSUE 15, engine/fused.STAGE_KINDS): every stage kind the plan
+  compiler can emit into one jitted program, pinned both ways
+  against the runtime stage-emitter registry. Rule R13 extends the
+  R6 jit-purity facts to these programs: a jitted fused stage may
+  not call costprofile/tracing/metrics host helpers in the traced
+  region.
 
 Emitted under `"facts"` in `--format=json` output.
 """
@@ -138,6 +145,16 @@ def extract_facts(contexts) -> dict:
     from dgraph_tpu.server.debug_routes import DEBUG_ENDPOINTS
     debug_endpoints = [{"path": p, "doc": d}
                        for p, d in sorted(DEBUG_ENDPOINTS.items())]
+    # same discipline for the WHOLE-QUERY FUSED PROGRAM (ISSUE 15):
+    # the stage-kind inventory the plan compiler can emit
+    # (engine/fused.STAGE_KINDS — a jax-free import by design) is
+    # re-exported verbatim; tests/test_lint.py pins it against the
+    # runtime stage-emitter registry in both directions, so a stage
+    # the compiler emits but the inventory doesn't name (or an
+    # inventoried kind no emitter serves) fails tier-1
+    from dgraph_tpu.engine.fused import STAGE_KINDS
+    fused_stages = [{"kind": k, "doc": d}
+                    for k, d in sorted(STAGE_KINDS.items())]
     return {
         "kernels": kernels,
         "kernel_launch_sites": launches,
@@ -149,6 +166,7 @@ def extract_facts(contexts) -> dict:
         "cost_record_fields": cost_fields,
         "cost_prior_features": prior_features,
         "debug_endpoints": debug_endpoints,
+        "fused_stage_kinds": fused_stages,
         "totals": {
             "kernels": len(kernels),
             "kernel_launch_sites": len(launches),
@@ -163,5 +181,6 @@ def extract_facts(contexts) -> dict:
             "cost_record_fields": len(cost_fields),
             "cost_prior_features": len(prior_features),
             "debug_endpoints": len(debug_endpoints),
+            "fused_stage_kinds": len(fused_stages),
         },
     }

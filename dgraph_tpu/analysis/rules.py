@@ -35,6 +35,16 @@ R8 atomic-write          durable files under store/ (and
 
 R9–R12 (lock discipline / data races) live in `guards.py` — the
 Eraser-style static half of the race sanitizer (ISSUE 12).
+
+R13 fused-host-callback  a jitted function in the fused-program layer
+                         (engine/fused.py, ops/) may not call
+                         costprofile/tracing/metrics/jit-accounting
+                         host helpers inside the traced region — they
+                         would run at TRACE time only (silent no-op on
+                         cached executions) or force a host callback
+                         into the one-launch program (ISSUE 15;
+                         extends the R6 jit-purity facts to the fused
+                         program inventory).
 """
 
 from __future__ import annotations
@@ -45,7 +55,7 @@ from dgraph_tpu.analysis import FileContext, Finding, Rule
 
 __all__ = ["default_rules", "HotLoopCheckpoint", "DirectIO", "WallClock",
            "RetryDeadline", "MetricDocs", "JitPurity", "ShardMapCompat",
-           "AtomicWrite"]
+           "FusedHostCallback", "AtomicWrite"]
 
 
 def _dotted(node: ast.AST) -> str:
@@ -458,6 +468,44 @@ class ShardMapCompat(Rule):
 
 
 # ---------------------------------------------------------------------------
+class FusedHostCallback(Rule):
+    name = "fused-host-callback"
+    doc = ("R13: jitted functions in the fused-program layer "
+           "(engine/fused.py, ops/) must keep host accounting OUT of "
+           "the traced region — a costprofile/tracing/METRICS/"
+           "jit_call/deadline call inside runs once at trace time "
+           "(then silently never again on cached executions) or drags "
+           "a host round-trip into the single-launch program; account "
+           "around the dispatch, never inside it")
+
+    SCOPES = ("dgraph_tpu/ops/",)
+    HOST_HELPERS = ("costprofile", "tracing", "METRICS", "deadline")
+    HOST_CALLS = frozenset({"jit_call", "note_launch", "launch_frame"})
+
+    def applies(self, rel: str) -> bool:
+        return (rel.startswith(self.SCOPES)
+                or rel == "dgraph_tpu/engine/fused.py")
+
+    def check_file(self, ctx: FileContext) -> list[Finding]:
+        out = []
+        for fn, _statics in JitPurity()._jitted_functions(ctx.tree):
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                d = _dotted(node.func)
+                root = d.split(".", 1)[0]
+                leaf = d.rsplit(".", 1)[-1]
+                if root in self.HOST_HELPERS or leaf in self.HOST_CALLS:
+                    out.append(Finding(
+                        self.name, ctx.rel, node.lineno,
+                        f"host accounting call {d}() inside jitted "
+                        f"function {fn.name}() — it runs at trace "
+                        f"time only; move it outside the traced "
+                        f"region (around the dispatch site)"))
+        return out
+
+
+# ---------------------------------------------------------------------------
 class AtomicWrite(Rule):
     name = "atomic-write"
     doc = ("persistence-layer files (store/, server/backup.py) must be "
@@ -522,4 +570,5 @@ def default_rules() -> list[Rule]:
     from dgraph_tpu.analysis.guards import guard_rules
     return [HotLoopCheckpoint(), DirectIO(), WallClock(),
             RetryDeadline(), MetricDocs(), JitPurity(),
-            ShardMapCompat(), AtomicWrite()] + guard_rules()
+            ShardMapCompat(), FusedHostCallback(),
+            AtomicWrite()] + guard_rules()

@@ -430,8 +430,8 @@ def run_batch(store, plan, device_threshold: int) -> list:
             _last, _seen, _edges, hops = fn(jax.device_put(mask0),
                                             plan.depth, True)
         hops = np.asarray(hops)      # [depth, n+1, W] fresh masks
+    # launch count + dispatch gap are recorded by jit_call itself
     exec_us = (time.perf_counter() - t_exec) * 1e6
-    costprofile.note_launch(t_exec, time.perf_counter())
     costprofile.add_kernel("recurse", execute_us=exec_us)
     costprofile.add_tablet_cost(plan.attr, exec_us)
     # gather-traffic model per hop (the bench's HBM model): index reads
@@ -618,15 +618,13 @@ def _run_shortest_batch(store, plan: _ShortestPlan,
                 # uninterruptible dispatch of SHORTEST_STAGE hops
                 deadline.checkpoint("kernel")
                 chunk = min(SHORTEST_STAGE, plan.depth - done)
-                t_launch = time.perf_counter()
                 with jit_call("bfs.ell_step",
                               (plan.attr, plan.reverse, W, chunk,
                                plan.first_visit, n)):
                     frontier, seen, hops = step(frontier, seen, chunk)
                 hops_np = np.asarray(hops)
-                # each staged dispatch is one launch: the host gap
-                # between them is the fusion item's overhead baseline
-                costprofile.note_launch(t_launch, time.perf_counter())
+                # each staged dispatch is one launch: jit_call counts
+                # it and bills the host gap between stages
                 for h in range(chunk):
                     lvl = hops_np[h]
                     levels.append(lvl)

@@ -577,6 +577,13 @@ class Executor:
             if sg.var_name:
                 self.uid_vars[sg.var_name] = data.nodes
             return node
+        # whole-query fusion (engine/fused.py): an eligible block tree
+        # compiles into ONE jitted program — zero host round-trips
+        # between levels. None → the staged path below, bit-identical.
+        from dgraph_tpu.engine.fused import try_fused
+        fused_node = try_fused(self, sg)
+        if fused_node is not None:
+            return fused_node
         display = self.root_display(sg)
         nodes = np.unique(display).astype(np.int32)
         node = LevelNode(sg=sg, nodes=nodes, display=display.astype(np.int32))

@@ -52,6 +52,16 @@ class RecurseData:
     all_nodes: np.ndarray = field(default_factory=lambda: np.zeros(0, np.int32))
 
 
+def split_children(ex, sg: SubGraph, data: RecurseData) -> RecurseData:
+    """Partition a recurse block's children into edge predicates vs
+    leaves — ONE rule shared by the host loop, the mesh paths, and the
+    whole-query fused program (engine/fused.py), so the routing can
+    never fork."""
+    for c in sg.children:
+        (data.edge_sgs if ex._expands(c) else data.leaf_sgs).append(c)
+    return data
+
+
 def expand_recurse(ex, root) -> None:
     """Run the recurse loop below an already-evaluated root LevelNode."""
     from dgraph_tpu.engine.execute import LevelNode  # noqa: F401 (doc)
@@ -62,9 +72,7 @@ def expand_recurse(ex, root) -> None:
     if args.loop and not args.depth:
         raise ValueError("@recurse(loop: true) requires depth")
 
-    data = RecurseData(loop=args.loop)
-    for c in root.sg.children:
-        (data.edge_sgs if ex._expands(c) else data.leaf_sgs).append(c)
+    data = split_children(ex, root.sg, RecurseData(loop=args.loop))
 
     # Single-predicate depth-bounded visit-once recursions run as ONE
     # compiled SPMD program on the mesh (all hops inside one lax.scan over

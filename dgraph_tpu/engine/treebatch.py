@@ -367,7 +367,7 @@ def run_tree_batch(store, plan: TreePlan, device_threshold: int) -> list:
         with jit_call("treebatch.tree_kernel", (plan.sig, W, n)):
             outs = fn(tuple(jax.device_put(m) for m in seeds_np),
                       tuple(jax.device_put(m) for m in filts_np))
-    costprofile.note_launch(t_exec, _time.perf_counter())
+    # launch count + dispatch gap are recorded by jit_call itself
     costprofile.add_kernel(
         "tree", execute_us=(_time.perf_counter() - t_exec) * 1e6)
 

@@ -397,7 +397,11 @@ class Alpha:
         is measurable after the fact."""
         outer = dl.current()
         if outer is not None:
-            yield outer
+            # nested leg on the outer recorder: frame the launch-gap
+            # baseline so the leg boundary (parse/apply work, not
+            # dispatch overhead) is never billed as a launch gap
+            with costprofile.launch_frame():
+                yield outer
             return
         if deadline_ms is None and self.default_deadline_ms:
             deadline_ms = self.default_deadline_ms
@@ -875,9 +879,12 @@ class Alpha:
                 store = routed_view(self, store, ts)
             if self.acl is not None and acl_user is not None:
                 store = self.acl.readable_view(acl_user, store)
-            out, ex = Engine(
-                store, device_threshold=self.device_threshold,
-                mesh=self.mesh).query_with_vars(query_src)
+            # the upsert's query leg is a nested sub-request on the
+            # mutate recorder: its own launch-gap frame
+            with costprofile.launch_frame():
+                out, ex = Engine(
+                    store, device_threshold=self.device_threshold,
+                    mesh=self.mesh).query_with_vars(query_src)
         uid_vars = {
             name: store.uid_of(np.asarray(ranks, np.int32)).tolist()
             for name, ranks in ex.uid_vars.items()}

@@ -28,7 +28,9 @@ DEBUG_ENDPOINTS: dict[str, str] = {
         "body in Perfetto / chrome://tracing",
     "/debug/costs":
         "GET: shape-keyed cost digests + feature means + top-N "
-        "expensive shapes; ?recent=true adds the raw record ring",
+        "expensive shapes + the fused-program cache (per-shape "
+        "hits/misses/compile µs); ?recent=true adds the raw record "
+        "ring",
     "/debug/slow_queries":
         "GET: structured slow-query ring; ?trace_id= filters to one "
         "request (its span tree is one hop away at /debug/traces)",
@@ -37,7 +39,8 @@ DEBUG_ENDPOINTS: dict[str, str] = {
         "single-flight jax.profiler capture (409 on conflict)",
     "/debug/scheduler":
         "GET: cost priors with hit/fallback counts, predicted-vs-"
-        "actual error, lane EMAs, feature fit, admission work ahead",
+        "actual error, lane EMAs, feature fit, admission work ahead, "
+        "fused-vs-staged route counts + program cache",
     "/debug/admission":
         "GET: per-lane inflight/queued/shed counts + limits",
     "/debug/locks":

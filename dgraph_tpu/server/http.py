@@ -343,6 +343,10 @@ def make_http_server(alpha: Alpha, addr: str = "127.0.0.1",
             qs = self._qs()
             n = int((qs.get("n") or [10])[0])
             doc = costprofile.summary(top_n=n)
+            # whole-query fused-program cache (engine/fused.py):
+            # per-shape hits/misses/compile µs + sticky-fallback bits
+            from dgraph_tpu.engine import fused
+            doc["fused_programs"] = fused.status()
             if (qs.get("recent") or ["false"])[0] == "true":
                 doc["recent"] = costprofile.recent(min(n, 100))
             self._send(200, doc)
@@ -379,6 +383,14 @@ def make_http_server(alpha: Alpha, addr: str = "127.0.0.1",
             shard_cost = costprofile.shard_costs()
             if shard_cost:
                 doc["mesh"] = {"shard_cost_us": shard_cost}
+            # fused-vs-staged route selection (engine/fused.py):
+            # per-route counts + the program cache the scheduler's
+            # per-PROGRAM cost priors learn from
+            from dgraph_tpu.engine import fused
+            doc["fused"] = {
+                "routes": {r: METRICS.get("fused_route_total", route=r)
+                           for r in ("fused", "staged", "fallback")},
+                **fused.status()}
             self._send(200, doc)
 
         def _dbg_admission(self):

@@ -36,6 +36,15 @@ surfaced at `GET /debug/scheduler` with live hit/fallback counts
 (`cost_prior_hits_total` / `cost_prior_fallbacks_total`). The model
 persists as `costpriors.json` beside `costprofiles.json` and merges
 back on boot exactly as the digests do.
+
+Whole-query fusion (ISSUE 15, engine/fused.py) composes with all of
+this for free: a fused request records a `fused` shape component, so
+its digests — and therefore the priors fit from them — key per
+PROGRAM (shape `fused+q:...`, `kernel_launches == 1`) while the
+staged runs of the same template keep their per-kernel-chain shape.
+Admission predictions and the batch planner's cost gates sharpen as
+the fused route warms, with no new code path here: the shape
+vocabulary IS the mechanism.
 """
 
 from __future__ import annotations

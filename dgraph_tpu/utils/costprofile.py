@@ -49,7 +49,7 @@ from dgraph_tpu.utils.metrics import MAX_LABEL_SETS, METRICS
 __all__ = ["FIELDS", "DIGEST_FIELDS", "FEATURE_FIELDS", "Digest",
            "Recorder", "Aggregator", "COSTS", "profile", "active",
            "note", "note_max", "add", "add_shape", "add_kernel",
-           "note_launch",
+           "note_launch", "launch_frame",
            "add_tablet_cost", "tablet_costs",
            "add_shard_cost", "shard_costs", "recent",
            "add_sink", "remove_sink", "set_enabled", "summary",
@@ -227,14 +227,33 @@ class Recorder:
         """One device kernel launch spanning [start_t, end_t) on the
         host's perf_counter clock. Counts launches and accumulates the
         HOST-SIDE GAP since the previous launch ended — the per-request
-        launch/dispatch overhead the whole-query-fusion ROADMAP item
-        needs a measured baseline for (per-shape means surface at
-        /debug/costs)."""
+        launch/dispatch overhead the whole-query-fusion item needed a
+        measured baseline for (per-shape means surface at /debug/costs,
+        and the fused path's acceptance number is this feature
+        collapsing to 1). The last-launch timestamp is per-Recorder-
+        FRAME (`launch_frame`): a nested sub-request leg (an upsert's
+        query, a txn read inside a mutate) interleaving launches on the
+        same thread must not bill its leg boundary — which includes
+        parse/apply work, not dispatch overhead — as a launch gap."""
         self.add("kernel_launches", 1)
         last = self._last_launch_end
         if last is not None and start_t > last:
             self.add("launch_gap_us", int((start_t - last) * 1e6))
         self._last_launch_end = end_t
+
+    @contextlib.contextmanager
+    def launch_frame(self):
+        """Scope one nested sub-request leg's launch-gap accounting:
+        entering resets the gap baseline (the outer leg's last launch
+        is not this leg's predecessor), leaving resets it again (this
+        leg's last launch is not the outer leg's predecessor). Launch
+        COUNTS still accumulate into the one shared record — only the
+        gap attribution is frame-local."""
+        self._last_launch_end = None
+        try:
+            yield
+        finally:
+            self._last_launch_end = None
 
     def add_kernel(self, family: str, compile_us: float = 0.0,
                    execute_us: float = 0.0) -> None:
@@ -552,6 +571,20 @@ def note_launch(start_t: float, end_t: float) -> None:
     rec = getattr(_TLS, "rec", None)
     if rec is not None:
         rec.note_launch(start_t, end_t)
+
+
+@contextlib.contextmanager
+def launch_frame():
+    """Module-level form of `Recorder.launch_frame` for contributor
+    sites that don't hold the recorder (`Alpha._request`'s nested
+    branch, the upsert query leg): a no-op when no request is being
+    profiled."""
+    rec = getattr(_TLS, "rec", None)
+    if rec is None:
+        yield
+        return
+    with rec.launch_frame():
+        yield
 
 
 def add_tablet_cost(pred: str, us) -> None:

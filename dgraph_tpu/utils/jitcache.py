@@ -39,29 +39,41 @@ def seen(kernel: str, key: tuple) -> bool:
 def jit_call(kernel: str, key: tuple):
     """Wrap one jitted-kernel launch; classifies it as compile (first
     time this static key is seen) or cache hit, and feeds the shared
-    metrics/tracing registries. Yields True when a compile is expected."""
+    metrics/tracing registries. Yields True when a compile is expected.
+
+    Every `jit_call` site is exactly one device dispatch, so this is
+    ALSO where per-request launch accounting lives: the wrapped span
+    feeds `costprofile.note_launch` — `kernel_launches` counts one per
+    site reached, and the host-side gap since the previous launch in
+    the same recorder frame lands in `launch_gap_us` (the dispatch-
+    overhead baseline the whole-query fused path collapses to a single
+    launch)."""
     from dgraph_tpu.utils import costprofile
     with _lock:
         new = (kernel, key) not in _seen
         if new:
             _seen.add((kernel, key))
-    if not new:
-        METRICS.inc("jit_cache_hits_total", kernel=kernel)
-        costprofile.add("jit_cache_hits", 1)
-        yield False
-        return
-    METRICS.inc("jit_compile_total", kernel=kernel)
     t0 = time.perf_counter()
-    with tracing.span("jit.compile", kernel=kernel, key=str(key)):
-        try:
-            yield True
-        finally:
-            compile_us = (time.perf_counter() - t0) * 1e6
-            METRICS.observe("jit_compile_us", compile_us,
-                            buckets=COMPILE_BUCKETS_US, kernel=kernel)
-            # per-kernel-family compile cost joins the request's cost
-            # record (the compile-vs-execute split the cost model needs)
-            costprofile.add_kernel(kernel, compile_us=compile_us)
+    try:
+        if not new:
+            METRICS.inc("jit_cache_hits_total", kernel=kernel)
+            costprofile.add("jit_cache_hits", 1)
+            yield False
+            return
+        METRICS.inc("jit_compile_total", kernel=kernel)
+        with tracing.span("jit.compile", kernel=kernel, key=str(key)):
+            try:
+                yield True
+            finally:
+                compile_us = (time.perf_counter() - t0) * 1e6
+                METRICS.observe("jit_compile_us", compile_us,
+                                buckets=COMPILE_BUCKETS_US, kernel=kernel)
+                # per-kernel-family compile cost joins the request's
+                # cost record (the compile-vs-execute split the cost
+                # model needs)
+                costprofile.add_kernel(kernel, compile_us=compile_us)
+    finally:
+        costprofile.note_launch(t0, time.perf_counter())
 
 
 def reset() -> None:

@@ -1,0 +1,302 @@
+"""Whole-query fused compilation (ISSUE 15): fused ≡ staged ≡ host
+bit-identity A/B across the LDBC-IC template shapes and
+@recurse+filter+aggregate composites (the chain≡scan≡host pattern from
+test_mesh_serving.py), the launch-collapse contract (fused requests
+record kernel_launches == 1 under a "fused" shape component), the
+per-shape program cache + /debug surfaces, the sticky-fallback
+lifecycle when tracing a fused program raises, and the per-Recorder-
+frame launch-gap fix for nested sub-requests.
+
+Note the strongest A/B rides tier-1 already: the fused flag is
+default-ON, so tests/test_ldbc_ic.py's 14 golden templates and every
+engine test execute THROUGH the fused route wherever a block is
+eligible, checked against oracles computed off-engine.
+"""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from dgraph_tpu.engine import Engine, fused
+from dgraph_tpu.server.api import Alpha
+from dgraph_tpu.store.schema import parse_schema
+from dgraph_tpu.store.store import StoreBuilder
+from dgraph_tpu.utils import costprofile, costprior
+from dgraph_tpu.utils import deadline as dl
+from dgraph_tpu.utils.metrics import METRICS
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    monkeypatch.setenv("DGRAPH_TPU_FUSED", "1")
+    fused.reset()
+    costprior.reset()
+    costprofile.reset()
+    yield
+    fused.reset()
+    costprior.reset()
+    costprofile.reset()
+
+
+def _store(n=160, seed=7):
+    """SNB-flavored fixture: person/message-ish graph with enough
+    structure for the IC template shapes (knows/likes trees, exact-
+    indexed names, reverse edges)."""
+    rng = np.random.default_rng(seed)
+    b = StoreBuilder(parse_schema(
+        "knows: [uid] @reverse .\n"
+        "likes: [uid] @reverse .\n"
+        "name: string @index(exact) .\n"
+        "city: string @index(exact) ."))
+    for i in range(1, n):
+        b.add_value(i, "name", f"p{i % 19}")
+        b.add_value(i, "city", f"c{i % 7}")
+        for j in rng.integers(1, n, 4):
+            if i != int(j):
+                b.add_edge(i, "knows", int(j))
+        for j in rng.integers(1, n, 2):
+            if i != int(j):
+                b.add_edge(i, "likes", int(j))
+    return b.finalize()
+
+
+# IC template shapes (structural mirrors of the LDBC Interactive
+# Complex mix test_ldbc_ic.py runs in full): multi-child trees,
+# filters at depth, reverse hops, pagination, count leaves, var chains
+IC_TEMPLATES = [
+    # IC1-like: exact-match root, 2-hop friend tree with filter
+    '{ q(func: eq(name, "p7")) { name knows @filter(eq(city, "c2")) '
+    '{ name city } } }',
+    # IC2-like: friends\' messages, first-N per row
+    '{ q(func: uid(0x2, 0x7)) { knows (first: 5) { name likes '
+    '(first: 2) { uid } } } }',
+    # IC5-like: reverse membership hop below a forward hop
+    '{ q(func: uid(0x3)) { knows { ~likes { uid } } } }',
+    # IC9-like: two-hop with offset pagination and uid render
+    '{ q(func: uid(0x4)) { knows (first: 3, offset: 1) { uid knows '
+    '{ uid } } } }',
+    # negative-first (last k) pagination fuses too
+    '{ q(func: uid(0x5)) { knows (first: -2) { uid } } }',
+    # ball expansion: depth-bounded visit-once recurse
+    '{ q(func: uid(0x2)) @recurse(depth: 3) { uid knows } }',
+    # recurse + filter fused into the gather mask
+    '{ q(func: uid(0x6)) @recurse(depth: 2) { uid knows '
+    '@filter(eq(city, "c1")) } }',
+    # recurse + var + downstream aggregate block composite
+    '{ ball as q(func: uid(0x8)) @recurse(depth: 2) { uid knows } '
+    '  agg(func: uid(ball)) { c as count(knows) } '
+    '  m() { max(val(c)) } }',
+    # count leaf as terminal aggregation + sibling hop
+    '{ q(func: uid(0x9)) { c as count(knows) knows { uid } } '
+    '  t() { sum(val(c)) } }',
+    # or-filter trees evaluate to one fused allowed set
+    '{ q(func: uid(0x2)) { knows @filter(eq(city, "c1") OR '
+    'eq(city, "c3")) { city } } }',
+]
+
+
+def test_fused_matches_staged_and_host_across_ic_templates(monkeypatch):
+    """The acceptance A/B: fused ≡ staged-device ≡ host numpy, byte
+    for byte, across the template shapes."""
+    st = _store()
+    host = Engine(st, device_threshold=10**9)
+    dev = Engine(st, device_threshold=0)
+
+    monkeypatch.setenv("DGRAPH_TPU_FUSED", "0")
+    want_host = [host.query_bytes(q) for q in IC_TEMPLATES]
+    want_dev = [dev.query_bytes(q) for q in IC_TEMPLATES]
+    assert want_host == want_dev
+
+    monkeypatch.setenv("DGRAPH_TPU_FUSED", "1")
+    got = [host.query_bytes(q) for q in IC_TEMPLATES]
+    assert got == want_host
+    # and the fused route actually served: this wasn't 10 staged runs
+    assert METRICS.get("fused_route_total", route="fused") >= 10
+    # repeated templates hit the compiled-program memo
+    got2 = [host.query_bytes(q) for q in IC_TEMPLATES]
+    assert got2 == want_host
+    assert METRICS.get("fused_program_hits_total") >= 10
+
+
+def test_fused_request_records_one_launch_under_fused_shape():
+    """The launch-collapse contract: a fused request is ONE device
+    dispatch (kernel_launches == 1) recorded under a shape carrying
+    the "fused" component, so costprior learns per-PROGRAM cost for
+    fused shapes; the staged run of the same query launches per
+    level."""
+    st = _store()
+    a = Alpha(base=st, device_threshold=0)
+    q = '{ q(func: uid(0x2)) { uid knows { uid knows { uid } } } }'
+    import os
+    os.environ["DGRAPH_TPU_FUSED"] = "0"
+    try:
+        staged = a.query(q)
+        rec_staged = costprofile.recent(1)[0]
+    finally:
+        os.environ["DGRAPH_TPU_FUSED"] = "1"
+    a.query(q)          # first fused run may grow caps
+    assert a.query(q) == staged
+    rec_fused = costprofile.recent(1)[0]
+    assert rec_staged["kernel_launches"] >= 2
+    assert "fused" not in rec_staged["shape"]
+    assert rec_fused["kernel_launches"] == 1
+    assert "fused" in rec_fused["shape"]
+    # the cost priors digest fused shapes separately → per-PROGRAM
+    # priors (shape keys differ between the two routes)
+    assert rec_fused["shape"] != rec_staged["shape"]
+
+
+def test_fused_program_cache_and_debug_surfaces():
+    """Per-shape hits/misses/compile-µs surface at /debug/costs
+    (fused_programs) and /debug/scheduler (fused routes + cache)."""
+    from dgraph_tpu.server.http import make_http_server, serve_background
+
+    st = _store(n=80)
+    a = Alpha(base=st, device_threshold=0)
+    q = '{ q(func: uid(0x2)) { knows { uid } } }'
+    a.query(q)
+    a.query(q)
+    status = fused.status()
+    assert status["enabled"]
+    (shape,) = [s for s in status["shapes"]
+                if not status["shapes"][s]["disabled"]]
+    row = status["shapes"][shape]
+    assert row["misses"] >= 1 and row["hits"] >= 1
+    assert row["compile_us"] > 0
+    srv = make_http_server(a, port=0)
+    serve_background(srv)
+    try:
+        base = f"http://127.0.0.1:{srv.server_address[1]}"
+        with urllib.request.urlopen(base + "/debug/costs") as r:
+            doc = json.loads(r.read())
+        assert doc["fused_programs"]["shapes"][shape]["hits"] >= 1
+        with urllib.request.urlopen(base + "/debug/scheduler") as r:
+            sched = json.loads(r.read())
+        assert sched["fused"]["routes"]["fused"] >= 2
+        assert shape in sched["fused"]["shapes"]
+    finally:
+        srv.shutdown()
+
+
+def test_sticky_fallback_lifecycle(monkeypatch):
+    """A fused program that raises while tracing degrades THAT shape
+    to the staged path — sticky, counted, results unaffected — and a
+    reset() re-arms it (the Pallas fail-safe pattern)."""
+    st = _store(n=80)
+    host = Engine(st, device_threshold=10**9)
+    q = '{ q(func: uid(0x2)) { knows { uid } } }'
+    monkeypatch.setenv("DGRAPH_TPU_FUSED", "0")
+    want = host.query(q)
+    monkeypatch.setenv("DGRAPH_TPU_FUSED", "1")
+
+    def boom(*a, **k):
+        raise RuntimeError("mosaic said no")
+
+    monkeypatch.setattr(fused, "_build_program", boom)
+    before = METRICS.get("fused_fallback_total")
+    assert host.query(q) == want            # served by the staged path
+    assert METRICS.get("fused_fallback_total") == before + 1
+    assert METRICS.snapshot()["gauges"]["fused_degraded"] == 1.0
+    (shape,) = [s for s, e in fused.status()["shapes"].items()
+                if e["disabled"]]
+    # sticky: the next query doesn't re-attempt the build (boom would
+    # raise again and re-count); it routes fallback immediately
+    fb = METRICS.get("fused_route_total", route="fallback")
+    assert host.query(q) == want
+    assert METRICS.get("fused_route_total", route="fallback") == fb + 1
+    assert METRICS.get("fused_fallback_total") == before + 1
+    # lifecycle: reset re-arms the shape; with the builder restored
+    # the program compiles and the fused route serves again
+    monkeypatch.undo()
+    monkeypatch.setenv("DGRAPH_TPU_FUSED", "1")
+    fused.reset()
+    was = METRICS.get("fused_route_total", route="fused")
+    assert host.query(q) == want
+    assert METRICS.get("fused_route_total", route="fused") == was + 1
+    assert not fused.status()["shapes"][shape]["disabled"]
+
+
+def test_flag_off_pins_staged_path(monkeypatch):
+    monkeypatch.setenv("DGRAPH_TPU_FUSED", "0")
+    st = _store(n=60)
+    host = Engine(st, device_threshold=10**9)
+    was = METRICS.get("fused_route_total", route="fused")
+    host.query('{ q(func: uid(0x2)) { knows { uid } } }')
+    assert METRICS.get("fused_route_total", route="fused") == was
+    assert not fused.status()["enabled"]
+
+
+def test_ineligible_shapes_route_staged():
+    """Ordering, `after` cursors, facet machinery, complement filters
+    and var-dependent filters stay staged — counted as route=staged,
+    results identical by construction (they never enter the program)."""
+    st = _store(n=60)
+    host = Engine(st, device_threshold=10**9)
+    staged_before = METRICS.get("fused_route_total", route="staged")
+    for q in (
+            '{ q(func: uid(0x2)) { knows (orderasc: name) { name } } }',
+            '{ q(func: uid(0x2)) { knows @filter(NOT eq(city, "c1")) '
+            '{ uid } } }',
+            '{ v as q(func: uid(0x2)) { knows @filter(uid(v)) '
+            '{ uid } } }',
+    ):
+        host.query(q)
+    assert METRICS.get("fused_route_total",
+                       route="staged") >= staged_before + 3
+
+
+# ---------------------------------------------------------------------------
+# ISSUE-15 satellite: per-Recorder-frame launch-gap attribution
+
+def test_launch_gap_is_frame_local():
+    """The nested-request fix: a sub-request leg's boundary (parse/
+    apply work) must never bill as launch gap — entering and leaving a
+    frame resets the baseline; gaps INSIDE a frame still bill."""
+    with costprofile.profile("mutate") as rec:
+        rec.note_launch(100.0, 100.5)
+        with rec.launch_frame():
+            # nested leg: 4.5s since the outer launch is NOT a gap
+            rec.note_launch(105.0, 105.2)
+            rec.note_launch(105.7, 106.0)   # in-frame gap: 0.5s
+        # outer resumes: the leg boundary is not a gap either
+        rec.note_launch(120.0, 121.0)
+    assert rec.vals["kernel_launches"] == 4
+    assert rec.vals["launch_gap_us"] == 500_000
+
+
+def test_nested_request_launches_do_not_bill_outer_gap():
+    """The nested-request shape end to end: a txn-style inner
+    alpha.query inside an already-active request context rides the
+    outer recorder through `_request`'s nested branch, which now
+    frames the launch-gap baseline."""
+    st = _store(n=60)
+    a = Alpha(base=st, device_threshold=10**9)
+    ctx = dl.RequestContext(None)
+    with dl.activate(ctx), costprofile.profile("read") as rec:
+        rec.note_launch(100.0, 100.5)
+        a.query('{ q(func: uid(0x2)) { name } }')   # nested leg
+        # the frame reset the baseline: whatever the wall clock says,
+        # the next launch must not bill the nested leg as a gap
+        assert rec._last_launch_end is None
+        rec.note_launch(500.0, 501.0)
+    assert rec.vals.get("launch_gap_us", 0) == 0
+    assert rec.vals["kernel_launches"] == 2
+
+
+def test_upsert_query_leg_rides_a_launch_frame():
+    """The upsert shape: the query leg runs inside the mutate
+    recorder; its launches count, but the leg boundary gaps do not
+    leak into the mutate record's launch_gap_us."""
+    a = Alpha(device_threshold=0)
+    a.alter("knows: [uid] @reverse .\nname: string @index(exact) .")
+    a.mutate(set_nquads='<1> <name> "x" .\n<1> <knows> <2> .\n'
+                        '<2> <knows> <3> .')
+    a.upsert('''upsert {
+      query { q(func: uid(0x1)) { v as knows { knows { uid } } } }
+      mutation { set { uid(v) <name> "seen" . } }
+    }''')
+    recs = [r for r in costprofile.recent(5) if r["lane"] == "mutate"
+            and r["kernel_launches"] >= 1]
+    assert recs, costprofile.recent(5)

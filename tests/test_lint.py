@@ -424,6 +424,110 @@ def test_r6_shape_and_len_branches_are_static():
 
 
 # ---------------------------------------------------------------------------
+# R13 fused-host-callback (ISSUE 15 — jit purity for the fused layer)
+
+R13_BAD = """\
+import jax
+from dgraph_tpu.utils import costprofile
+from dgraph_tpu.utils.metrics import METRICS
+@jax.jit
+def stage(x):
+    costprofile.add("edges_traversed", 1)
+    METRICS.inc("edges_traversed_total")
+    return x + 1
+"""
+
+R13_CLOSURE = """\
+import jax
+from dgraph_tpu.utils.jitcache import jit_call
+def build():
+    def program(x):
+        with jit_call("fused.program", ()):
+            return x + 1
+    return jax.jit(program)
+"""
+
+R13_OK = """\
+import jax
+from dgraph_tpu.utils import costprofile
+@jax.jit
+def stage(x):
+    return x + 1
+def launch(x):
+    out = stage(x)
+    costprofile.add("edges_traversed", 1)   # around, not inside
+    return out
+"""
+
+
+def test_r13_flags_host_accounting_inside_jitted_fused_stage():
+    a = scan("dgraph_tpu/engine/fused.py", R13_BAD)
+    msgs = [f.msg for f in a.findings
+            if f.rule == "fused-host-callback"]
+    assert any("costprofile.add" in m for m in msgs)
+    assert any("METRICS.inc" in m for m in msgs)
+
+
+def test_r13_covers_program_closures_and_jit_call():
+    a = scan("dgraph_tpu/ops/fake.py", R13_CLOSURE)
+    assert any("jit_call" in f.msg for f in a.findings
+               if f.rule == "fused-host-callback")
+
+
+def test_r13_accounting_around_the_dispatch_is_clean():
+    a = scan("dgraph_tpu/engine/fused.py", R13_OK)
+    assert "fused-host-callback" not in rules_of(a)
+    # outside the fused layer the rule does not apply (R6 still does)
+    a = scan("dgraph_tpu/server/fake.py", R13_BAD)
+    assert "fused-host-callback" not in rules_of(a)
+
+
+def test_r13_waiver_with_reason():
+    src = R13_BAD.replace(
+        '    costprofile.add("edges_traversed", 1)\n',
+        '    # graftlint: allow(fused-host-callback): trace-time '
+        'build counter, once per compile is the intent\n'
+        '    costprofile.add("edges_traversed", 1)\n')
+    a = scan("dgraph_tpu/engine/fused.py", src)
+    assert any("fused-host-callback" in r
+               for r in rules_of(a, waived=True))
+
+
+def test_fused_stage_inventory_pinned_both_ways():
+    """ISSUE-15 satellite (the cost_record_fields pattern applied to
+    the fused program): the static stage-kind inventory
+    (engine/fused.STAGE_KINDS, re-exported by facts) and the RUNTIME
+    stage-emitter registry are pinned to each other in both
+    directions — a stage the compiler can emit that isn't inventoried,
+    or an inventoried kind no emitter serves, fails tier-1."""
+    from dgraph_tpu.engine import fused
+    a = run(ROOT)
+    facts_kinds = {e["kind"]: e["doc"]
+                   for e in a.facts["fused_stage_kinds"]}
+    assert facts_kinds == fused.STAGE_KINDS
+    assert a.facts["totals"]["fused_stage_kinds"] \
+        == len(fused.STAGE_KINDS)
+    # direction 1: every inventoried kind has a runtime emitter
+    assert set(fused.STAGE_KINDS) == set(fused._STAGE_EMITTERS)
+    # direction 2: every plan the compiler builds emits only
+    # inventoried kinds (the _Stage constructor vocabulary)
+    from dgraph_tpu.store.schema import parse_schema
+    from dgraph_tpu.store.store import StoreBuilder
+    b = StoreBuilder(parse_schema("knows: [uid] @reverse ."))
+    b.add_edge(1, "knows", 2)
+    st = b.finalize()
+    from dgraph_tpu.dql.parser import parse
+    blocks = parse('{ q(func: uid(0x1)) @recurse(depth: 2) '
+                   '{ uid knows } }')
+    plan = fused.plan_block(st, blocks[0])
+    assert plan is not None
+    assert {s.kind for s in plan.stages} <= set(fused.STAGE_KINDS)
+    # and every kind's doc is a real one-liner, not a placeholder
+    for doc in fused.STAGE_KINDS.values():
+        assert len(doc) > 20
+
+
+# ---------------------------------------------------------------------------
 # R7 shard-map-compat
 
 def test_r7_flags_every_direct_spelling():

@@ -131,11 +131,16 @@ def test_trace_id_echo_and_debug_surface(alpha):
     assert "http.query" in names           # request root
     assert "engine.query" in names         # engine level
     assert "engine.block" in names
-    assert {"engine.level", "ops.expand"} & names  # op level
+    # op level: the staged path's level/expand spans, or the whole-
+    # query fused program's single span (ISSUE 15 — the default route)
+    assert {"engine.level", "ops.expand", "engine.fused"} & names
     assert all(s["trace_id"] == tid for s in spans)
-    # the friend hop's expansion recorded its route and edge count
-    exp = [s for s in spans if s["name"] == "ops.expand"]
-    assert exp and all("path" in s["attrs"] for s in exp)
+    # the hop recorded its route/shape and edge count, whichever route
+    exp = [s for s in spans
+           if s["name"] in ("ops.expand", "engine.fused")]
+    assert exp and all("path" in s["attrs"] or "shape" in s["attrs"]
+                       for s in exp)
+    assert all("edges" in s["attrs"] for s in exp)
 
     with urllib.request.urlopen(
             base + f"/debug/events?trace_id={tid}") as r:
