@@ -59,7 +59,8 @@ MAINT_N = 220              # maintenance-stage store size (host-side)
 METRIC = f"edges_traversed_per_sec_{DEPTH}hop_recurse_{B_DEV}q"
 GLOBAL_DEADLINE_S = 780
 STAGE_DEADLINES = {"stage0": 150.0, "stage1": 240.0, "stage2": 330.0,
-                   "maintenance": 60.0, "sched": 240.0, "mesh": 300.0}
+                   "maintenance": 60.0, "pressure": 60.0,
+                   "sched": 240.0, "mesh": 300.0}
 
 # whole-query fusion A/B (ISSUE 15): the same fixed-seed small-query
 # template mix served with DGRAPH_TPU_FUSED toggled in a child each —
@@ -435,6 +436,7 @@ def child_main(platform: str, expect_path: str) -> None:
     for name, fn in (("stage0", stage0), ("stage1", stage1),
                      ("stage2", stage2),
                      ("maintenance", maintenance_stage),
+                     ("pressure", pressure_stage),
                      ("sched", sched_stage), ("mesh", mesh_stage)):
         _run_stage(flightrec, name, fn)
     os._exit(0)
@@ -998,6 +1000,142 @@ def maintenance_stage() -> dict:
             "cost_records": costprofile.summary(top_n=5)}
 
 
+def pressure_stage() -> dict:
+    """Budgeted-serving proof (ISSUE 16): serve a fixed-seed query mix
+    against an out-of-core store twice — unbudgeted first (recording a
+    digest per query), then with the memory governor's budgets pinned to
+    HALF the measured cache footprint, so the working set is ~2× the
+    budget and every fill pays the evict-to-watermark path. Reports
+    p50/p99 for both passes, the eviction and OOM-retry counters the
+    pressure generated, and the contract the governor exists for:
+    every budgeted response digest-identical to its unbudgeted twin,
+    ZERO aborted requests, resident bytes at or under budget once the
+    mix drains."""
+    import hashlib
+    import shutil
+    import statistics
+    import tempfile
+
+    from dgraph_tpu.server.api import Alpha
+    from dgraph_tpu.utils import memgov
+    from dgraph_tpu.utils.metrics import METRICS
+
+    def evict_total() -> float:
+        return sum(v for k, v in METRICS.snapshot()["counters"].items()
+                   if k.startswith("cache_evictions_total"))
+
+    t0 = time.perf_counter()
+    rng = np.random.default_rng(23)
+    seed_alpha = Alpha(device_threshold=10**9)
+    seed_alpha.alter("name: string @index(exact) .\n"
+                     "follows: [uid] @reverse .\nknows: [uid] @reverse .")
+    lines = [f'_:p{i} <name> "p{i}" .' for i in range(MAINT_N)]
+    for pred in ("follows", "knows"):
+        for i in range(MAINT_N):
+            for j in rng.choice(MAINT_N, 10, replace=False):
+                if i != j:
+                    lines.append(f"_:p{i} <{pred}> _:p{j} .")
+    seed_alpha.mutate(set_nquads="\n".join(lines))
+    workdir = tempfile.mkdtemp(prefix="bench_press_")
+    p_dir = os.path.join(workdir, "p")
+    seed_alpha.checkpoint_to(p_dir)
+    alpha = Alpha.open(p_dir, device_threshold=10**9, sync=False)
+
+    # wide fixed-seed mix: enough distinct anchors that the tablet /
+    # plan / residency caches accumulate a real working set
+    anchors = rng.choice(MAINT_N, 24, replace=False)
+    mix = []
+    for i in anchors:
+        mix.append('{ q(func: eq(name, "p%d")) '
+                   '{ name follows { name } } }' % i)
+        mix.append('{ q(func: eq(name, "p%d")) { knows { name } '
+                   'follows { ~follows (first: 3) { name } } } }' % i)
+
+    def digest(resp) -> str:
+        return hashlib.sha256(
+            json.dumps(resp, sort_keys=True).encode()).hexdigest()
+
+    def run_mix():
+        """One full pass over the mix: (digests, latencies_us, aborts)."""
+        digs, lats, aborts = [], [], 0
+        for q in mix:
+            t = time.perf_counter()
+            try:
+                resp = alpha.query(q)
+            except Exception:  # noqa: BLE001 — an abort is the FINDING
+                aborts += 1
+                digs.append(None)
+                continue
+            lats.append((time.perf_counter() - t) * 1e6)
+            digs.append(digest(resp))
+        return digs, lats, aborts
+
+    def pcts(lats):
+        lats = sorted(lats)
+        return {"p50_us": round(statistics.median(lats)),
+                "p99_us": round(lats[min(len(lats) - 1,
+                                         int(len(lats) * 0.99))])}
+
+    # -- pass 1: unbudgeted — the digests are the ground truth, the
+    # quiescent footprint is what the budget halves
+    run_mix()                       # warm: compiles/fills outside timing
+    want, idle_lats, idle_aborts = run_mix()
+    assert idle_aborts == 0, f"{idle_aborts} aborts with NO budget set"
+    st0 = memgov.GOVERNOR.status()
+    budgets = {k: max(st0["budgets"][k]["resident_bytes"] // 2, 4096)
+               for k in ("device", "host")}
+    ev0, oom0 = evict_total(), memgov.GOVERNOR.oom_stats()
+
+    # -- pass 2: working set ~2× budget — same mix, same digests required
+    memgov.GOVERNOR.set_budgets(device_bytes=budgets["device"],
+                                host_bytes=budgets["host"])
+    try:
+        got, press_lats, aborts = run_mix()
+        got2, press_lats2, aborts2 = run_mix()
+        press_lats += press_lats2
+        aborts += aborts2
+        # quiescent point: one synchronous pass drains any overhang the
+        # last fills left between maybe_evict hooks, then residency must
+        # sit within budget (or the registry must be empty-handed)
+        for kind in ("device", "host"):
+            memgov.GOVERNOR.evict_to_low(kind)
+        st1 = memgov.GOVERNOR.status()
+        resident = {k: st1["budgets"][k]["resident_bytes"]
+                    for k in ("device", "host")}
+    finally:
+        memgov.GOVERNOR.set_budgets(0, 0)  # later stages run unbudgeted
+
+    assert aborts == 0, f"{aborts} requests aborted under memory budget"
+    mismatched = [i for i, (a, b) in enumerate(zip(want, got))
+                  if a != b] + \
+                 [i for i, (a, b) in enumerate(zip(want, got2)) if a != b]
+    assert not mismatched, \
+        f"budgeted responses diverge from unbudgeted at mix{mismatched}"
+    oom1 = memgov.GOVERNOR.oom_stats()
+    shutil.rmtree(workdir, ignore_errors=True)
+
+    i_p, p_p = pcts(idle_lats), pcts(press_lats)
+    return {"stage": "pressure",
+            "secs": round(time.perf_counter() - t0, 2),
+            "queries": len(mix) * 2, "aborts": aborts,
+            "digest_match": True,
+            "budget_bytes": budgets,
+            "working_set_bytes": {
+                k: st0["budgets"][k]["resident_bytes"]
+                for k in ("device", "host")},
+            "resident_after_bytes": resident,
+            "within_budget": {k: resident[k] <= budgets[k]
+                              for k in ("device", "host")},
+            "evictions": round(evict_total() - ev0),
+            "oom_retries": oom1["retries"] - oom0["retries"],
+            "oom_degraded": oom1["degraded"] - oom0["degraded"],
+            "unbudgeted": i_p, "pressured": p_p,
+            "pressure_impact_p50": round(p_p["p50_us"] /
+                                         max(i_p["p50_us"], 1), 3),
+            "pressure_impact_p99": round(p_p["p99_us"] /
+                                         max(i_p["p99_us"], 1), 3)}
+
+
 # ---------------------------------------------------------------------------
 # parent: staged child supervision
 
@@ -1026,12 +1164,12 @@ def run_child_staged(platform: str, expect_path: str,
     t_start = time.perf_counter()
     try:
         for name in ("stage0", "stage1", "stage2", "maintenance",
-                     "sched", "mesh"):
+                     "pressure", "sched", "mesh"):
             remaining = budget_s - (time.perf_counter() - t_start)
             deadline = min(STAGE_DEADLINES[name], max(remaining, 1.0))
             line = _read_line(proc, deadline)
             if line is None:
-                if name in ("maintenance", "sched", "mesh"):
+                if name in ("maintenance", "pressure", "sched", "mesh"):
                     break  # additive telemetry: absence is not an error
                 err = (f"{name} produced no output within {deadline:.0f}s "
                        f"(rc={proc.poll()})")

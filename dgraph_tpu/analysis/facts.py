@@ -28,6 +28,10 @@ so the same pass extracts:
   recorded cost joins back to the kernels/spans that incurred it
   (tests/test_lint.py pins the two in sync — the join key for the
   future learned cost model).
+* **governed_caches** — the memory-governor cache inventory (ISSUE 16,
+  utils/memgov.GOVERNED_CACHES): every byte-holding cache name the
+  process-wide governor budgets, pinned both ways against the runtime
+  registration surface; rule R14 enforces that new caches join it.
 * **fused_stage_kinds** — the whole-query fused-program inventory
   (ISSUE 15, engine/fused.STAGE_KINDS): every stage kind the plan
   compiler can emit into one jitted program, pinned both ways
@@ -155,6 +159,17 @@ def extract_facts(contexts) -> dict:
     from dgraph_tpu.engine.fused import STAGE_KINDS
     fused_stages = [{"kind": k, "doc": d}
                     for k, d in sorted(STAGE_KINDS.items())]
+    # same discipline for the MEMORY GOVERNOR (ISSUE 16): the static
+    # inventory of governed cache names (utils/memgov.GOVERNED_CACHES —
+    # a jax-free import by design) is re-exported verbatim;
+    # tests/test_lint.py pins it both ways against the runtime
+    # registration surface, so a cache that registers under an
+    # uninventoried name (or an inventoried name nothing registers)
+    # fails tier-1 — rule R14 enforces that byte-holding caches
+    # register at all
+    from dgraph_tpu.utils.memgov import GOVERNED_CACHES
+    governed_caches = [{"name": n, "doc": d}
+                       for n, d in sorted(GOVERNED_CACHES.items())]
     return {
         "kernels": kernels,
         "kernel_launch_sites": launches,
@@ -167,6 +182,7 @@ def extract_facts(contexts) -> dict:
         "cost_prior_features": prior_features,
         "debug_endpoints": debug_endpoints,
         "fused_stage_kinds": fused_stages,
+        "governed_caches": governed_caches,
         "totals": {
             "kernels": len(kernels),
             "kernel_launch_sites": len(launches),
@@ -182,5 +198,6 @@ def extract_facts(contexts) -> dict:
             "cost_prior_features": len(prior_features),
             "debug_endpoints": len(debug_endpoints),
             "fused_stage_kinds": len(fused_stages),
+            "governed_caches": len(governed_caches),
         },
     }

@@ -45,6 +45,15 @@ R13 fused-host-callback  a jitted function in the fused-program layer
                          into the one-launch program (ISSUE 15;
                          extends the R6 jit-purity facts to the fused
                          program inventory).
+R14 cache-registration   byte-holding caches join the process memory
+                         governor (ISSUE 16): every `Memo(...)` call
+                         states its `governed=` decision explicitly,
+                         and a file that grows a dict-typed `*_cache`
+                         attribute must register with
+                         `memgov.GOVERNOR.register` somewhere (or
+                         waive with the reason its bytes are bounded)
+                         — an unregistered cache is invisible to the
+                         OOM evict-retry path and to /debug/memory.
 """
 
 from __future__ import annotations
@@ -55,7 +64,7 @@ from dgraph_tpu.analysis import FileContext, Finding, Rule
 
 __all__ = ["default_rules", "HotLoopCheckpoint", "DirectIO", "WallClock",
            "RetryDeadline", "MetricDocs", "JitPurity", "ShardMapCompat",
-           "FusedHostCallback", "AtomicWrite"]
+           "FusedHostCallback", "AtomicWrite", "CacheRegistration"]
 
 
 def _dotted(node: ast.AST) -> str:
@@ -566,9 +575,86 @@ class AtomicWrite(Rule):
         return out
 
 
+# ---------------------------------------------------------------------------
+class CacheRegistration(Rule):
+    name = "cache-registration"
+    doc = ("R14: byte-holding caches must join the process memory "
+           "governor (utils/memgov.py) — every `Memo(...)` call "
+           "carries an explicit `governed=` decision, and a file that "
+           "creates a dict-typed `*_cache` attribute must call "
+           "`memgov.GOVERNOR.register` somewhere (or waive with the "
+           "reason its bytes are bounded); an unregistered cache is "
+           "invisible to the OOM evict-retry path and /debug/memory")
+
+    DICT_CTORS = frozenset({"dict", "OrderedDict",
+                            "collections.OrderedDict"})
+
+    def applies(self, rel: str) -> bool:
+        # the governor itself and the Memo implementation are the
+        # mechanism, not clients of it
+        return (rel.startswith("dgraph_tpu/")
+                and rel not in ("dgraph_tpu/utils/memgov.py",
+                                "dgraph_tpu/utils/jitcache.py"))
+
+    @staticmethod
+    def _is_dict_value(node: ast.AST) -> bool:
+        if isinstance(node, ast.Dict):
+            return True
+        return (isinstance(node, ast.Call)
+                and _dotted(node.func)
+                in CacheRegistration.DICT_CTORS)
+
+    @staticmethod
+    def _cache_targets(node: ast.stmt):
+        """Attribute/name targets ending in `_cache` of an assignment
+        whose value is a dict literal / dict() / OrderedDict()."""
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets, value = [node.target], node.value
+        else:
+            return
+        if not CacheRegistration._is_dict_value(value):
+            return
+        for t in targets:
+            if isinstance(t, ast.Attribute) and t.attr.endswith("_cache"):
+                yield t.attr
+            elif isinstance(t, ast.Name) and t.id.endswith("_cache"):
+                yield t.id
+
+    def check_file(self, ctx: FileContext) -> list[Finding]:
+        out = []
+        registers = any(
+            isinstance(n, ast.Call)
+            and _dotted(n.func).endswith("GOVERNOR.register")
+            for n in ast.walk(ctx.tree))
+        for node in ast.walk(ctx.tree):
+            if (isinstance(node, ast.Call)
+                    and _dotted(node.func).rsplit(".", 1)[-1] == "Memo"
+                    and not any(kw.arg == "governed"
+                                for kw in node.keywords)):
+                out.append(Finding(
+                    self.name, ctx.rel, node.lineno,
+                    "Memo(...) without an explicit governed= decision "
+                    "— pass governed=\"<inventory name>\" to join the "
+                    "memory governor, or governed=None with a waiver "
+                    "stating why its bytes stay unbudgeted"))
+            elif isinstance(node, ast.stmt) and not registers:
+                for attr in self._cache_targets(node):
+                    out.append(Finding(
+                        self.name, ctx.rel, node.lineno,
+                        f"dict-typed cache attribute `{attr}` in a "
+                        f"file that never calls "
+                        f"memgov.GOVERNOR.register — register its "
+                        f"bytes/evict callbacks (GOVERNED_CACHES "
+                        f"inventory), or waive with the bound that "
+                        f"keeps it small"))
+        return out
+
+
 def default_rules() -> list[Rule]:
     from dgraph_tpu.analysis.guards import guard_rules
     return [HotLoopCheckpoint(), DirectIO(), WallClock(),
             RetryDeadline(), MetricDocs(), JitPurity(),
             ShardMapCompat(), FusedHostCallback(),
-            AtomicWrite()] + guard_rules()
+            AtomicWrite(), CacheRegistration()] + guard_rules()

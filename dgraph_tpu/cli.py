@@ -69,6 +69,8 @@ def cmd_alpha(args) -> int:
         "encryption_key_file": args.encryption_key_file,
         "encryption_strict": args.encryption_strict or None,
         "memory_budget_mb": args.memory_budget_mb,
+        "device_budget_mb": args.device_budget_mb,
+        "host_cache_budget_mb": args.host_cache_budget_mb,
         "slow_query_ms": args.slow_query_ms,
         "trace_dir": args.trace_dir,
         "trace_export": args.trace_export,
@@ -133,6 +135,22 @@ def cmd_alpha(args) -> int:
                        memory_budget=(cfg.memory_budget_mb << 20)
                        if cfg.memory_budget_mb else None)
     alpha.slow_query_ms = cfg.slow_query_ms
+    # unified cache governor (utils/memgov.py): arm the process-wide
+    # byte budgets — every registered cache (fused programs, ELL
+    # plans/kernels, device relations, tablet adapters, LazyPreds
+    # residency) evicts above 90% of its kind's budget down to 70%,
+    # lowest predicted recompute-value-per-byte first; governed launch
+    # sites absorb allocation failures with one evict-retry, then
+    # sticky-degrade the shape to the staged/host route
+    if cfg.device_budget_mb or cfg.host_cache_budget_mb:
+        from dgraph_tpu.utils import memgov
+        memgov.GOVERNOR.set_budgets(
+            device_bytes=cfg.device_budget_mb << 20,
+            host_bytes=cfg.host_cache_budget_mb << 20)
+        log.info("memory governor armed: device_budget_mb=%d "
+                 "host_cache_budget_mb=%d (caches: %s)",
+                 cfg.device_budget_mb, cfg.host_cache_budget_mb,
+                 ",".join(sorted(memgov.GOVERNOR.registered_names())))
     # request lifecycle: admission control (token limit + bounded FIFO
     # queue + shedding) and the default per-request budget
     if cfg.max_inflight > 0:
@@ -661,6 +679,20 @@ def main(argv=None) -> int:
                    help="out-of-core mode: fault predicate tablets from "
                         "the checkpoint on demand, LRU-evict above this "
                         "many MB resident (0 = fully resident)")
+    p.add_argument("--device_budget_mb", type=int, default=None,
+                   help="memory governor: HBM cache budget in MB — "
+                        "device relations, shard stacks, and compiled "
+                        "kernels evict above 90%% of it down to 70%%, "
+                        "lowest recompute-value/byte first; governed "
+                        "launches absorb allocation failures with one "
+                        "evict-retry then sticky-degrade the shape "
+                        "(0 = unguarded)")
+    p.add_argument("--host_cache_budget_mb", type=int, default=None,
+                   help="memory governor: host-RAM cache budget in MB "
+                        "(fused programs, ELL plans, tablet adapters, "
+                        "out-of-core residency); same watermark/"
+                        "eviction policy as --device_budget_mb "
+                        "(0 = unguarded)")
     p.add_argument("--rollup_after", type=int, default=None,
                    help="background-fold when this many delta layers "
                         "are pending (0 = off); out-of-core stores "

@@ -189,12 +189,26 @@ class FaultSchedule:
     Off by default — historical (flags, seed) schedules replay
     byte-identically (the golden-schedule tests pin this); armed, the
     extended slice re-splits equally with "disk" LAST in the fixed
-    family order."""
+    family order.
+
+    `alloc=True` adds ALLOCATION-FAULT events (ISSUE 16 — the memory
+    governor's OOM lifecycle under fuzz): node `src`'s next governed
+    device launch fails its allocation through the memgov process hook
+    (utils/memgov.py `set_alloc_fault` — the vault `set_io_fault`
+    idiom moved from the disk to the accelerator), exercising the
+    evict-retry-once → sticky-degrade protocol under live partitions
+    and crashes. The harness arms the one-shot hook through
+    `alloc_cb(src)`; events count `fault_alloc_events_total`. Off by
+    default — same seed-stability rule; armed, the family slots LAST
+    after "disk" in the fixed order, so every historical (flags, seed)
+    schedule replays byte-identically and new goldens pin the alloc
+    space."""
 
     def __init__(self, seed: int, n_nodes: int, steps: int = 8,
                  max_delay_s: float = 0.03, wal_trunc: bool = False,
                  deadline: bool = False, crash: bool = False,
-                 clock_free: bool = False, disk: bool = False):
+                 clock_free: bool = False, disk: bool = False,
+                 alloc: bool = False):
         import random
         self.seed = seed
         self.n_nodes = n_nodes
@@ -212,7 +226,8 @@ class FaultSchedule:
         families = [f for f, on in (("wal_trunc", wal_trunc),
                                     ("deadline", deadline),
                                     ("crash", crash),
-                                    ("disk", disk)) if on]
+                                    ("disk", disk),
+                                    ("alloc", alloc)) if on]
         gen_down: set[int] = set()  # crash/restart pairing at generation
         for _ in range(steps):
             src, dst = rng.choice(links)
@@ -250,6 +265,11 @@ class FaultSchedule:
                     # bitflip/trunc damage durable state; the harness
                     # crash-restarts the node so recovery runs
                     gen_down.discard(src)
+            elif extended == "alloc":
+                # one injected allocation failure on src's next governed
+                # launch; dst/seconds unused, no extra rng draw — the
+                # alloc family never perturbs other families' schedules
+                self.events.append(("alloc", src, dst, 0.0))
             elif r < 0.40:
                 self.events.append(("drop", src, dst, 0.0))
             elif r < 0.70:
@@ -266,7 +286,7 @@ class FaultSchedule:
     def apply_event(self, ev: tuple[str, int, int, float],
                     faulty_groups, addrs, wal_trunc_cb=None,
                     deadline_cb=None, crash_cb=None,
-                    disk_cb=None) -> None:
+                    disk_cb=None, alloc_cb=None) -> None:
         """Apply one event; `faulty_groups[i]` is node i's FaultyGroups
         wrapper, `addrs[i]` its address. `wal_trunc_cb(src)` performs a
         crash-restart-with-torn-tail of node src; `deadline_cb(src,
@@ -274,14 +294,20 @@ class FaultSchedule:
         `crash_cb(src, up)` kills (up=False) or rebuilds-from-WAL
         (up=True) node src; `disk_cb(src, kind)` injects one
         bitflip/trunc/enospc write fault on node src through the vault
-        IO hook (any callback is skipped when the harness passes
-        None)."""
+        IO hook; `alloc_cb(src)` arms one allocation failure on node
+        src's next governed launch through the memgov process hook (any
+        callback is skipped when the harness passes None)."""
         from dgraph_tpu.utils.metrics import METRICS
         op, src, dst, secs = ev
         if op.startswith("disk_"):
             if disk_cb is not None and src not in self.crashed:
                 METRICS.inc("fault_disk_events_total", kind=op[5:])
                 disk_cb(src, op[5:])
+            return
+        if op == "alloc":
+            if alloc_cb is not None and src not in self.crashed:
+                METRICS.inc("fault_alloc_events_total")
+                alloc_cb(src)
             return
         if op == "deadline":
             if deadline_cb is not None:

@@ -30,7 +30,7 @@ from dgraph_tpu.engine.execute import Executor, LevelNode
 from dgraph_tpu.engine.ir import SubGraph
 from dgraph_tpu.engine.outputnode import to_json
 from dgraph_tpu.engine.recurse import RecurseData, _bind_recurse_vars
-from dgraph_tpu.utils import costprofile, deadline, locks, tracing
+from dgraph_tpu.utils import costprofile, deadline, locks, memgov, tracing
 from dgraph_tpu.utils.jitcache import Memo, jit_call
 from dgraph_tpu.utils.metrics import METRICS
 
@@ -319,7 +319,7 @@ def order_plans_by_cost(plans, enabled: bool = True):
 # entirely. Plans carry only parsed SubGraphs — seeds and filters are
 # (re)evaluated against the CURRENT snapshot at run time, so reuse
 # across stores is sound as long as the schema shape matched.
-_plan_memo = Memo("batch.plan", capacity=256)
+_plan_memo = Memo("batch.plan", capacity=256, governed="batch.plan")
 
 
 def _schema_fingerprint(store) -> tuple:
@@ -370,7 +370,9 @@ def plan_batch_groups_cached(store, dqls: list):
     sch = store.schema
     sch.__dict__.pop("_plan_fp", None)
     _plan_memo.put((_schema_fingerprint(store), tuple(dqls)),
-                   (plans, leftover))
+                   (plans, leftover),
+                   rebuild_us=(time.perf_counter() - t_plan) * 1e6)
+    memgov.GOVERNOR.maybe_evict("host")
     return plans, leftover
 
 
@@ -422,13 +424,22 @@ def run_batch(store, plan, device_threshold: int) -> list:
                       depth=plan.depth, queries=len(plan.blocks),
                       lanes=B, padded_lanes=B - len(seeds)):
         fn = _recurse_for(store, plan.attr, plan.reverse, mask0.shape[1])
-        with jit_call("bfs.ell_recurse",
-                      (plan.attr, plan.reverse, int(mask0.shape[1]),
-                       plan.depth, g.n)):
-            # the seed mask is donated to the kernel (ops/bfs.py): put a
-            # fresh copy per launch and let the scan reuse its buffer
-            _last, _seen, _edges, hops = fn(jax.device_put(mask0),
-                                            plan.depth, True)
+        lkey = (plan.attr, plan.reverse, int(mask0.shape[1]),
+                plan.depth, g.n)
+
+        def _launch():
+            memgov.check_alloc_fault("bfs.ell_recurse")
+            with jit_call("bfs.ell_recurse", lkey):
+                # the seed mask is donated to the kernel (ops/bfs.py):
+                # put a fresh copy per launch (so the OOM retry has an
+                # undonated buffer) and let the scan reuse it
+                return fn(jax.device_put(mask0), plan.depth, True)
+
+        # allocation failure: evict-to-low + one retry; a second failure
+        # sticky-degrades this launch shape and OomDegraded propagates —
+        # api.query_batch's per-query fallback serves bit-identically
+        _last, _seen, _edges, hops = memgov.oom_retry(
+            "bfs.ell_recurse", lkey, _launch)
         hops = np.asarray(hops)      # [depth, n+1, W] fresh masks
     # launch count + dispatch gap are recorded by jit_call itself
     exec_us = (time.perf_counter() - t_exec) * 1e6
@@ -604,6 +615,10 @@ def _run_shortest_batch(store, plan: _ShortestPlan,
         t_exec = time.perf_counter()
         step = _step_for(store, plan.attr, plan.reverse, W,
                          plan.first_visit)
+        skey = (plan.attr, plan.reverse, W, plan.first_visit, n)
+        if memgov.GOVERNOR.is_degraded("bfs.ell_step", skey):
+            # sticky OOM degrade: the per-query path serves this shape
+            raise memgov.OomDegraded("bfs.ell_step", str(skey))
         unresolved = {q: None for q in active}   # q → found level (bfs)
         dst_rows = {q: int(g.new_of_old[int(dst[q])]) for q in active}
         frontier = jax.device_put(mask0)
@@ -618,10 +633,24 @@ def _run_shortest_batch(store, plan: _ShortestPlan,
                 # uninterruptible dispatch of SHORTEST_STAGE hops
                 deadline.checkpoint("kernel")
                 chunk = min(SHORTEST_STAGE, plan.depth - done)
-                with jit_call("bfs.ell_step",
-                              (plan.attr, plan.reverse, W, chunk,
-                               plan.first_visit, n)):
-                    frontier, seen, hops = step(frontier, seen, chunk)
+                try:
+                    memgov.check_alloc_fault("bfs.ell_step")
+                    with jit_call("bfs.ell_step",
+                                  (plan.attr, plan.reverse, W, chunk,
+                                   plan.first_visit, n)):
+                        frontier, seen, hops = step(frontier, seen,
+                                                    chunk)
+                except Exception as e:
+                    if not memgov.is_alloc_failure(e):
+                        raise
+                    # the carries are DONATED: a failed dispatch leaves
+                    # no valid buffers to retry with, so this site
+                    # degrades in one step — evict for the next caller,
+                    # sticky-mark the shape, per-query path serves
+                    memgov.GOVERNOR.note_oom("bfs.ell_step", str(skey))
+                    memgov.GOVERNOR.degrade("bfs.ell_step", skey)
+                    raise memgov.OomDegraded("bfs.ell_step",
+                                             str(skey)) from e
                 hops_np = np.asarray(hops)
                 # each staged dispatch is one launch: jit_call counts
                 # it and bills the host gap between stages
@@ -763,6 +792,66 @@ def _shortest_path_data(store, plan, g, rrel, levels, src: int,
 # same ELL arrays (double HBM) or clobber each other's cache dicts
 _cache_lock = locks.make_lock("batch.plan_cache")
 
+# compiled recurse/step kernels are opaque closures; a nominal per-entry
+# charge keeps the cache byte-governable with honest relative pressure
+_KERNEL_NBYTES_EST = 64 << 10
+
+
+def _governed_host_cache(host, attr_name: str, gov_name: str, kind: str,
+                         sizer, cascade=None) -> None:
+    """Register a per-snapshot cache dict (`host.<attr_name>`) with the
+    memory governor, once per snapshot. Caller holds `_cache_lock`;
+    the callbacks re-take it and close over a weakref so a dropped
+    snapshot's caches fall out of the registry with it. Eviction pops
+    the oldest-inserted entry (these dicts fill in first-use order, so
+    oldest ≈ coldest)."""
+    import weakref
+
+    done = getattr(host, "_memgov_registered", None)
+    if done is None:
+        done = host._memgov_registered = set()
+    if attr_name in done:
+        return
+    done.add(attr_name)
+    ref = weakref.ref(host)
+
+    def nbytes():
+        h = ref()
+        if h is None:
+            return 0
+        with _cache_lock:
+            vals = list((getattr(h, attr_name, None) or {}).values())
+        return sum(sizer(v) for v in vals)
+
+    def evict_one():
+        h = ref()
+        if h is None:
+            return 0
+        with _cache_lock:
+            d = getattr(h, attr_name, None)
+            if not d:
+                return 0
+            k = next(iter(d))
+            v = d.pop(k)
+            if cascade is not None:
+                cascade(h, k)   # drop dependents still pinning bytes
+        return sizer(v)
+
+    memgov.GOVERNOR.register(gov_name, kind, nbytes, evict_one,
+                             owner=host)
+
+
+def _drop_dependent_fns(host, dkey) -> None:
+    """Evicting a device ELL must also drop the compiled kernels whose
+    closures pin its arrays, or the HBM never actually frees. Caller
+    holds `_cache_lock`."""
+    fns = getattr(host, "_ell_fns", None)
+    if not fns:
+        return
+    attr, reverse = dkey
+    for fkey in [k for k in fns if k[1] == attr and k[2] == reverse]:
+        del fns[fkey]
+
 
 def _cache_host(store, attr: str, reverse: bool):
     """Where kernel caches live: the UNDERLYING immutable snapshot when
@@ -808,6 +897,8 @@ def _ell_for(store, attr: str, reverse: bool):
         cache = getattr(host, "_ell_cache", None)
         if cache is None:
             cache = host._ell_cache = {}
+            _governed_host_cache(host, "_ell_cache", "batch.ell", "host",
+                                 memgov.estimate_nbytes)
         if key in cache:
             _note_ell_cache(hit=True)
         else:
@@ -828,7 +919,9 @@ def _ell_for(store, attr: str, reverse: bool):
                 METRICS.set_gauge("ell_padding_ratio",
                                   g.padded_edges / max(g.nnz, 1) - 1.0,
                                   pred=attr, reverse=str(reverse))
-        return cache[key]
+        out = cache[key]
+    memgov.GOVERNOR.maybe_evict("host")
+    return out
 
 
 def _dev_for(store, attr: str, reverse: bool):
@@ -844,10 +937,15 @@ def _dev_for(store, attr: str, reverse: bool):
         devs = getattr(host, "_ell_devs", None)
         if devs is None:
             devs = host._ell_devs = {}
+            _governed_host_cache(host, "_ell_devs", "batch.ell_dev",
+                                 "device", memgov.estimate_nbytes,
+                                 cascade=_drop_dependent_fns)
         dkey = (attr, reverse)
         if dkey not in devs:
             devs[dkey] = device_ell(g)
-        return g, devs[dkey]
+        out = g, devs[dkey]
+    memgov.GOVERNOR.maybe_evict("device")
+    return out
 
 
 def _recurse_for(store, attr: str, reverse: bool, W: int):
@@ -868,6 +966,8 @@ def _recurse_for(store, attr: str, reverse: bool, W: int):
         fns = getattr(host, "_ell_fns", None)
         if fns is None:
             fns = host._ell_fns = {}
+            _governed_host_cache(host, "_ell_fns", "batch.kernel",
+                                 "host", lambda v: _KERNEL_NBYTES_EST)
         if key not in fns:
             fns[key] = make_ell_recurse(dev, g.outdeg, g.n, W,
                                         count_edges=False)
@@ -891,6 +991,8 @@ def _step_for(store, attr: str, reverse: bool, W: int,
         fns = getattr(host, "_ell_fns", None)
         if fns is None:
             fns = host._ell_fns = {}
+            _governed_host_cache(host, "_ell_fns", "batch.kernel",
+                                 "host", lambda v: _KERNEL_NBYTES_EST)
         if key not in fns:
             fns[key] = make_ell_step(dev, g.n, W,
                                      first_visit=first_visit)
@@ -920,14 +1022,21 @@ def carry_kernel_caches(old_store, new_store, touched) -> int:
         dst_cache = getattr(new_store, "_ell_cache", None)
         if dst_cache is None:
             dst_cache = new_store._ell_cache = {}
+            _governed_host_cache(new_store, "_ell_cache", "batch.ell",
+                                 "host", memgov.estimate_nbytes)
         src_devs = getattr(old_store, "_ell_devs", {}) or {}
         src_fns = getattr(old_store, "_ell_fns", {}) or {}
         dst_devs = getattr(new_store, "_ell_devs", None)
         if dst_devs is None:
             dst_devs = new_store._ell_devs = {}
+            _governed_host_cache(new_store, "_ell_devs", "batch.ell_dev",
+                                 "device", memgov.estimate_nbytes,
+                                 cascade=_drop_dependent_fns)
         dst_fns = getattr(new_store, "_ell_fns", None)
         if dst_fns is None:
             dst_fns = new_store._ell_fns = {}
+            _governed_host_cache(new_store, "_ell_fns", "batch.kernel",
+                                 "host", lambda v: _KERNEL_NBYTES_EST)
         for key, gval in src_cache.items():
             attr = key[0]
             if attr in touched or key in dst_cache:

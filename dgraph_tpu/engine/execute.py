@@ -32,7 +32,7 @@ from dgraph_tpu.engine.funcs import (EMPTY, eval_func,
 from dgraph_tpu.engine.ir import FilterNode, FuncNode, Order, SubGraph
 from dgraph_tpu.store.store import Store
 from dgraph_tpu.store.types import Kind
-from dgraph_tpu.utils import costprofile
+from dgraph_tpu.utils import costprofile, memgov
 from dgraph_tpu.utils import deadline as dl
 from dgraph_tpu.utils import tracing
 from dgraph_tpu.utils.jitcache import jit_call
@@ -160,12 +160,22 @@ class Executor:
         if len(frontier) == 0 or rel.nnz == 0:
             return (EMPTY, EMPTY, EMPTY64), "empty"
         if len(frontier) >= self.device_threshold:
-            if self.mesh is not None:
+            try:
+                if self.mesh is not None:
+                    return (self._expand_mesh(pred, reverse, frontier),
+                            "mesh")
+                return (self._expand_device(pred, reverse, frontier),
+                        "device")
+            except memgov.OomDegraded:
+                # allocation failure survived its evict-retry (or the
+                # shape is sticky-degraded): the host walk produces the
+                # identical (nbrs, seg, pos) triple
+                pass
+        elif self.mesh is not None and self._mesh_promoted(len(frontier)):
+            try:
                 return self._expand_mesh(pred, reverse, frontier), "mesh"
-            return (self._expand_device(pred, reverse, frontier),
-                    "device")
-        if self.mesh is not None and self._mesh_promoted(len(frontier)):
-            return self._expand_mesh(pred, reverse, frontier), "mesh"
+            except memgov.OomDegraded:
+                pass
         return csr_rows(rel, frontier), "numpy"
 
     # learned-promotion floor: below this many frontier rows, per-launch
@@ -269,8 +279,13 @@ class Executor:
         deg = self.store.rel(pred, reverse).degree(frontier)
         edge_cap = self._shard_edge_cap(srel, frontier, deg)
         from dgraph_tpu.parallel.mesh import host_np
-        nbrs_s, seg_s, pos_s, totals, max_shard = matrix_hop(
-            self.mesh, srel, fr, edge_cap)
+
+        def _launch():
+            memgov.check_alloc_fault("mesh.matrix_hop")
+            return matrix_hop(self.mesh, srel, fr, edge_cap)
+
+        nbrs_s, seg_s, pos_s, totals, max_shard = memgov.oom_retry(
+            "mesh.matrix_hop", (pred, reverse), _launch)
         max_shard = int(host_np(max_shard))
         assert max_shard <= edge_cap, (max_shard, edge_cap)
         totals = host_np(totals)
@@ -300,8 +315,13 @@ class Executor:
         np.add.at(per_pair, (chunk_of, shard_of), deg)
         edge_cap = _bucket(max(int(per_pair.max()), 1))
         from dgraph_tpu.parallel.mesh import host_np
-        nbrs_a, seg_a, pos_a, totals, max_e = ring_matrix_hop(
-            self.mesh, srel, chunks, edge_cap)
+
+        def _launch():
+            memgov.check_alloc_fault("mesh.ring_matrix_hop")
+            return ring_matrix_hop(self.mesh, srel, chunks, edge_cap)
+
+        nbrs_a, seg_a, pos_a, totals, max_e = memgov.oom_retry(
+            "mesh.ring_matrix_hop", (pred, reverse), _launch)
         assert int(host_np(max_e)) <= edge_cap, edge_cap
         nbrs_a, seg_a, pos_a = (host_np(nbrs_a), host_np(seg_a),
                                 host_np(pos_a))
@@ -322,9 +342,17 @@ class Executor:
         deg = self.store.rel(pred, reverse).degree(frontier)
         ecap = _bucket(max(int(deg.sum()), 1))
         from dgraph_tpu.ops.hop import launch_key
-        with jit_call("hop.gather_edges", launch_key(indptr, fr, ecap)):
-            nbrs, seg, pos, valid, total = ops.gather_edges(
-                indptr, indices, fr, ecap)
+
+        def _launch():
+            memgov.check_alloc_fault("hop.gather_edges")
+            with jit_call("hop.gather_edges",
+                          launch_key(indptr, fr, ecap)):
+                return ops.gather_edges(indptr, indices, fr, ecap)
+
+        # OOM lifecycle: evict-to-low + one retry, then sticky degrade
+        # of this predicate's device route (OomDegraded → numpy walk)
+        nbrs, seg, pos, valid, total = memgov.oom_retry(
+            "hop.gather_edges", (pred, reverse), _launch)
         valid = np.asarray(valid)
         return (np.asarray(nbrs)[valid], np.asarray(seg)[valid],
                 np.asarray(pos)[valid].astype(np.int64))

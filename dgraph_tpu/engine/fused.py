@@ -59,7 +59,7 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
-from dgraph_tpu.utils import costprofile, locks, tracing
+from dgraph_tpu.utils import costprofile, locks, memgov, tracing
 from dgraph_tpu.utils import deadline as dl
 from dgraph_tpu.utils.jitcache import Memo, jit_call
 from dgraph_tpu.utils.metrics import MAX_LABEL_SETS, METRICS
@@ -312,7 +312,12 @@ def _build_program(stages: tuple, caps: tuple):
 
 # -- program + caps caches, per-shape accounting ------------------------------
 
-_programs = Memo("fused.program", capacity=128)
+# a compiled program's true footprint (host executable + reserved HBM)
+# is opaque to python; this nominal per-entry charge makes the memo
+# byte-bounded under the governor with honest RELATIVE pressure
+_PROGRAM_NBYTES_EST = 256 << 10
+
+_programs = Memo("fused.program", capacity=128, governed="fused.program")
 _lock = locks.make_lock("fused.registry")
 _caps_memo: dict = {}     # plan sig → last good caps (under _lock)
 _shapes: dict = {}        # shape fingerprint → stats dict (under _lock)
@@ -352,7 +357,9 @@ def _program_for(shape: str, sig: tuple, caps: tuple):
     METRICS.inc("fused_program_misses_total")
     t0 = time.perf_counter()
     fn = _build_program(tuple(_Stage(*s) for s in sig), caps)
-    _programs.put(key, fn)
+    _programs.put(key, fn, nbytes=_PROGRAM_NBYTES_EST,
+                  rebuild_us=(time.perf_counter() - t0) * 1e6)
+    memgov.GOVERNOR.maybe_evict("host")
     with _lock:
         e = _shape_entry(shape)
         e["misses"] += 1
@@ -417,6 +424,18 @@ def try_fused(ex, sg):
                 return node
     except (dl.DeadlineExceeded, dl.Cancelled):
         raise
+    except memgov.OomDegraded:
+        # allocation failure survived its one evict-retry: the shape is
+        # sticky-degraded (gauge + flight event recorded by the
+        # governor); the staged path serves bit-identically
+        _disable(shape)
+        METRICS.inc("fused_fallback_total")
+        from dgraph_tpu.utils import logging as xlog
+        xlog.get("fused").warning(
+            "fused program for shape %s oom-degraded after one "
+            "evict-retry; sticky fallback to the staged path", shape)
+        METRICS.inc("fused_route_total", route="fallback")
+        return None
     except Exception:  # noqa: BLE001 — optimization only, never fatal
         _disable(shape)
         METRICS.inc("fused_fallback_total")
@@ -490,13 +509,24 @@ def _run_plan(ex, sg, plan: FusedPlan, shape: str):
                    tuple(int(d[0].shape[0]) for d in devs),
                    tuple(int(a.shape[0]) for a in alloweds_d))
             t_launch = time.perf_counter()
-            with jit_call("fused.program", key) as compiling:
-                outs = program(tuple(devs), fr, alloweds_d, pages_d)
-                outs = [tuple(np.asarray(o) for o in out)
-                        for out in outs]
+
+            def _launch():
+                memgov.check_alloc_fault("fused.program")
+                with jit_call("fused.program", key) as compiling:
+                    got = program(tuple(devs), fr, alloweds_d, pages_d)
+                    got = [tuple(np.asarray(o) for o in out)
+                           for out in got]
+                return got, compiling
+
+            # OOM lifecycle: alloc failure → evict to low watermark,
+            # retry ONCE, then sticky-degrade the shape (OomDegraded
+            # propagates to try_fused → staged path, bit-identical)
+            outs, compiling = memgov.oom_retry("fused.program", shape,
+                                               _launch)
             if compiling:
-                _note_compile(shape,
-                              (time.perf_counter() - t_launch) * 1e6)
+                compile_us = (time.perf_counter() - t_launch) * 1e6
+                _note_compile(shape, compile_us)
+                _programs.reprice(key, compile_us)
             caps, overflowed = _grow_caps(plan, caps, outs, nodes)
             if not overflowed:
                 break

@@ -316,6 +316,7 @@ def run_tree_batch(store, plan: TreePlan, device_threshold: int) -> list:
     filt_lists: list[list[np.ndarray]] = [[] for _ in plan.filt_paths]
     idx_per_query: list[_StageIndex] = []
     root_displays: list[dict[int, np.ndarray]] = []
+    # graftlint: allow(cache-registration): per-call local memo of this one batch's filter sets — it dies with the function, never holds bytes across requests
     filt_cache: dict = {}
     for q, blocks in enumerate(plan.queries):
         ex = Executor(store, device_threshold=device_threshold)

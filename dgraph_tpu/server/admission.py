@@ -53,7 +53,7 @@ import threading
 import time
 from collections import deque
 
-from dgraph_tpu.utils import costprofile, flightrec, locks, tracing
+from dgraph_tpu.utils import costprofile, flightrec, locks, memgov, tracing
 from dgraph_tpu.utils.metrics import METRICS
 
 __all__ = ["AdmissionController", "ServerOverloaded", "LANES"]
@@ -222,6 +222,17 @@ class _Lane:
                     self.inflight_cost_us += cost_us
                 self._publish()
                 return
+            # sustained memory pressure sheds BEFORE queue-full
+            # (ISSUE 16): when a cache kind is still above its high
+            # watermark after a synchronous evict pass, every queued
+            # admission only adds predicted cache footprint the budget
+            # cannot hold — shed the arrival with a retry hint instead
+            # of letting the queue convert memory pressure into OOMs.
+            # Unarmed processes pay one attribute read here.
+            pressured = memgov.GOVERNOR.admission_pressure()
+            if pressured is not None:
+                hint = self._retry_after_s(len(self.waiters), cost_us)
+                raise self._overloaded(hint, "memory_pressure", cost_us)
             if len(self.waiters) >= self.queue_depth:
                 if cost_us is None or not self._try_displace(cost_us):
                     hint = self._retry_after_s(len(self.waiters),

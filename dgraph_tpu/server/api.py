@@ -31,7 +31,7 @@ from dgraph_tpu.store.mvcc import MVCCStore, Mutation
 from dgraph_tpu.store.schema import parse_schema
 from dgraph_tpu.store.store import Store
 from dgraph_tpu.store.types import Kind, hash_password
-from dgraph_tpu.utils import costprior, costprofile, flightrec
+from dgraph_tpu.utils import costprior, costprofile, flightrec, memgov
 from dgraph_tpu.utils import deadline as dl
 from dgraph_tpu.utils import tracing
 from dgraph_tpu.utils.metrics import METRICS
@@ -66,6 +66,38 @@ class StageRefused(Exception):
     `allow_volatile_stage`."""
 
 GC_EVERY = 256  # timestamps between oracle/store gc sweeps
+
+
+def _register_tablet_cache(alpha) -> None:
+    """Join the adapted-tablet cache to the process memory governor:
+    previously an unbounded dict, now byte-accounted and evictable
+    (oldest-inserted first — an evicted tablet refetches from its
+    owner). Callbacks close over a weakref and take the Alpha's own
+    state lock; the governor never holds its lock across them."""
+    import weakref
+
+    ref = weakref.ref(alpha)
+
+    def nbytes():
+        a = ref()
+        if a is None:
+            return 0
+        with a._state_lock:
+            vals = list(a._tablet_cache.values())
+        return sum(memgov.estimate_nbytes(v) for v in vals)
+
+    def evict_one():
+        a = ref()
+        if a is None:
+            return 0
+        with a._state_lock:
+            if not a._tablet_cache:
+                return 0
+            v = a._tablet_cache.pop(next(iter(a._tablet_cache)))
+        return memgov.estimate_nbytes(v)
+
+    memgov.GOVERNOR.register("api.tablet", "host", nbytes, evict_one,
+                             owner=alpha)
 
 
 class Alpha:
@@ -142,6 +174,7 @@ class Alpha:
         if base is not None and base.n_nodes:
             self.oracle.bump_uid(int(base.uids[-1]))
         locks.guarded(self, "alpha.state")
+        _register_tablet_cache(self)
 
     @classmethod
     def open(cls, p_dir: str, device_threshold: int = 512,
@@ -1672,6 +1705,7 @@ class Alpha:
                 # graftlint: allow(split-critical-section): idempotent cache fill — concurrent fillers install equivalent adaptations for the same (pred, version, n) key, and stale widths are simply re-deleted
                 del self._tablet_cache[k]
             self._tablet_cache[(pred, version, n)] = adapted
+        memgov.GOVERNOR.maybe_evict("host")
         return adapted
 
     @staticmethod
@@ -1742,6 +1776,7 @@ class Alpha:
                 for k in [k for k in self._tablet_cache
                           if k[0] == pred and k[1] != version]:
                     del self._tablet_cache[k]
+        memgov.GOVERNOR.maybe_evict("host")
         return pd
 
     def remote_hop(self, pred: str, reverse: bool, frontier,

@@ -64,4 +64,8 @@ DEBUG_ENDPOINTS: dict[str, str] = {
         "GET: flight-recorder snapshot (in-flight ops with stacks, "
         "ring, watchdog); ?peer=host:port pulls a cluster peer's over "
         "the DebugFlight RPC, ?n= limits the ring tail",
+    "/debug/memory":
+        "GET: memory-governor snapshot — per-cache resident bytes / "
+        "registrants / evictions against the device+host budgets and "
+        "watermarks, OOM evict-retry counters, sticky-degraded shapes",
 }

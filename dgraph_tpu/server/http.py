@@ -51,6 +51,7 @@ _DEBUG_GET = {
     "/debug/flightrecorder": "_dbg_flightrec",
     "/debug/fleet": "_dbg_fleet",
     "/debug/fleet/flight": "_dbg_fleet_flight",
+    "/debug/memory": "_dbg_memory",
 }
 _DEBUG_POST = {
     "/debug/profile": "_post_profile",
@@ -402,6 +403,15 @@ def make_http_server(alpha: Alpha, addr: str = "127.0.0.1",
             else:
                 self._send(200, {"enabled": True,
                                  **alpha.admission.status()})
+
+        def _dbg_memory(self):
+            # memory-governor snapshot (utils/memgov.py): budgets +
+            # watermarks, per-cache resident bytes/registrants/
+            # evictions, OOM evict-retry counters, sticky-degraded
+            # shapes — the surface the acceptance test reads after an
+            # injected allocation fault
+            from dgraph_tpu.utils import memgov
+            self._send(200, memgov.GOVERNOR.status())
 
         def _dbg_locks(self):
             # lock-order sanitizer state: acquisition-graph

@@ -101,6 +101,39 @@ class LazyPreds:
         # is rewritten by the next checkpoint/fold.
         self.heal_cb = None
         locks.guarded(self, "outofcore.residency")
+        # join the process memory governor: residency already runs its
+        # own LRU under budget_bytes; the governor adds the CROSS-cache
+        # budget on top (evict_one surrenders the LRU-coldest tablet —
+        # a re-fault reloads bit-identical arrays, so value density is
+        # just the disk reload cost spread over the tablet's bytes)
+        import weakref
+
+        from dgraph_tpu.utils import memgov
+        ref = weakref.ref(self)
+
+        def _gov_bytes():
+            lp = ref()
+            return lp.stats()["resident_bytes"] if lp is not None else 0
+
+        def _gov_evict():
+            lp = ref()
+            return lp._evict_coldest() if lp is not None else 0
+
+        memgov.GOVERNOR.register("outofcore.resident", "host",
+                                 _gov_bytes, _gov_evict, owner=self)
+
+    def _evict_coldest(self) -> int:
+        """Governor callback: drop the least-recently-used resident
+        tablet (bytes freed; 0 when nothing is resident)."""
+        with self._lock:
+            if not self._resident:
+                return 0
+            victim = next(iter(self._resident))
+            del self._resident[victim]
+            freed = self._sizes.pop(victim)
+            self.resident_bytes -= freed
+            self.evictions += 1
+            return freed
 
     def stats(self) -> dict[str, int]:
         """Residency counters read under the lock — the ONLY way other

@@ -175,6 +175,49 @@ class PredicateData:
         self.rev_pos = np.searchsorted(fwd_keys, s_arr * n + o_arr)
 
 
+def _register_device_caches(store) -> None:
+    """Join the snapshot's HBM caches (`_device` CSR blocks,
+    `_sharded` mesh stacks) to the process memory governor. Callbacks
+    close over a weakref — a dropped snapshot's registrations die with
+    it. Eviction pops oldest-inserted (first-use order ≈ coldest);
+    `device_rel`/`sharded_rel` simply re-place an evicted tablet."""
+    import weakref
+
+    from dgraph_tpu.utils import memgov
+
+    ref = weakref.ref(store)
+
+    def _dict_of(attr):
+        s = ref()
+        return getattr(s, attr, None) if s is not None else None
+
+    def make_cbs(attr):
+        def nbytes():
+            d = _dict_of(attr)
+            if not d:
+                return 0
+            return sum(memgov.estimate_nbytes(v)
+                       for v in list(d.values()))
+
+        def evict_one():
+            d = _dict_of(attr)
+            if not d:
+                return 0
+            try:
+                v = d.pop(next(iter(d)))
+            except (KeyError, StopIteration):
+                return 0
+            return memgov.estimate_nbytes(v)
+
+        return nbytes, evict_one
+
+    for attr, name in (("_device", "store.device"),
+                       ("_sharded", "store.sharded")):
+        nbytes, evict_one = make_cbs(attr)
+        memgov.GOVERNOR.register(name, "device", nbytes, evict_one,
+                                 owner=store)
+
+
 class Store:
     """Immutable posting-store snapshot (host arrays + device cache)."""
 
@@ -185,8 +228,11 @@ class Store:
         self.schema = schema
         self.preds = preds
         self._device: dict[tuple[str, str], tuple[jax.Array, jax.Array]] = {}
+        self._sharded: dict = {}
+        self._sharded_mesh = None
         self._empty_rel = EdgeRel(np.zeros(self.n_nodes + 1, np.int32),
                                   np.zeros(0, np.int32))
+        _register_device_caches(self)
 
     def rev_to_fwd_pos(self, pred: str, pos: np.ndarray) -> np.ndarray:
         """Map reverse-CSR edge positions to their forward positions (the
@@ -225,11 +271,16 @@ class Store:
         """CSR block on the default device, cached (HBM residency —
         reference analog: posting-list cache, posting/lists.go)."""
         key = (pred, "rev" if reverse else "fwd")
-        if key not in self._device:
+        out = self._device.get(key)
+        if out is None:
             r = self.rel(pred, reverse)
-            self._device[key] = (jax.device_put(r.indptr),
-                                 jax.device_put(r.indices))
-        return self._device[key]
+            out = self._device[key] = (jax.device_put(r.indptr),
+                                       jax.device_put(r.indices))
+            from dgraph_tpu.utils import memgov
+            # `out` is returned even if the pass evicts it: the caller's
+            # launch still holds the arrays; next lookup re-places
+            memgov.GOVERNOR.maybe_evict("device")
+        return out
 
     def sharded_rel(self, pred: str, reverse: bool, mesh):
         """Row-sharded CSR placed on a mesh, cached per (pred, direction)
@@ -243,11 +294,14 @@ class Store:
             cache = {}
             self._sharded = cache
             self._sharded_mesh = mesh
-        if key not in cache:
+        out = cache.get(key)
+        if out is None:
             srel = shard_rel(self.rel(pred, reverse), mesh.devices.size)
-            cache[key] = device_put_rel(srel, mesh)
+            out = cache[key] = device_put_rel(srel, mesh)
             self._note_mesh_residency(srel)
-        return cache[key]
+            from dgraph_tpu.utils import memgov
+            memgov.GOVERNOR.maybe_evict("device")
+        return out
 
     def _note_mesh_residency(self, srel) -> None:
         """Residency gauges for a newly placed sharded tablet:

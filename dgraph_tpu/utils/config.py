@@ -42,6 +42,12 @@ class AlphaConfig:
     rollup_every: int = 64        # commits between automatic rollups
     memory_budget_mb: int = 0     # 0 = fully resident; >0 = out-of-core
                                   # tablet faulting under this budget
+    # unified cache governor (utils/memgov.py): 0 disarms a kind;
+    # armed, every byte-holding cache (fused programs, ELL plans,
+    # device relations, tablets, LazyPreds residency) evicts above
+    # 90% of the budget down to 70%, lowest recompute-value/byte first
+    device_budget_mb: int = 0     # HBM-resident cache budget
+    host_cache_budget_mb: int = 0  # host-RAM cache budget
     # background maintenance scheduler (store/maintenance.py):
     rollup_after: int = 0         # fold when this many delta layers are
                                   # pending (0 = no background rollup)

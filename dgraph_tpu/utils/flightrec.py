@@ -204,6 +204,10 @@ class Watchdog:
         self._kind_last: dict[str, float] = {}  # per-kind conviction gate
         self._last_dump_mono = float("-inf")
         self._maint_seen = (None, -1, 0.0)  # (job, progress, since)
+        # governor sticky-degrade count at last scan; None until the
+        # first scan baselines it (a watchdog armed AFTER an old
+        # degrade must not convict history)
+        self._oom_seen = None
         self.convictions = 0
         self.suppressed = 0
         locks.guarded(self, "flightrec.watchdog")
@@ -252,6 +256,7 @@ class Watchdog:
         convicted.extend(self._scan_admission(now))
         convicted.extend(self._scan_maintenance(now))
         convicted.extend(self._scan_pusher())
+        convicted.extend(self._scan_memory(now))
         for kind, detail in convicted:
             METRICS.inc("watchdog_stalls_total", kind=kind)
             emit("watchdog.stall", stall=kind, **{
@@ -343,6 +348,27 @@ class Watchdog:
             return [("pusher", {"buffered": buffered, "dead": dead,
                                 "last_cycle_age_s":
                                     st.get("last_cycle_age_s")})]
+        return []
+
+    def _scan_memory(self, now: float):
+        """Repeat-OOM conviction (kind=oom): the memory governor
+        absorbing a single allocation failure with one evict-retry is
+        the design working — no conviction. A shape going
+        STICKY-degraded means the allocation failed AGAIN after the
+        evict pass (the repeat the budget could not absorb): that is a
+        capsized budget the black box should explain — convict once per
+        dump interval with the governor's counters as evidence."""
+        from dgraph_tpu.utils import memgov
+        st = memgov.GOVERNOR.oom_stats()
+        with self._lock:
+            deg0 = self._oom_seen
+            self._oom_seen = st["degraded"]
+        if deg0 is None:
+            return []  # first scan baselines; history never convicts
+        if st["degraded"] > deg0 and self._kind_due("oom", now):
+            return [("oom", {"events": st["events"],
+                             "retries": st["retries"],
+                             "degraded": st["degraded"]})]
         return []
 
     def _kind_due(self, kind: str, now: float) -> bool:
@@ -770,6 +796,11 @@ def _surfaces(alpha) -> dict:
         "locks": locks.GRAPH.snapshot(),
         "races": locks.RACES.snapshot(),
     }
+    # memory-governor state (ISSUE 16): an OOM/degrade conviction's
+    # bundle must carry the budgets, per-cache residency, and the
+    # sticky-degraded shapes that explain it
+    from dgraph_tpu.utils import memgov
+    out["memory"] = memgov.GOVERNOR.status()
     try:
         from dgraph_tpu.server.http import slow_queries_snapshot
         out["slow_queries"] = slow_queries_snapshot()

@@ -33,7 +33,7 @@ from dgraph_tpu.utils import flightrec, tracing
 from dgraph_tpu.utils.metrics import METRICS
 
 SURFACES = {"traces", "events", "costs", "scheduler", "admission",
-            "locks", "races", "peers", "slow_queries"}
+            "locks", "races", "peers", "slow_queries", "memory"}
 
 
 @pytest.fixture(autouse=True)

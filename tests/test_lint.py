@@ -928,3 +928,109 @@ def test_runtime_registry_matches_static_inventory():
         assert set(reg[key]["fields"]) == inv[key], (
             f"{key}: runtime shim tracks {sorted(reg[key]['fields'])} "
             f"but static inference says {sorted(inv[key])}")
+
+
+# ---------------------------------------------------------------------------
+# R14 cache-registration (ISSUE 16)
+
+def test_r14_flags_memo_without_governed_decision():
+    src = ("from dgraph_tpu.utils.jitcache import Memo\n"
+           "_plans = Memo(\"engine.plans\", capacity=64)\n")
+    a = scan("dgraph_tpu/engine/fake.py", src)
+    assert "cache-registration" in rules_of(a)
+
+
+def test_r14_satisfied_by_explicit_governed_kwarg():
+    src = ("from dgraph_tpu.utils.jitcache import Memo\n"
+           "_plans = Memo(\"batch.plan\", capacity=64,\n"
+           "              governed=\"batch.plan\")\n"
+           "_raw = Memo(\"raw\", governed=None)\n")
+    a = scan("dgraph_tpu/engine/fake.py", src)
+    assert "cache-registration" not in rules_of(a)
+
+
+def test_r14_flags_unregistered_dict_cache_attr():
+    src = ("class Host:\n"
+           "    def __init__(self):\n"
+           "        self._page_cache: dict = {}\n")
+    a = scan("dgraph_tpu/store/fake.py", src)
+    assert "cache-registration" in rules_of(a)
+
+
+def test_r14_dict_cache_passes_when_file_registers():
+    src = ("from dgraph_tpu.utils import memgov\n"
+           "class Host:\n"
+           "    def __init__(self):\n"
+           "        self._page_cache: dict = {}\n"
+           "        memgov.GOVERNOR.register(\n"
+           "            \"store.device\", \"device\",\n"
+           "            lambda: 0, lambda: 0, owner=self)\n")
+    a = scan("dgraph_tpu/store/fake.py", src)
+    assert "cache-registration" not in rules_of(a)
+
+
+def test_r14_waiver_suppresses_with_reason():
+    src = ("class Host:\n"
+           "    def __init__(self):\n"
+           "        # graftlint: allow(cache-registration): bounded at 3 entries\n"
+           "        self._page_cache: dict = {}\n")
+    a = scan("dgraph_tpu/store/fake.py", src)
+    assert "cache-registration" not in rules_of(a)
+    assert "cache-registration" in rules_of(a, waived=True)
+
+
+def test_r14_exempts_the_mechanism_itself():
+    src = "_self_cache: dict = {}\n"
+    for rel in ("dgraph_tpu/utils/memgov.py",
+                "dgraph_tpu/utils/jitcache.py"):
+        a = scan(rel, src)
+        assert "cache-registration" not in rules_of(a)
+
+
+def test_governed_cache_inventory_pinned_both_ways():
+    """ISSUE-16 satellite (the cost_record_fields pattern applied to
+    the memory governor): the static cache inventory
+    (utils/memgov.GOVERNED_CACHES, re-exported by facts) and the
+    runtime registration surface are pinned to each other in both
+    directions — a cache registering under an uninventoried name is a
+    hard ValueError at register(), and an inventoried name no
+    `GOVERNOR.register("<name>", ...)` site ever uses fails here."""
+    import ast as _ast
+
+    from dgraph_tpu.utils import memgov
+    a = run(ROOT)
+    facts_caches = {e["name"]: e["doc"]
+                    for e in a.facts["governed_caches"]}
+    assert facts_caches == memgov.GOVERNED_CACHES
+    assert a.facts["totals"]["governed_caches"] \
+        == len(memgov.GOVERNED_CACHES)
+    # direction 1: register() refuses names outside the inventory
+    try:
+        memgov.GOVERNOR.register("not.a.cache", "host",
+                                 lambda: 0, lambda: 0)
+    except ValueError:
+        pass
+    else:
+        raise AssertionError(
+            "register() accepted a name outside GOVERNED_CACHES")
+    # direction 2: every inventoried name is referenced as a string
+    # literal somewhere OUTSIDE the inventory module — registration
+    # sites pass the name to GOVERNOR.register directly, through
+    # Memo(governed=...), or through a file-local registration helper
+    # (batch._governed_host_cache, store._register_device_caches);
+    # an inventory row nothing mentions is dead vocabulary
+    registered_literals = set()
+    for ctx in a.contexts:
+        if ctx.rel == "dgraph_tpu/utils/memgov.py":
+            continue
+        for node in _ast.walk(ctx.tree):
+            if (isinstance(node, _ast.Constant)
+                    and isinstance(node.value, str)):
+                registered_literals.add(node.value)
+    missing = set(memgov.GOVERNED_CACHES) - registered_literals
+    assert not missing, (
+        f"inventoried cache name(s) with no registration site: "
+        f"{sorted(missing)}")
+    # and every doc is a real one-liner, not a placeholder
+    for doc in memgov.GOVERNED_CACHES.values():
+        assert len(doc) > 20

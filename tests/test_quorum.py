@@ -372,13 +372,26 @@ def test_election_quorum_defers_when_peers_unreachable():
     s1target = f"127.0.0.1:{s1port}"
     docs, _n = pstate.journal_tail(0)
     s1.apply_remote(docs)
-    # the peer standby exists but its server is NOT up yet
+    # the peer standby's server is up but GATED: every probe fails until
+    # the gate opens. (This replaced a bind-then-close ephemeral port:
+    # a freed port can be reallocated to a live socket — or picked as a
+    # client's ephemeral OUTBOUND port, a TCP self-connect that then
+    # breaks the later re-bind — the occasional tier-1 flake. A gated
+    # live server is unreachable/reachable deterministically.)
     s2 = ZeroState()
+    s2server, s2port, _ = make_zero_server(s2)
     s2.standby = True
-    with __import__("socket").socket() as sk:
-        sk.bind(("127.0.0.1", 0))
-        s2port = sk.getsockname()[1]
     s2target = f"127.0.0.1:{s2port}"
+    gate = threading.Event()
+    real_cursor = s2.replica_cursor
+
+    def gated_cursor():
+        if not gate.is_set():
+            raise RuntimeError("standby s2 partitioned (test gate)")
+        return real_cursor()
+
+    s2.replica_cursor = gated_cursor
+    s2server.start()
 
     stop = threading.Event()
     out = {}
@@ -395,15 +408,14 @@ def test_election_quorum_defers_when_peers_unreachable():
     time.sleep(1.2)                    # several election attempts
     try:
         assert s1.standby, "must defer without an electorate majority"
-        # peer standby comes up: electorate reachable, s1 wins by seq
-        s2server, _port2, _ = make_zero_server(s2, addr=s2target)
-        s2server.start()
+        # peer standby becomes reachable: electorate whole, s1 wins by seq
+        gate.set()
         t.join(timeout=15)
         assert out.get("r") is True and not s1.standby
-        s2server.stop(None)
     finally:
         stop.set()
-        s1server.stop(None)
+        for s in (s1server, s2server):
+            s.stop(None)
 
 
 def test_default_config_symmetric_partition_defers():
@@ -420,8 +432,14 @@ def test_default_config_symmetric_partition_defers():
     zc.connect("127.0.0.1:7979", 1)
 
     # two standbys; each one's peer address is a bound-but-dead port —
-    # the SYMMETRIC partition (neither standby reaches the other)
+    # the SYMMETRIC partition (neither standby reaches the other). The
+    # placeholder sockets stay OPEN for the whole test: a closed one
+    # frees its port for reallocation (the next make_zero_server or a
+    # client's ephemeral outbound socket can land on it, making the
+    # "dead" peer answer → quorum met → the dual-promote flake); a held
+    # bound-not-listening socket refuses every connect deterministically
     states, targets, dead_peers, servers = [], [], [], []
+    holders = []
     import socket
     for _ in range(2):
         st = ZeroState()
@@ -431,9 +449,10 @@ def test_default_config_symmetric_partition_defers():
         servers.append(sserver)
         states.append(st)
         targets.append(f"127.0.0.1:{sport}")
-        with socket.socket() as sk:
-            sk.bind(("127.0.0.1", 0))
-            dead_peers.append(f"127.0.0.1:{sk.getsockname()[1]}")
+        sk = socket.socket()
+        sk.bind(("127.0.0.1", 0))
+        holders.append(sk)
+        dead_peers.append(f"127.0.0.1:{sk.getsockname()[1]}")
     docs, _n = pstate.journal_tail(0)
     for st in states:
         st.apply_remote(docs)
@@ -467,6 +486,8 @@ def test_default_config_symmetric_partition_defers():
             t.join(timeout=10)
         for s in servers:
             s.stop(None)
+        for sk in holders:
+            sk.close()
 
 
 def test_stage_without_wal_refused(tmp_path):
