@@ -844,10 +844,18 @@ def sched_stage() -> dict:
     plus the prior fit summary and the batch planner's cost-pack
     imbalance gauges from a mixed two-family kernel batch."""
     from dgraph_tpu.server.api import Alpha
-    from dgraph_tpu.utils import costprior, costprofile
+    from dgraph_tpu.utils import costprior, costprofile, slo, timeseries
     from dgraph_tpu.utils.metrics import METRICS
 
     t0 = time.perf_counter()
+    # retained-history + SLO verdicts over the stage's own traffic
+    # (ISSUE 17): a fast-cadence sampler with test-scaled windows
+    # watches the whole A/B run; its series summary and per-objective
+    # burn-rate verdicts land in the BENCH JSON
+    sampler = timeseries.arm(
+        interval_s=0.2, ring_points=600,
+        slo_engine=slo.SloEngine(fast_window_s=10.0, slow_window_s=60.0),
+        forecast=False)
     off = run_sched_workload(priors_on=False)
     on = run_sched_workload(priors_on=True)
     fit = costprior.refit()  # fit over the on-run's digests
@@ -884,6 +892,11 @@ def sched_stage() -> dict:
            for stage in ("count", "predicted")}
 
     from dgraph_tpu.utils import tracing as _tracing
+    sampler.tick()  # one final point so the tail of the run is retained
+    states = (sampler.engine.evaluate(sampler.ring)
+              if sampler.engine is not None else {})
+    ts_summary = sampler.ring.summary(60.0)
+    timeseries.disarm()
     out = {"stage": "sched",
            "secs": round(time.perf_counter() - t0, 2),
            "priors_off": off, "priors_on": on,
@@ -892,6 +905,11 @@ def sched_stage() -> dict:
            # whole-query fusion ON/OFF on the same fixed-seed workload
            # (ISSUE 15): the launch-collapse headline, measured
            "fused_ab": _run_fused_ab(),
+           "timeseries": ts_summary,
+           "slo": {name: {win: {"burn": w["burn"],
+                                "breached": w["breached"]}
+                          for win, w in st["windows"].items()}
+                   for name, st in states.items()},
            "scheduler": costprior.status(top_n=5)}
     fleet = _fleet_block({"local": _tracing.stats()})
     if fleet is not None:
@@ -1331,6 +1349,13 @@ def main() -> None:
         out["sched"] = {k: ss[k] for k in
                         ("priors_on", "priors_off", "prior_fit",
                          "pack_imbalance") if k in ss}
+        # retained-history digest + SLO verdicts over the sched stage's
+        # traffic (ISSUE 17) — the bench-compare gate and dashboards
+        # read these top-level
+        if ss.get("timeseries"):
+            out["timeseries"] = ss["timeseries"]
+        if ss.get("slo"):
+            out["slo"] = ss["slo"]
     # mesh-sharded serving scaling (ISSUE 10): edges/s per device count,
     # 4-vs-1 scaling + efficiency, shard balance, reshard counter —
     # straight off the child's mesh stage

@@ -3,6 +3,12 @@
 Exit status 0 = no unwaived findings, 1 = findings (the build-failing
 condition tier-1's tests/test_lint.py enforces), 2 = usage error.
 Default scan set: the whole dgraph_tpu package + bench.py.
+
+Second mode — the bench regression gate (ISSUE 17):
+`--bench-compare OLD.json NEW.json [--bench-threshold 0.10]` diffs the
+shared quality keys of two BENCH JSON documents (edges/s, latency
+percentiles, kernel launches, shed precision) and exits 1 when any
+drifts past the threshold in its bad direction. See compare.py.
 """
 
 from __future__ import annotations
@@ -29,7 +35,21 @@ def main(argv: list[str] | None = None) -> int:
                     help="text mode: also print waived findings")
     ap.add_argument("--facts", action="store_true",
                     help="text mode: print the facts inventory totals")
+    ap.add_argument("--bench-compare", nargs=2,
+                    metavar=("OLD.json", "NEW.json"),
+                    help="bench regression gate: diff two BENCH JSON "
+                         "files' shared quality keys; exit 1 past the "
+                         "threshold (skips the lint scan)")
+    ap.add_argument("--bench-threshold", type=float, default=0.10,
+                    help="fractional drift in a key's bad direction "
+                         "that fails the gate (default 0.10)")
     args = ap.parse_args(argv)
+
+    if args.bench_compare:
+        from dgraph_tpu.analysis.compare import bench_compare_main
+        return bench_compare_main(args.bench_compare[0],
+                                  args.bench_compare[1],
+                                  args.bench_threshold, args.format)
 
     repo_root = pathlib.Path(__file__).resolve().parents[2]
     paths = args.paths or default_paths(repo_root)

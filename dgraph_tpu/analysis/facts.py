@@ -32,6 +32,10 @@ so the same pass extracts:
   utils/memgov.GOVERNED_CACHES): every byte-holding cache name the
   process-wide governor budgets, pinned both ways against the runtime
   registration surface; rule R14 enforces that new caches join it.
+* **slo_specs** — the SLO objective inventory (ISSUE 17,
+  utils/slo.SLO_SPECS): every service-level objective the burn-rate
+  engine can evaluate, pinned both ways against the runtime evaluator
+  registry; rule R15 keeps `slo=` label literals inside it.
 * **fused_stage_kinds** — the whole-query fused-program inventory
   (ISSUE 15, engine/fused.STAGE_KINDS): every stage kind the plan
   compiler can emit into one jitted program, pinned both ways
@@ -170,6 +174,16 @@ def extract_facts(contexts) -> dict:
     from dgraph_tpu.utils.memgov import GOVERNED_CACHES
     governed_caches = [{"name": n, "doc": d}
                        for n, d in sorted(GOVERNED_CACHES.items())]
+    # same discipline for the SLO ENGINE (ISSUE 17): the objective
+    # inventory (utils/slo.SLO_SPECS — a jax-free import by design) is
+    # re-exported verbatim; tests/test_lint.py pins it both ways
+    # against the runtime evaluator registry, so an objective with no
+    # evaluator (or an evaluator for an un-inventoried name) fails
+    # tier-1 — rule R15 enforces that `slo=` label literals and spec
+    # lookups stay inside this vocabulary
+    from dgraph_tpu.utils.slo import SLO_SPECS
+    slo_specs = [{"name": n, "doc": d}
+                 for n, d in sorted(SLO_SPECS.items())]
     return {
         "kernels": kernels,
         "kernel_launch_sites": launches,
@@ -183,6 +197,7 @@ def extract_facts(contexts) -> dict:
         "debug_endpoints": debug_endpoints,
         "fused_stage_kinds": fused_stages,
         "governed_caches": governed_caches,
+        "slo_specs": slo_specs,
         "totals": {
             "kernels": len(kernels),
             "kernel_launch_sites": len(launches),
@@ -199,5 +214,6 @@ def extract_facts(contexts) -> dict:
             "debug_endpoints": len(debug_endpoints),
             "fused_stage_kinds": len(fused_stages),
             "governed_caches": len(governed_caches),
+            "slo_specs": len(slo_specs),
         },
     }

@@ -54,6 +54,15 @@ R14 cache-registration   byte-holding caches join the process memory
                          waive with the reason its bytes are bounded)
                          — an unregistered cache is invisible to the
                          OOM evict-retry path and to /debug/memory.
+R15 slo-spec             SLO names stay inside the utils/slo.SLO_SPECS
+                         inventory (ISSUE 17): a literal `slo=` label
+                         on a metric, a literal SLO_SPECS /
+                         DEFAULT_TARGETS subscript, or a literal
+                         `_evaluator("...")` registration naming an
+                         objective the inventory doesn't carry would
+                         split the burn-rate vocabulary — dashboards,
+                         /debug/slo, and the watchdog conviction feed
+                         would disagree on what objectives exist.
 """
 
 from __future__ import annotations
@@ -64,7 +73,8 @@ from dgraph_tpu.analysis import FileContext, Finding, Rule
 
 __all__ = ["default_rules", "HotLoopCheckpoint", "DirectIO", "WallClock",
            "RetryDeadline", "MetricDocs", "JitPurity", "ShardMapCompat",
-           "FusedHostCallback", "AtomicWrite", "CacheRegistration"]
+           "FusedHostCallback", "AtomicWrite", "CacheRegistration",
+           "SloSpec"]
 
 
 def _dotted(node: ast.AST) -> str:
@@ -652,9 +662,69 @@ class CacheRegistration(Rule):
         return out
 
 
+# ---------------------------------------------------------------------------
+class SloSpec(Rule):
+    name = "slo-spec"
+    doc = ("R15: SLO objective names stay inside the utils/slo."
+           "SLO_SPECS inventory — a literal `slo=` metric label, a "
+           "literal SLO_SPECS/DEFAULT_TARGETS subscript, or a literal "
+           "`_evaluator(\"...\")` registration outside the inventory "
+           "splits the burn-rate vocabulary between dashboards, "
+           "/debug/slo, and the watchdog's kind=slo conviction feed")
+
+    SPEC_TABLES = frozenset({"SLO_SPECS", "DEFAULT_TARGETS"})
+
+    def __init__(self):
+        # jax-free by design (utils/slo.py imports no jax), so the
+        # static-analysis CLI can load the inventory directly
+        from dgraph_tpu.utils.slo import SLO_SPECS
+        self.known = frozenset(SLO_SPECS)
+
+    def applies(self, rel: str) -> bool:
+        return rel.startswith("dgraph_tpu/") or rel == "bench.py"
+
+    def check_file(self, ctx: FileContext) -> list[Finding]:
+        out = []
+
+        def flag(line: int, name: str, where: str) -> None:
+            out.append(Finding(
+                self.name, ctx.rel, line,
+                f"SLO name {name!r} ({where}) is not in the "
+                f"utils/slo.SLO_SPECS inventory — add it there with a "
+                f"doc line (and an @_evaluator), or fix the literal; "
+                f"known: {sorted(self.known)}"))
+
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call):
+                for kw in node.keywords:
+                    if (kw.arg == "slo"
+                            and isinstance(kw.value, ast.Constant)
+                            and isinstance(kw.value.value, str)
+                            and kw.value.value not in self.known):
+                        flag(node.lineno, kw.value.value,
+                             "literal slo= label")
+                if (_dotted(node.func).rsplit(".", 1)[-1]
+                        == "_evaluator"
+                        and node.args
+                        and isinstance(node.args[0], ast.Constant)
+                        and isinstance(node.args[0].value, str)
+                        and node.args[0].value not in self.known):
+                    flag(node.lineno, node.args[0].value,
+                         "evaluator registration")
+            elif (isinstance(node, ast.Subscript)
+                    and _dotted(node.value).rsplit(".", 1)[-1]
+                    in self.SPEC_TABLES
+                    and isinstance(node.slice, ast.Constant)
+                    and isinstance(node.slice.value, str)
+                    and node.slice.value not in self.known):
+                flag(node.lineno, node.slice.value, "spec-table lookup")
+        return out
+
+
 def default_rules() -> list[Rule]:
     from dgraph_tpu.analysis.guards import guard_rules
     return [HotLoopCheckpoint(), DirectIO(), WallClock(),
             RetryDeadline(), MetricDocs(), JitPurity(),
             ShardMapCompat(), FusedHostCallback(),
-            AtomicWrite(), CacheRegistration()] + guard_rules()
+            AtomicWrite(), CacheRegistration(),
+            SloSpec()] + guard_rules()

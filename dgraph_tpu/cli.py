@@ -81,6 +81,10 @@ def cmd_alpha(args) -> int:
         "queue_depth": args.queue_depth,
         "default_deadline_ms": args.default_deadline_ms,
         "cost_priors": args.cost_priors,
+        "ts_interval_s": args.ts_interval_s,
+        "ts_ring_points": args.ts_ring_points,
+        "slo_spec": args.slo_spec,
+        "forecast_shedding": args.forecast_shedding,
         "telemetry_push_url": args.telemetry_push_url,
         "telemetry_push_interval_s": args.telemetry_push_interval_s,
         "diag_dir": args.diag_dir,
@@ -297,6 +301,26 @@ def cmd_alpha(args) -> int:
              "stall_floor_ms=%.0f (SIGUSR2 or POST "
              "/debug/flightrecorder dumps a bundle)", diag_dir,
              cfg.stall_factor, cfg.stall_floor_ms)
+    if cfg.ts_interval_s > 0:
+        # retained metrics history + SLO burn-rate engine + load
+        # forecast (utils/timeseries.py, utils/slo.py): the sampler
+        # daemon snapshots the registry every tick into the memgov-
+        # governed ring, evaluates fast/slow-window burn rates (a
+        # breach emits a flight event with an exemplar trace id; a
+        # SUSTAINED fast burn convicts via the watchdog as kind=slo),
+        # and feeds admission's predicted-load shedding
+        from dgraph_tpu.utils import slo, timeseries
+        engine = slo.SloEngine(slo.parse_spec(cfg.slo_spec))
+        timeseries.arm(interval_s=cfg.ts_interval_s,
+                       ring_points=cfg.ts_ring_points,
+                       slo_engine=engine,
+                       forecast=cfg.forecast_shedding)
+        log.info("time-series sampler armed: interval_s=%.1f "
+                 "ring_points=%d slos=%s forecast_shedding=%s "
+                 "(/debug/timeseries, /debug/slo)",
+                 cfg.ts_interval_s, cfg.ts_ring_points,
+                 ",".join(sorted(engine.targets)),
+                 cfg.forecast_shedding)
     http_server = make_http_server(alpha, cfg.http_addr, cfg.http_port)
     serve_background(http_server)
     log.info("alpha up: grpc=%d http=%d", grpc_port,
@@ -753,6 +777,31 @@ def main(argv=None) -> int:
                         "and the placement heartbeat (default on; "
                         "--no-cost_priors restores count/EMA-only "
                         "scheduling)")
+    p.add_argument("--ts_interval_s", type=float, default=None,
+                   help="metrics-history sampler cadence in seconds: "
+                        "each tick snapshots the registry into the "
+                        "retained ring (counters as rates, histograms "
+                        "as windowed p50/p90/p99) and evaluates SLO "
+                        "burn rates (0 = sampler off)")
+    p.add_argument("--ts_ring_points", type=int, default=None,
+                   help="retained-history ring capacity in points "
+                        "(default 3600 ≈ 1h at 1s); the ring is "
+                        "memgov-governed — memory pressure surrenders "
+                        "the oldest history first")
+    p.add_argument("--slo_spec", default=None,
+                   help="SLO target overrides, 'name=value; ...' "
+                        "superflag over utils/slo.SLO_SPECS (e.g. "
+                        "'read_latency_p99_us=50000; "
+                        "error_rate=0.001'); unnamed objectives keep "
+                        "their defaults")
+    p.add_argument("--forecast_shedding", default=None,
+                   action=argparse.BooleanOptionalAction,
+                   help="Holt-trend load forecast (arrival rate × "
+                        "predicted cost) sheds admissions BEFORE the "
+                        "queue fills when predicted demand exceeds "
+                        "capacity (default on; --no-forecast_shedding "
+                        "keeps admission purely reactive, "
+                        "bit-identical to the pre-forecast path)")
     p.add_argument("--rpc_retries", type=int, default=None,
                    help="re-attempts per retryable cluster RPC "
                         "(UNAVAILABLE/connect failures only; backoff "

@@ -53,7 +53,8 @@ import threading
 import time
 from collections import deque
 
-from dgraph_tpu.utils import costprofile, flightrec, locks, memgov, tracing
+from dgraph_tpu.utils import (costprofile, flightrec, locks, memgov,
+                              timeseries, tracing)
 from dgraph_tpu.utils.metrics import METRICS
 
 __all__ = ["AdmissionController", "ServerOverloaded", "LANES"]
@@ -215,6 +216,9 @@ class _Lane:
             now = time.monotonic()
             self._maybe_decay_ema(now)
             self._last_activity = now
+            # every arrival counts (admitted or shed): the per-lane
+            # rate the time-series sampler feeds the load forecast
+            METRICS.inc("admission_requests_total", lane=self.name)
             if self.inflight < self.max_inflight and not self.waiters:
                 self.inflight += 1
                 self.admitted_total += 1
@@ -233,6 +237,17 @@ class _Lane:
             if pressured is not None:
                 hint = self._retry_after_s(len(self.waiters), cost_us)
                 raise self._overloaded(hint, "memory_pressure", cost_us)
+            # predicted-load shedding (ISSUE 17): the Holt trend over
+            # sampled arrival rates × this lane's predicted cost says
+            # demand outruns the tokens before the forecast horizon —
+            # shed NOW, while the retry hint is still short, instead
+            # of after the queue fills. Disarmed (forecast flag off or
+            # no sampler armed): one module-global load + None check.
+            if timeseries.forecast_probe(self.name, cost_us,
+                                         self.max_inflight):
+                METRICS.inc("forecast_sheds_total", lane=self.name)
+                hint = self._retry_after_s(len(self.waiters), cost_us)
+                raise self._overloaded(hint, "forecast", cost_us)
             if len(self.waiters) >= self.queue_depth:
                 if cost_us is None or not self._try_displace(cost_us):
                     hint = self._retry_after_s(len(self.waiters),

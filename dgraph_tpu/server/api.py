@@ -26,6 +26,7 @@ from dgraph_tpu.cluster.oracle import Oracle, TxnAborted
 from dgraph_tpu.utils import locks
 from dgraph_tpu.engine import Engine
 from dgraph_tpu.loader.chunker import NQuad, parse_json, parse_rdf
+from dgraph_tpu.server.admission import ServerOverloaded
 from dgraph_tpu.loader.xidmap import XidMap
 from dgraph_tpu.store.mvcc import MVCCStore, Mutation
 from dgraph_tpu.store.schema import parse_schema
@@ -468,6 +469,17 @@ class Alpha:
                     else:
                         yield ctx
                 completed = True
+            except (ServerOverloaded, dl.Cancelled, PermissionError):
+                # not error-budget burn: a shed is the shed_rate SLO's
+                # event, a cancel is the client's, auth is the caller's
+                raise
+            except Exception:
+                # every other escape is a failed serve, whatever the
+                # transport — the error_rate SLO's bad-event count
+                # (utils/slo.py) must see gRPC and embedded callers,
+                # not just the HTTP handler's 400 path
+                METRICS.inc("query_errors_total", lane=lane)
+                raise
             finally:
                 if predicted is not None:
                     # predicted-vs-actual joins the cost record (a shed

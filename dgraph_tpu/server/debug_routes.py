@@ -68,4 +68,13 @@ DEBUG_ENDPOINTS: dict[str, str] = {
         "GET: memory-governor snapshot — per-cache resident bytes / "
         "registrants / evictions against the device+host budgets and "
         "watermarks, OOM evict-retry counters, sticky-degraded shapes",
+    "/debug/timeseries":
+        "GET: retained metrics history — the sampler ring's windowed "
+        "points (counters as rates, histograms as p50/p90/p99); "
+        "?name= filters series by prefix, ?window= bounds the "
+        "lookback seconds, ?rate=false serves raw deltas",
+    "/debug/slo":
+        "GET: SLO engine state — per-objective targets, fast/slow "
+        "window burn rates, breach counts, and the sustained-burn "
+        "conviction feed the watchdog convicts as kind=slo",
 }

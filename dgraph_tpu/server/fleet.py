@@ -94,7 +94,30 @@ def node_snapshot(alpha) -> dict:
                    "dumps": len(fr["dumps"])},
         "gates": {"races": races.get("races_total", 0),
                   "lock_cycles": len(lock_graph.get("cycles", ()))},
+        # retained-history fragment (ISSUE 17): the recent-window
+        # digest + SLO states, so the fleet merge can answer "which
+        # node is burning budget" without a per-node round of pulls
+        "timeseries": _timeseries_fragment(),
+        "slo": _slo_fragment(),
     }
+
+
+def _timeseries_fragment() -> dict | None:
+    from dgraph_tpu.utils import timeseries
+    s = timeseries.state()
+    if s is None:
+        return None
+    return s.ring.summary(60.0)
+
+
+def _slo_fragment() -> dict | None:
+    from dgraph_tpu.utils import slo
+    eng = slo.ENGINE
+    if eng is None:
+        return None
+    st = eng.status()
+    return {"states": st["states"],
+            "breaches_total": st["breaches_total"]}
 
 
 def _with_instance(line: str, instance: str) -> str:
@@ -162,12 +185,31 @@ def fleet_snapshot(alpha, budget_ms: float = FLEET_BUDGET_MS) -> dict:
                 frag.get("costs") or {}))
         except Exception:  # noqa: BLE001 — a malformed fragment merges as empty
             pass
+    # cluster SLO/series roll-up (ISSUE 17): per-node burn rates fold
+    # into one worst-burn-per-objective view — "is anyone breaching,
+    # and who" in a single read; nodes with no engine armed are
+    # simply absent (partial, never a 500)
+    slo_merged: dict[str, dict] = {}
+    breaches_total = 0
+    for addr, frag in fragments.items():
+        sl = frag.get("slo") or {}
+        breaches_total += sl.get("breaches_total", 0)
+        for name, st in (sl.get("states") or {}).items():
+            for win, w in (st.get("windows") or {}).items():
+                cur = slo_merged.setdefault(name, {}).get(win)
+                if cur is None or w.get("burn", 0) > cur["burn"]:
+                    slo_merged[name][win] = {
+                        "burn": w.get("burn", 0),
+                        "breached": w.get("breached", False),
+                        "node": addr}
     return {
         "self": me,
         "nodes": {addr: {k: v for k, v in frag.items()
                          if k not in ("metrics", "costs")}
                   for addr, frag in fragments.items()},
         "errors": errors,
+        "slo": {"worst_burn": slo_merged,
+                "breaches_total": breaches_total},
         # exact merge: integer digest state is associative, so this is
         # bit-identical to merging the same fragments in-process (the
         # tier-1 test pins it against a local Aggregator.merge)

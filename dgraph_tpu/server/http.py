@@ -52,6 +52,8 @@ _DEBUG_GET = {
     "/debug/fleet": "_dbg_fleet",
     "/debug/fleet/flight": "_dbg_fleet_flight",
     "/debug/memory": "_dbg_memory",
+    "/debug/timeseries": "_dbg_timeseries",
+    "/debug/slo": "_dbg_slo",
 }
 _DEBUG_POST = {
     "/debug/profile": "_post_profile",
@@ -413,6 +415,34 @@ def make_http_server(alpha: Alpha, addr: str = "127.0.0.1",
             from dgraph_tpu.utils import memgov
             self._send(200, memgov.GOVERNOR.status())
 
+        def _dbg_timeseries(self):
+            # retained metrics history (utils/timeseries.py): the
+            # sampler ring's windowed points — ?name= filters series
+            # by prefix, ?window= bounds the lookback seconds,
+            # ?rate=false serves raw counter deltas instead of rates
+            from dgraph_tpu.utils import timeseries
+            qs = self._qs()
+            name = (qs.get("name") or [None])[0]
+            window = (qs.get("window") or [None])[0]
+            rate = (qs.get("rate") or ["true"])[0] != "false"
+            self._send_bytes(200, json.dumps(timeseries.status(
+                name=name,
+                window_s=float(window) if window else None,
+                rate=rate), default=str).encode())
+
+        def _dbg_slo(self):
+            # SLO engine state (utils/slo.py): every inventoried
+            # objective with its target and both windows' burn rates,
+            # breach counts, and the sustained-burn conviction feed
+            from dgraph_tpu.utils import slo
+            eng = slo.ENGINE
+            if eng is None:
+                self._send(200, {"armed": False})
+            else:
+                self._send_bytes(200, json.dumps(
+                    {"armed": True, **eng.status()},
+                    default=str).encode())
+
         def _dbg_locks(self):
             # lock-order sanitizer state: acquisition-graph
             # edges, detected cycles (each with both stacks),
@@ -546,6 +576,18 @@ def make_http_server(alpha: Alpha, addr: str = "127.0.0.1",
                     "query": " ".join(q.split())[:200],
                     "mono_s": dl.monotonic_s()})
 
+        def _explain_doc(self, trace_id: str) -> dict:
+            """The request's finished cost record (utils/costprofile —
+            the same record /debug/costs?recent=true serves), joined
+            by trace id: no new accounting, just the existing
+            breakdown echoed where the caller can see it."""
+            for rec in reversed(costprofile.recent(64)):
+                if rec.get("trace_id") == trace_id:
+                    return rec
+            return {"trace_id": trace_id,
+                    "note": "no finished cost record for this request "
+                            "(cost profiling disabled?)"}
+
         def _acl_user(self):
             """Resolve the access token when ACL is on (reference: the
             accessJwt header gate on every endpoint)."""
@@ -677,7 +719,9 @@ def make_http_server(alpha: Alpha, addr: str = "127.0.0.1",
                                              "code": "Unauthorized"}]})
             except Exception as e:  # surface parse/exec errors as the
                 # reference does: 200-with-errors JSON is api-breaking,
-                # use 400 + errors list
+                # use 400 + errors list (`query_errors_total{lane=}` is
+                # counted once, in the api._request lifecycle, so gRPC
+                # and embedded callers burn the same SLO budget)
                 self._send(400, {"errors": [{"message": str(e)}]})
 
         def _dispatch_post(self, t0):
@@ -731,6 +775,14 @@ def make_http_server(alpha: Alpha, addr: str = "127.0.0.1",
                     q, variables = req["query"], req.get("variables")
                 else:
                     q, variables = body, None
+                # ?explain=true (or an X-Explain request header):
+                # echo the request's cost-Recorder breakdown — route
+                # per hop, kernel launches, launch-gap µs, cache hit
+                # bits, admission wait — in the response extensions.
+                # One-hop introspection over EXISTING accounting.
+                explain = ("explain=true" in self.path.partition("?")[2]
+                           or (self.headers.get("X-Explain") or ""
+                               ).lower() in ("1", "true"))
                 with tracing.trace("http.query",
                                    trace_id=inbound_tid) as tid:
                     raw = alpha.query_raw(q, variables,
@@ -742,11 +794,17 @@ def make_http_server(alpha: Alpha, addr: str = "127.0.0.1",
                 self._slow_query_check(us, tid, q)
                 # splice the emitter's bytes into the envelope — the
                 # response body is never re-parsed server-side
-                self._send_bytes(200, b'{"data":' + raw +
-                                 b',"extensions":{"server_latency":'
-                                 b'{"total_us":%d},"trace_id":"%s"}}'
-                                 % (us, tid.encode()),
-                                 headers={"X-Trace-Id": tid})
+                env = (b'{"data":' + raw +
+                       b',"extensions":{"server_latency":'
+                       b'{"total_us":%d},"trace_id":"%s"'
+                       % (us, tid.encode()))
+                headers = {"X-Trace-Id": tid}
+                if explain:
+                    env += (b',"explain":'
+                            + json.dumps(self._explain_doc(tid),
+                                         default=str).encode())
+                    headers["X-Explain"] = "true"
+                self._send_bytes(200, env + b'}}', headers=headers)
             elif self.path.startswith("/mutate"):
                 ctype = self.headers.get("Content-Type") or ""
                 body = self._body().decode()

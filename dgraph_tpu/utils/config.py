@@ -96,6 +96,20 @@ class AlphaConfig:
     encryption_key_file: str = ""  # at-rest AES key (reference: ee enc)
     encryption_strict: bool = False  # reject plaintext files once migrated
     slow_query_ms: int = 0        # log queries slower than this (0 = off)
+    # time-series telemetry + SLO engine (utils/timeseries.py,
+    # utils/slo.py): retained metrics history sampled from the shared
+    # registry, multi-window burn-rate alerting, and the load forecast
+    # that feeds admission's predicted-load shedding
+    ts_interval_s: float = 1.0    # sampler cadence (0 = sampler off)
+    ts_ring_points: int = 3600    # retained samples (memgov-governed)
+    slo_spec: str = ""            # superflag overrides of the default
+                                  # SLO budgets, e.g.
+                                  # "read_latency_p99_us=5000;
+                                  #  error_rate=0.01"
+    forecast_shedding: bool = True  # trend forecast (arrival rate ×
+                                    # predicted cost) sheds ahead of the
+                                    # queue filling; False restores the
+                                    # reactive-only admission path
     trace_dir: str = ""           # arm jax.profiler device-trace capture
     log_level: str = "info"
 

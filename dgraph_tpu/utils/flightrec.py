@@ -257,6 +257,7 @@ class Watchdog:
         convicted.extend(self._scan_maintenance(now))
         convicted.extend(self._scan_pusher())
         convicted.extend(self._scan_memory(now))
+        convicted.extend(self._scan_slo(now))
         for kind, detail in convicted:
             METRICS.inc("watchdog_stalls_total", kind=kind)
             emit("watchdog.stall", stall=kind, **{
@@ -370,6 +371,24 @@ class Watchdog:
                              "retries": st["retries"],
                              "degraded": st["degraded"]})]
         return []
+
+    def _scan_slo(self, now: float):
+        """Sustained fast-burn conviction (kind=slo): the SLO engine's
+        edge-triggered breach already paged (`slo_breaches_total` + a
+        `slo.breach` flight event with an exemplar trace id); a FAST
+        burn that stays breached across the engine's sustain threshold
+        is an ongoing regression the black box should explain — convict
+        once per dump interval, so the bundle's "timeseries" surface
+        records the approach, not just the crash."""
+        from dgraph_tpu.utils import slo as _slo
+        eng = _slo.ENGINE
+        if eng is None:
+            return []
+        out = []
+        for c in eng.convictable():
+            if self._kind_due("slo", now):
+                out.append(("slo", c))
+        return out
 
     def _kind_due(self, kind: str, now: float) -> bool:
         """Condition-shaped convictions (queue head, maintenance,
@@ -814,6 +833,14 @@ def _surfaces(alpha) -> dict:
         else None
     out["peers"] = ({"enabled": True, "peers": res.snapshot()}
                     if res is not None else {"enabled": False})
+    # retained history (ISSUE 17): the last minutes LEADING UP TO this
+    # dump — per-series rates and latency percentiles plus SLO states,
+    # so a conviction bundle shows the approach, not just the crash
+    try:
+        from dgraph_tpu.utils import timeseries
+        out["timeseries"] = timeseries.recent_window(300.0)
+    except Exception:  # noqa: BLE001 — surface optional when disarmed
+        out["timeseries"] = None
     return out
 
 

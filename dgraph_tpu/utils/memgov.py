@@ -66,6 +66,9 @@ GOVERNED_CACHES: dict[str, str] = {
                   "the serving path reuses across queries",
     "outofcore.resident": "LazyPreds resident tablets: out-of-core "
                           "postings faulted from disk under its own LRU",
+    "timeseries.ring": "retained metrics history: the sampler daemon's "
+                       "bounded ring of windowed points (PR 17) — under "
+                       "pressure the oldest history is surrendered first",
 }
 
 # watermark fractions of the configured budget: eviction starts above

@@ -184,5 +184,18 @@ class Registry:
                            for (n, lk), v in self._gauges.items()},
             }
 
+    def hist_snapshot(self) -> dict:
+        """Histogram series view for the time-series sampler: rendered
+        series name → {"buckets": ladder, "counts": cumulative-free
+        per-bucket counts (last slot = +Inf), "sum": Σvalues, "n": N}.
+        Copies under the lock so the sampler diffs stable points."""
+        with self._lock:
+            return {
+                _series(n, lk): {"buckets": self._hist_buckets[n],
+                                 "counts": list(counts),
+                                 "sum": s, "n": n_obs}
+                for (n, lk), (counts, s, n_obs) in self._hists.items()
+            }
+
 
 METRICS = Registry()

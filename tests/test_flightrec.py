@@ -33,7 +33,8 @@ from dgraph_tpu.utils import flightrec, tracing
 from dgraph_tpu.utils.metrics import METRICS
 
 SURFACES = {"traces", "events", "costs", "scheduler", "admission",
-            "locks", "races", "peers", "slow_queries", "memory"}
+            "locks", "races", "peers", "slow_queries", "memory",
+            "timeseries"}
 
 
 @pytest.fixture(autouse=True)

@@ -1034,3 +1034,102 @@ def test_governed_cache_inventory_pinned_both_ways():
     # and every doc is a real one-liner, not a placeholder
     for doc in memgov.GOVERNED_CACHES.values():
         assert len(doc) > 20
+
+# ---------------------------------------------------------------------------
+# R15 slo-spec
+
+R15_BAD_LABEL = """\
+from dgraph_tpu.utils.metrics import METRICS
+METRICS.inc("slo_breaches_total", slo="made_up_objective", window="fast")
+"""
+
+R15_BAD_LOOKUP = """\
+from dgraph_tpu.utils.slo import DEFAULT_TARGETS
+target = DEFAULT_TARGETS["typo_latency_p99_us"]
+"""
+
+R15_GOOD = """\
+from dgraph_tpu.utils.metrics import METRICS
+from dgraph_tpu.utils.slo import DEFAULT_TARGETS
+METRICS.inc("slo_breaches_total", slo="error_rate", window="slow")
+target = DEFAULT_TARGETS["read_latency_p99_us"]
+"""
+
+R15_DYNAMIC = """\
+from dgraph_tpu.utils.metrics import METRICS
+def breach(name):
+    METRICS.inc("slo_breaches_total", slo=name, window="fast")
+"""
+
+R15_README = "`slo_breaches_total` documented here"
+
+
+def test_r15_flags_uninventoried_slo_label():
+    a = scan("dgraph_tpu/server/x.py", R15_BAD_LABEL,
+             readme=R15_README)
+    assert "slo-spec" in rules_of(a)
+
+
+def test_r15_flags_uninventoried_spec_lookup():
+    a = scan("dgraph_tpu/server/x.py", R15_BAD_LOOKUP,
+             readme=R15_README)
+    assert "slo-spec" in rules_of(a)
+
+
+def test_r15_passes_inventoried_names_and_dynamic_labels():
+    for src in (R15_GOOD, R15_DYNAMIC):
+        a = scan("dgraph_tpu/server/x.py", src, readme=R15_README)
+        assert "slo-spec" not in rules_of(a), src
+
+
+def test_r15_waiver():
+    src = R15_BAD_LABEL.replace(
+        'window="fast")',
+        'window="fast")  '
+        '# graftlint: allow(slo-spec): fixture-only objective')
+    a = scan("dgraph_tpu/server/x.py", src, readme=R15_README)
+    assert "slo-spec" not in rules_of(a)
+    assert "slo-spec" in rules_of(a, waived=True)
+
+
+def test_slo_spec_inventory_pinned_both_ways():
+    """ISSUE-17 satellite (the cost_record_fields pattern applied to
+    the SLO engine): the static objective inventory (utils/slo.
+    SLO_SPECS, re-exported by facts as `slo_specs`) and the runtime
+    evaluator registry are pinned to each other in both directions —
+    an evaluator for an un-inventoried name is a hard ValueError at
+    registration, and an inventoried objective nothing evaluates
+    fails here."""
+    from dgraph_tpu.utils import slo
+    a = run(ROOT)
+    facts_specs = {e["name"]: e["doc"] for e in a.facts["slo_specs"]}
+    assert facts_specs == slo.SLO_SPECS
+    assert a.facts["totals"]["slo_specs"] == len(slo.SLO_SPECS)
+    # runtime registry ↔ inventory, both directions
+    assert set(slo._EVALUATORS) == set(slo.SLO_SPECS)
+    # registration refuses names outside the inventory...
+    try:
+        slo._evaluator("not_an_objective")
+    except ValueError:
+        pass
+    else:
+        raise AssertionError(
+            "_evaluator() accepted a name outside SLO_SPECS")
+    # ...and so do target overrides (CLI typos must not silently keep
+    # the default budget in force)
+    try:
+        slo.parse_spec("typo_rate=0.5")
+    except ValueError:
+        pass
+    else:
+        raise AssertionError("parse_spec() accepted an unknown SLO")
+    try:
+        slo.SloEngine({"typo_rate": 0.5})
+    except ValueError:
+        pass
+    else:
+        raise AssertionError("SloEngine accepted an unknown target")
+    # every target has a default and every doc is a real one-liner
+    assert set(slo.DEFAULT_TARGETS) == set(slo.SLO_SPECS)
+    for doc in slo.SLO_SPECS.values():
+        assert len(doc) > 20
