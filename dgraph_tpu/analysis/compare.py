@@ -27,7 +27,7 @@ __all__ = ["flatten", "direction", "compare", "bench_compare_main"]
 # leaves where HIGHER is better (throughput / precision)
 _HIGHER = frozenset({"value", "shed_precision", "edges_per_s"})
 # leaves where LOWER is better, beyond the `*_us` suffix rule
-_LOWER = frozenset({"mean_kernel_launches"})
+_LOWER = frozenset({"mean_kernel_launches", "launches_per_query"})
 
 
 def flatten(doc, prefix: str = "") -> dict[str, float]:

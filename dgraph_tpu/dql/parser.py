@@ -671,6 +671,7 @@ _ARITY = {  # args after the attr: (min, max)
     "allofterms": (1, 10**9), "regexp": (1, 2), "match": (1, 2),
     "has": (0, 0),
     "near": (2, 2), "within": (1, 1), "contains": (1, 1),
+    "similar_to": (2, 2),  # k, <vector literal | uid>
 }
 
 

@@ -500,6 +500,13 @@ class Executor:
                 parts.append(r[r >= 0].astype(np.int32))
             return (np.unique(np.concatenate(parts)).astype(np.int32)
                     if parts else EMPTY)
+        if f.name == "similar_to":
+            # routed k-NN seed: device/mesh brute-force top-k with host
+            # fallback — bit-identical to funcs.host_similar on every
+            # route (store/vec.py)
+            from dgraph_tpu.store.vec import similar_ranks
+            return similar_ranks(self.store, f, mesh=self.mesh,
+                                 device_threshold=self.device_threshold)
         return eval_func(self.store, f, self.val_vars)
 
     # -- root evaluation ----------------------------------------------------
@@ -593,7 +600,15 @@ class Executor:
         """Execute one root block (reference: Request.ProcessQuery per block)."""
         dl.checkpoint("block")
         with tracing.span("engine.block", block=sg.attr) as sp:
+            is_knn = sg.func is not None and sg.func.name == "similar_to"
+            t0 = time.perf_counter() if is_knn else 0.0
             node = self._run_block(sg)
+            if is_knn:
+                # the graphrag_read_p99 SLO watches this histogram: the
+                # retrieval workload's per-block latency under whatever
+                # route (fused/staged, host/device/mesh) actually served
+                METRICS.observe("graphrag_latency_us",
+                                (time.perf_counter() - t0) * 1e6)
             sp.attrs["nodes"] = int(len(node.nodes))
             return node
 

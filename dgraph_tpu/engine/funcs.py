@@ -52,6 +52,11 @@ def eval_func(store: Store, f: FuncNode, val_env: dict | None = None) -> np.ndar
         return _match(store, f)
     if name in ("near", "within", "contains"):
         return _geo_func(store, f, name)
+    if name == "similar_to":
+        # host reference route; the executor intercepts this name
+        # earlier for routed (device/mesh) dispatch
+        from dgraph_tpu.store.vec import host_similar
+        return host_similar(store, f)
     raise ValueError(f"unknown function {f.name!r}")
 
 

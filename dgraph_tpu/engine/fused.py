@@ -79,6 +79,10 @@ STAGE_KINDS: dict[str, str] = {
                 "the masked-hop body, per-hop edge matrices kept"),
     "count": ("terminal count(pred) aggregation: per-parent-node "
               "degree segment-reduce bound to the leaf's value var"),
+    "knn": ("similar_to seed selection: scored matmul over the vector "
+            "tablet + deterministic top-k (tie-break by uid) emitting "
+            "the root frontier in-trace — the GraphRAG flagship shape "
+            "(knn → recurse → filter → count) is ONE program"),
 }
 
 # depth bound for the scanned recurse stage (shares the host guard)
@@ -101,10 +105,11 @@ class _Stage:
     parent: int          # producing stage index; -1 = the root frontier
     has_filter: bool = False
     depth: int = 0       # recurse only
+    k: int = 0           # knn only: requested seed count
 
     def sig(self) -> tuple:
         return (self.kind, self.attr, self.reverse, self.parent,
-                self.has_filter, self.depth)
+                self.has_filter, self.depth, self.k)
 
 
 @dataclass
@@ -118,6 +123,7 @@ class FusedPlan:
     # parent stage idx → {id(leaf sg): count stage idx}
     counts_of: dict[int, dict[int, int]] = field(default_factory=dict)
     recurse: bool = False
+    knn: bool = False    # stage 0 is a knn seed stage
 
     @property
     def sig(self) -> tuple:
@@ -166,6 +172,9 @@ def plan_block(store, sg) -> FusedPlan | None:
 
     if sg.shortest is not None or sg.groupby:
         return None
+
+    knn_stage = _plan_knn(store, sg)
+
     if sg.recurse is not None:
         a = sg.recurse
         if a.loop or not a.depth or a.depth > MAX_FUSED_DEPTH:
@@ -177,13 +186,23 @@ def plan_block(store, sg) -> FusedPlan | None:
         if (e.is_expand_all or e.facet_filter is not None
                 or not _filter_fusable(e.filters)):
             return None
-        plan = FusedPlan(recurse=True)
-        plan.stages.append(_Stage("recurse", e.attr, e.is_reverse, -1,
+        plan = FusedPlan(recurse=True, knn=knn_stage is not None)
+        if knn_stage is not None:
+            plan.stages.append(knn_stage)
+            plan.stage_sgs.append(sg)
+        root_parent = 0 if plan.knn else -1
+        plan.stages.append(_Stage("recurse", e.attr, e.is_reverse,
+                                  root_parent,
                                   e.filters is not None, a.depth))
         plan.stage_sgs.append(e)
         return plan
 
-    plan = FusedPlan()
+    plan = FusedPlan(knn=knn_stage is not None)
+    root_parent = -1
+    if knn_stage is not None:
+        plan.stages.append(knn_stage)
+        plan.stage_sgs.append(sg)
+        root_parent = 0
 
     def walk(node_sg, parent: int) -> None:
         for c in node_sg.children:
@@ -207,12 +226,39 @@ def plan_block(store, sg) -> FusedPlan | None:
             # other leaves (values, vars, aggregates) bind host-side
 
     try:
-        walk(sg, -1)
+        walk(sg, root_parent)
     except _Ineligible:
         return None
-    if not any(st.kind == "hop" for st in plan.stages):
+    if not plan.knn and not any(st.kind == "hop" for st in plan.stages):
         return None    # nothing device-bound to fuse
     return plan
+
+
+def _plan_knn(store, sg) -> _Stage | None:
+    """A similar_to root compiles to an in-trace knn seed stage when
+    the root level itself is plain: root filters/ordering/pagination
+    reorder or trim the SEED SET host-side, so those shapes keep the
+    staged (routed) seed and fuse only below it. k must be a static
+    positive int at plan time; query-vector resolution stays at run
+    time (_run_plan) where a structural empty can still fall back."""
+    from dgraph_tpu.store.types import Kind
+
+    f = sg.func
+    if f is None or f.name != "similar_to":
+        return None
+    if (sg.filters is not None or sg.orders or sg.first or sg.offset
+            or sg.after):
+        return None
+    ps = store.schema.peek(f.attr)
+    if ps is None or ps.kind != Kind.VECTOR:
+        return None
+    try:
+        k = int(f.args[0])
+    except (IndexError, TypeError, ValueError):
+        return None    # malformed: the staged route raises the error
+    if k <= 0 or len(f.args) != 2:
+        return None
+    return _Stage("knn", f.attr, False, -1, False, 0, k)
 
 
 # -- the program builder ------------------------------------------------------
@@ -249,9 +295,16 @@ def _emit_recurse(st: _Stage, caps: tuple, arrays, frontier):
 
     from dgraph_tpu.ops.recurse import masked_hop
 
+    from dgraph_tpu.ops.uidalgebra import pad_to
+
     (indptr, indices), allowed, _page = arrays
     edge_cap, out_cap = caps
     n_nodes = indptr.shape[0] - 1
+    if frontier.shape[0] < out_cap:
+        # knn-fed: the seed stage's cap is narrower than the scan's
+        # carry buffer — sentinel-pad in-trace (sorted sets keep their
+        # sentinels trailing, so this is shape-only)
+        frontier = pad_to(frontier, out_cap)
 
     def hop(carry, _):
         fr, seen = carry
@@ -280,10 +333,34 @@ def _emit_count(st: _Stage, caps: tuple, arrays, frontier):
     return (frontier_degrees(indptr, frontier),), None
 
 
+def _emit_knn(st: _Stage, caps: tuple, arrays, frontier):
+    """Emit the similar_to seed stage: scored matmul over the resident
+    [n, d] stack, deterministic top-k (score desc, uid asc — the exact
+    numpy-lexsort order of the host reference), emitted as a SORTED
+    sentinel-padded uid set so downstream stages consume it like any
+    frontier. Ignores the program's root `frontier` input."""
+    import jax.numpy as jnp
+
+    from dgraph_tpu.ops.uidalgebra import SENTINEL32
+
+    (subj, vecs), q, _page = arrays
+    (out_cap,) = caps
+    scores = vecs @ q
+    # -scores is an exact f32 sign flip, so this is bit-identical to
+    # the host np.lexsort((subj, -scores)) total order
+    order = jnp.lexsort((subj, -scores))
+    k = min(st.k, int(subj.shape[0]))    # static: k > n clamps
+    top = subj[order[:k]]
+    nxt = jnp.sort(jnp.concatenate(
+        [top, jnp.full((out_cap - k,), SENTINEL32, jnp.int32)]))
+    return (nxt, jnp.int32(k)), nxt
+
+
 _STAGE_EMITTERS = {
     "hop": _emit_hop,
     "recurse": _emit_recurse,
     "count": _emit_count,
+    "knn": _emit_knn,
 }
 
 
@@ -461,6 +538,23 @@ def _run_plan(ex, sg, plan: FusedPlan, shape: str):
     store = ex.store
     rels, devs, alloweds, pages = [], [], [], []
     for st, ssg in zip(plan.stages, plan.stage_sgs):
+        if st.kind == "knn":
+            from dgraph_tpu.store import vec
+            t = store.vec_tablet(st.attr)
+            if t is None or not t.rows:
+                return None   # structurally empty: staged serves EMPTY
+            try:
+                resolved = vec.resolve_query(store, sg.func)
+            except ValueError:
+                return None   # malformed query: staged raises it
+            if resolved is None:
+                return None   # unknown uid / uid without a vector
+            costprofile.note_max("tablet_rows", t.rows)
+            rels.append(t)
+            devs.append(store.vec_device(st.attr))
+            alloweds.append(resolved[2])   # f32 query vector
+            pages.append((0, NO_LIMIT))
+            continue
         rel = store.rel(st.attr, st.reverse)
         if rel.nnz == 0:
             return None           # staged short-circuits empties
@@ -479,21 +573,43 @@ def _run_plan(ex, sg, plan: FusedPlan, shape: str):
         offset = ssg.offset if st.kind == "hop" else 0
         pages.append((offset, first))
 
-    display = ex.root_display(sg)
-    nodes = np.unique(display).astype(np.int32)
+    if plan.knn:
+        # the seed set is computed IN-TRACE; root display/nodes bind
+        # from the program's own knn output after the launch
+        display = nodes = np.zeros(0, np.int32)
+    else:
+        display = ex.root_display(sg)
+        nodes = np.unique(display).astype(np.int32)
 
     with _lock:
         caps = _caps_memo.get(plan.sig)
     if caps is None:
         caps = _estimate_caps(plan, rels, nodes)
-    if plan.recurse and caps[0][1] < _bucket(max(len(nodes), 1)):
-        # memoized caps came from a smaller seed set: the frontier
-        # carry buffer must fit this query's roots
-        caps = ((caps[0][0], _bucket(len(nodes))),)
+    if plan.knn:
+        # memoized caps may predate tablet growth: the seed buffer
+        # must hold this snapshot's min(k, rows)
+        need = _bucket(max(min(plan.stages[0].k, rels[0].rows), 1))
+        if caps[0][0] < need:
+            lc = list(caps)
+            lc[0] = (need,)
+            caps = tuple(lc)
+    if plan.recurse:
+        ri = 1 if plan.knn else 0
+        # memoized caps may come from a smaller seed set: the scan's
+        # frontier carry buffer must fit this query's roots (for a knn
+        # seed, the seed stage's own cap)
+        floor = max(_bucket(max(len(nodes), 1)),
+                    caps[0][0] if plan.knn else 0)
+        if caps[ri][1] < floor:
+            lc = list(caps)
+            lc[ri] = (caps[ri][0], floor)
+            caps = tuple(lc)
 
     f_cap = _bucket(max(len(nodes), 1))
-    alloweds_d = tuple(ops.pad_to(a, _bucket(max(len(a), 1)))
-                       for a in alloweds)
+    alloweds_d = tuple(
+        a if (plan.knn and i == 0)   # f32 query vector: no int32 pad
+        else ops.pad_to(a, _bucket(max(len(a), 1)))
+        for i, a in enumerate(alloweds))
     pages_d = tuple((np.int32(o), np.int32(f)) for o, f in pages)
     # budget gate before the device is committed: past here the whole
     # query is one uninterruptible dispatch
@@ -502,8 +618,14 @@ def _run_plan(ex, sg, plan: FusedPlan, shape: str):
                       stages=len(plan.stages)) as sp:
         t_exec = time.perf_counter()
         for _attempt in range(_MAX_ATTEMPTS):
-            fr = (ops.pad_to(nodes, caps[0][1]) if plan.recurse
-                  else ops.pad_to(nodes, f_cap))
+            if plan.knn:
+                # stage 0 computes the seed set itself and ignores
+                # this input; 1-wide dummy keeps the pytree aligned
+                fr = ops.pad_to(nodes, 1)
+            elif plan.recurse:
+                fr = ops.pad_to(nodes, caps[0][1])
+            else:
+                fr = ops.pad_to(nodes, f_cap)
             program = _program_for(shape, plan.sig, caps)
             key = (plan.sig, caps, int(fr.shape[0]),
                    tuple(int(d[0].shape[0]) for d in devs),
@@ -548,13 +670,25 @@ def _run_plan(ex, sg, plan: FusedPlan, shape: str):
                         path="fused")
             costprofile.add("edges_traversed", edges)
             costprofile.add("bytes_gathered", 16 * edges)
-        for st, out in zip(plan.stages, outs):
+        for st, rel, out in zip(plan.stages, rels, outs):
             if st.kind == "count":
                 continue
-            n = int(out[6]) if st.kind == "hop" else int(out[4].sum())
+            if st.kind == "knn":
+                n = rel.rows   # scored rows ≈ the scan's work
+            else:
+                n = (int(out[6]) if st.kind == "hop"
+                     else int(out[4].sum()))
             # modeled per-tablet µs, the same ~16 edges/µs scale the
             # staged expand() charges (placement signal)
             costprofile.add_tablet_cost(st.attr, n // 16 + 1)
+        if plan.knn:
+            # bind the root set from the program's own seed output:
+            # sorted ascending with sentinels trailing, first k_true
+            # entries are the seeds — the same sorted-unique set the
+            # staged root_display yields for an order-free similar_to
+            k_true = int(outs[0][1])
+            nodes = np.asarray(outs[0][0][:k_true], np.int32)
+            display = nodes
         return _unpack(ex, sg, plan, outs, display, nodes)
 
 
@@ -571,6 +705,13 @@ def _estimate_caps(plan: FusedPlan, rels, nodes) -> tuple:
         if st.kind == "count":
             caps.append(())
             continue
+        if st.kind == "knn":
+            # exact: the seed stage emits at most min(k, rows) uids
+            # and can never overflow (rel is the VecTablet here)
+            seeds = max(min(st.k, rel.rows), 1)
+            caps.append((_bucket(seeds),))
+            est_nodes[i] = seeds
+            continue
         n_rows = max(int(len(rel.indptr)) - 1, 1)
         if st.parent == -1 and len(nodes):
             est = int(rel.degree(nodes).sum())
@@ -579,7 +720,10 @@ def _estimate_caps(plan: FusedPlan, rels, nodes) -> tuple:
             est = int(est_nodes[st.parent] * (avg + 1.0) * 2.0)
         ecap = _bucket(max(est, 1))
         if st.kind == "recurse":
-            caps.append((ecap, _bucket(max(len(nodes), 1))))
+            out_floor = max(len(nodes), 1)
+            if st.parent >= 0:   # knn-fed: carry must fit the seeds
+                out_floor = max(out_floor, caps[st.parent][0])
+            caps.append((ecap, _bucket(out_floor)))
         else:
             caps.append((ecap,))
         est_nodes[i] = max(1, min(est, n_rows))
@@ -635,10 +779,11 @@ def _unpack(ex, sg, plan: FusedPlan, outs, display, nodes):
                      display=display.astype(np.int32))
     if sg.var_name:
         ex.uid_vars[sg.var_name] = nodes
+    root_idx = 0 if plan.knn else -1
     if plan.recurse:
-        _unpack_recurse(ex, root, plan, outs[0])
+        _unpack_recurse(ex, root, plan, outs[1 if plan.knn else 0])
         return root
-    _attach(ex, plan, outs, -1, root)
+    _attach(ex, plan, outs, root_idx, root)
     return root
 
 

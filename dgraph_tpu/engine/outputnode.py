@@ -466,6 +466,8 @@ def _json_val(v):
         return int(v)
     if isinstance(v, (np.floating, float)):
         return float(v)
+    if isinstance(v, np.ndarray):  # float32vector: render as a list
+        return [float(x) for x in v.tolist()]
     return str(v)
 
 

@@ -22,6 +22,9 @@ _TYPE_MAP = {
 }
 for _k in list(_TYPE_MAP):
     _TYPE_MAP[f"http://www.w3.org/2001/XMLSchema#{_k.split(':')[1]}"] = _TYPE_MAP[_k]
+# vector literal rides as its string form `"[0.1, ...]"`; the schema
+# layer (types.parse_vector) decodes it at ingestion
+_TYPE_MAP["float32vector"] = str
 
 
 @dataclass

@@ -88,7 +88,13 @@ def save_predicate(dirname: str, pred: str, pd) -> dict:
         crcs[fname] = vault.save_np(os.path.join(dirname, fname),
                                     col.subj)
         vals = col.vals
-        if vals.dtype == object:  # strings: store as fixed-width UTF
+        if pd.schema.kind == Kind.VECTOR:
+            # vector columns persist as a dense [k, d] f32 stack — the
+            # exact bytes the tablet serves, crc-verified like any
+            # other segment (the GEO-string precedent, but binary)
+            vals = (np.stack([np.asarray(v, np.float32) for v in vals])
+                    if len(vals) else np.zeros((0, 0), np.float32))
+        elif vals.dtype == object:  # strings: store as fixed-width UTF
             vals = np.array([str(v) for v in vals], dtype=np.str_)
         fname = f"{slug}.val.{lslug}.vals.npy"
         crcs[fname] = vault.save_np(os.path.join(dirname, fname), vals)
@@ -286,6 +292,11 @@ def load_predicate(dirname: str, pred: str, meta: dict,
             out = np.empty(len(vals), dtype=object)
             out[:] = [parse_geo(v) for v in vals]
             vals = out
+        elif ps is not None and ps.kind == Kind.VECTOR:
+            # dense [k, d] f32 stack → object column of row views
+            rows = np.asarray(vals, np.float32)
+            vals = np.empty(len(rows), dtype=object)
+            vals[:] = [rows[i] for i in range(len(rows))]
         pd.vals[lang] = ValueColumn(
             subj=_load(f"{slug}.val.{lslug}.subj.npy"),
             vals=vals)

@@ -34,6 +34,9 @@ class PredicateSchema:
     lang: bool = False
     upsert: bool = False
     unique: bool = False
+    # float32vector only: embedding width. 0 = infer from the first
+    # loaded vector; any later mismatch is refused at schema time.
+    vector_dim: int = 0
 
     @property
     def is_uid(self) -> bool:
@@ -93,6 +96,8 @@ class Schema:
                                (p.unique, "unique")):
                 if flag:
                     d += f" @{name}"
+            if p.vector_dim:
+                d += f" @dim({p.vector_dim})"
             out.append(f"{p.name}: {t}{d} .")
         for t in self.types.values():
             fields = "\n".join(f"  {f}" for f in t.fields)
@@ -131,6 +136,10 @@ def parse_schema(text: str) -> Schema:
             kind = Kind(typ)
         except ValueError:
             raise ValueError(f"unknown type {typ!r} in schema line: {line!r}")
+        if kind == Kind.VECTOR and lb:
+            raise ValueError(
+                f"float32vector predicates hold one vector per node — "
+                f"list form is not supported: {line!r}")
         p = PredicateSchema(name=name, kind=kind, is_list=bool(lb))
         for dm in _DIRECTIVE_RE.finditer(rest):
             d, args = dm.group(1), dm.group(2)
@@ -156,6 +165,16 @@ def parse_schema(text: str) -> Schema:
                 p.upsert = True
             elif d == "unique":
                 p.unique = True
+            elif d == "dim":
+                if kind != Kind.VECTOR:
+                    raise ValueError(
+                        f"@dim only on float32vector predicates: {line!r}")
+                try:
+                    p.vector_dim = int((args or "").strip())
+                except ValueError:
+                    raise ValueError(f"@dim needs an integer: {line!r}")
+                if p.vector_dim <= 0:
+                    raise ValueError(f"@dim must be positive: {line!r}")
             elif d == "noconflict":
                 pass  # accepted, no-op (as in reference semantics for reads)
             else:

@@ -212,7 +212,8 @@ def _register_device_caches(store) -> None:
         return nbytes, evict_one
 
     for attr, name in (("_device", "store.device"),
-                       ("_sharded", "store.sharded")):
+                       ("_sharded", "store.sharded"),
+                       ("_vec_dev", "store.vec")):
         nbytes, evict_one = make_cbs(attr)
         memgov.GOVERNOR.register(name, "device", nbytes, evict_one,
                                  owner=store)
@@ -230,6 +231,11 @@ class Store:
         self._device: dict[tuple[str, str], tuple[jax.Array, jax.Array]] = {}
         self._sharded: dict = {}
         self._sharded_mesh = None
+        # float32vector tablets: host stacks (cheap, rebuilt from the
+        # value column) and device/mesh placements (governed: store.vec)
+        self._vec_tab: dict = {}
+        self._vec_dev: dict = {}
+        self._vec_mesh = None
         self._empty_rel = EdgeRel(np.zeros(self.n_nodes + 1, np.int32),
                                   np.zeros(0, np.int32))
         _register_device_caches(self)
@@ -329,6 +335,67 @@ class Store:
         if mean > 0:
             METRICS.set_gauge("mesh_shard_balance",
                               float(self._mesh_shard_nnz.max()) / mean)
+
+    # -- vector tablets ------------------------------------------------------
+    def vec_tablet(self, pred: str):
+        """Host `[n, d]` embedding stack of a float32vector predicate,
+        built lazily from the value column and cached on this snapshot.
+        None for non-vector predicates."""
+        t = self._vec_tab.get(pred)
+        if t is None:
+            ps = self.schema.peek(pred)
+            if ps is None or ps.kind != Kind.VECTOR:
+                return None
+            from dgraph_tpu.store import vec as _vec
+            t = self._vec_tab[pred] = _vec.build_tablet(
+                self.value_col(pred), ps.vector_dim)
+        return t
+
+    def vec_device(self, pred: str):
+        """Embedding stack on the default device, cached + governed
+        under `store.vec` (the device_rel residency discipline)."""
+        key = (pred, "dev")
+        out = self._vec_dev.get(key)
+        if out is None:
+            t = self.vec_tablet(pred)
+            out = self._vec_dev[key] = (jax.device_put(t.subj),
+                                        jax.device_put(t.vecs))
+            from dgraph_tpu.utils import memgov
+            memgov.GOVERNOR.maybe_evict("device")
+        return out
+
+    def vec_sharded(self, pred: str, mesh):
+        """Row-sharded embedding stack placed on a mesh, cached per
+        predicate (the sharded_rel tablet discipline — residency
+        carried across folds while the mesh object is unchanged).
+        Shard-stacked layout: subj `[d, rows]` padded with sentinel
+        ranks, vecs `[d, rows, dim]` padded with zero rows. Returns
+        (subj_s, vecs_s, rows_per_shard)."""
+        from dgraph_tpu.ops.uidalgebra import SENTINEL32
+        from dgraph_tpu.parallel.mesh import shard_leading
+        key = (pred, "mesh")
+        if self._vec_mesh is not mesh:
+            for k in [k for k in self._vec_dev if k[1] == "mesh"]:
+                self._vec_dev.pop(k, None)
+            self._vec_mesh = mesh
+        out = self._vec_dev.get(key)
+        if out is None:
+            t = self.vec_tablet(pred)
+            d = int(mesh.devices.size)
+            rows = -(-max(t.rows, 1) // d)
+            pad = rows * d - t.rows
+            subj = np.concatenate(
+                [t.subj, np.full(pad, SENTINEL32, np.int32)])
+            vecs = np.concatenate(
+                [t.vecs, np.zeros((pad, t.dim), np.float32)])
+            sh = shard_leading(mesh)
+            out = self._vec_dev[key] = (
+                jax.device_put(subj.reshape(d, rows), sh),
+                jax.device_put(vecs.reshape(d, rows, t.dim), sh),
+                rows)
+            from dgraph_tpu.utils import memgov
+            memgov.GOVERNOR.maybe_evict("device")
+        return out
 
     # -- values -------------------------------------------------------------
     def value_col(self, pred: str, lang: str = "") -> ValueColumn | None:
@@ -534,6 +601,17 @@ class StoreBuilder:
                 ps.kind = Kind.INT
             elif isinstance(value, float):
                 ps.kind = Kind.FLOAT
+        if ps.kind == Kind.VECTOR:
+            # convert NOW so a width mismatch is refused at schema time
+            # (load time), not discovered mid-query; first vector fixes
+            # the width when the schema didn't declare @dim
+            value = convert(value, Kind.VECTOR)
+            if ps.vector_dim == 0:
+                ps.vector_dim = int(len(value))
+            elif len(value) != ps.vector_dim:
+                raise ValueError(
+                    f"predicate {pred!r}: vector of dim {len(value)} "
+                    f"does not match schema dim {ps.vector_dim}")
         self._values.setdefault((pred, lang), []).append((subj, value))
         if facets:
             self._vfacets.setdefault(pred, {})[subj] = dict(facets)
@@ -585,8 +663,12 @@ class StoreBuilder:
             dpairs = []
             for s, v in pairs:
                 cv = convert(v, kind)
-                key = (rank[s], cv if not isinstance(cv, np.datetime64)
-                       else cv.astype("int64").item())
+                if isinstance(cv, np.datetime64):
+                    key = (rank[s], cv.astype("int64").item())
+                elif isinstance(cv, np.ndarray):  # vectors: hash bytes
+                    key = (rank[s], cv.tobytes())
+                else:
+                    key = (rank[s], cv)
                 if key in seen:
                     continue
                 seen.add(key)

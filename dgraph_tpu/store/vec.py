@@ -1,0 +1,306 @@
+"""Vector tablets + brute-force k-NN seed selection (GraphRAG serving).
+
+The ROADMAP's GraphRAG workload ("Democratizing GraphRAG", PAPERS) is
+k-NN seed selection feeding `@recurse` expansion under deadlines. This
+module is the vector half: per-predicate `[n, d]` float32 embedding
+stacks ("vec tablets") built from the columnar value store, plus the
+`similar_to(pred, k, <vector|uid>)` top-k scan behind the root func —
+FeatGraph's thesis that the same gather/segment machinery generalizes
+when nodes carry dense features: the scan is a scored matmul, exactly
+the dense-math-per-node shape the device wins biggest on, and on the
+mesh an embarrassingly row-shardable one.
+
+Three routes, one contract — bit-identical rank sets:
+
+* **host** — numpy matmul + lexsort((rank, -score)): score descending,
+  rank-ascending tie-break. This IS the reference the other routes are
+  pinned against.
+* **device** — the same trace under jax.jit, launched through the
+  memgov OOM lifecycle at site `vec.topk` (alloc failure → evict-retry
+  → sticky degrade to the host route).
+* **mesh** — row-sharded stacks (the `Store.sharded_rel` discipline:
+  per-snapshot residency, placed once), per-device local top-k +
+  all_gather merge (the parallel/dsort.py shape).
+
+Selection only compares scores, so the set is identical whenever the
+matmul is bit-identical across routes — guaranteed for exactly
+representable inputs (the fixtures and bench embeddings use small
+integer-valued components); route choice rides the PR-10 costprior
+route EMAs (`knn_host`/`knn_device`/`knn_mesh`) the same way
+`Executor._mesh_promoted` consults `mesh` vs `numpy`.
+
+Import discipline: jax only inside the device/mesh helpers — the host
+route and tablet builders import numpy alone (loaders and the analysis
+CLI touch them without a device runtime).
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+from dataclasses import dataclass
+
+import numpy as np
+
+from dgraph_tpu.store.types import parse_vector
+from dgraph_tpu.utils import memgov
+from dgraph_tpu.utils.metrics import METRICS
+
+__all__ = ["VecTablet", "build_tablet", "host_topk", "host_similar",
+           "similar_ranks", "resolve_query"]
+
+EMPTY = np.zeros(0, np.int32)
+
+
+@dataclass
+class VecTablet:
+    """One predicate's embedding stack: `vecs[i]` is the vector of rank
+    `subj[i]` (sorted unique int32 ranks — first value per subject)."""
+
+    subj: np.ndarray   # int32 [n], sorted unique
+    vecs: np.ndarray   # float32 [n, d], row-aligned with subj
+    dim: int
+
+    @property
+    def rows(self) -> int:
+        return int(self.subj.shape[0])
+
+    def vector_of(self, rank: int) -> np.ndarray | None:
+        i = int(np.searchsorted(self.subj, rank))
+        if i < self.rows and int(self.subj[i]) == rank:
+            return self.vecs[i]
+        return None
+
+
+def build_tablet(col, dim_hint: int = 0) -> VecTablet:
+    """ValueColumn (object column of 1-D f32 rows) → VecTablet. First
+    value per subject wins (the dsort key-column discipline); an empty
+    column yields a [0, dim_hint] stack."""
+    if col is None or not len(col.subj):
+        return VecTablet(subj=EMPTY.copy(),
+                         vecs=np.zeros((0, dim_hint), np.float32),
+                         dim=dim_hint)
+    subj, idx = np.unique(np.asarray(col.subj, np.int32),
+                          return_index=True)
+    rows = [np.asarray(col.vals[i], np.float32) for i in idx]
+    vecs = np.stack(rows).astype(np.float32)
+    return VecTablet(subj=subj.astype(np.int32), vecs=vecs,
+                     dim=int(vecs.shape[1]))
+
+
+# ---------------------------------------------------------------------------
+# host route: the bit-identity reference
+
+def host_topk(subj: np.ndarray, vecs: np.ndarray, q: np.ndarray,
+              k: int) -> np.ndarray:
+    """Top-k ranks by dot-product score, ties broken by rank ascending;
+    returns the SORTED rank set (root funcs produce sets — ordering and
+    pagination compose downstream). k > n clamps to n."""
+    if not len(subj) or k <= 0:
+        return EMPTY.copy()
+    scores = vecs @ np.asarray(q, np.float32)
+    # lexsort: primary -scores ascending (= score desc; f32 sign flip
+    # is exact), secondary subj ascending — the total order every
+    # route reproduces
+    order = np.lexsort((subj, -scores))
+    return np.sort(subj[order[:k]]).astype(np.int32)
+
+
+def host_similar(store, f) -> np.ndarray:
+    """`eval_func`'s similar_to branch: the pure-numpy reference route
+    (no device runtime, no route accounting)."""
+    resolved = resolve_query(store, f)
+    if resolved is None:
+        return EMPTY.copy()
+    pred, k, q = resolved
+    t = store.vec_tablet(pred)
+    return host_topk(t.subj, t.vecs, q, k)
+
+
+def resolve_query(store, f):
+    """FuncNode args → (pred, k, query f32[d]) or None when the seed
+    set is structurally empty (no tablet, unknown uid, uid without a
+    vector). Malformed args and dimension mismatches raise — the same
+    refusal on every route."""
+    pred = f.attr
+    if len(f.args) != 2:
+        raise ValueError("similar_to(pred, k, <vector|uid>) takes "
+                         "exactly two arguments after the predicate")
+    k = int(f.args[0])
+    if k <= 0:
+        raise ValueError(f"similar_to k must be positive, got {k}")
+    t = store.vec_tablet(pred)
+    if t is None or not t.rows:
+        return None
+    arg = f.args[1]
+    if isinstance(arg, (list, tuple, np.ndarray, str)):
+        # str: the quoted literal form `"[1, 0, ...]"` from DQL
+        q = parse_vector(arg)
+    elif isinstance(arg, (int, np.integer)):
+        rank = int(store.rank_of(np.array([int(arg)], np.int64))[0])
+        if rank < 0:
+            return None
+        q = t.vector_of(rank)
+        if q is None:
+            return None
+    else:
+        raise ValueError(
+            f"similar_to query must be a vector literal or a uid, "
+            f"got {arg!r}")
+    if len(q) != t.dim:
+        raise ValueError(
+            f"similar_to({pred}): query vector has dim {len(q)}, "
+            f"tablet has dim {t.dim}")
+    return pred, k, np.asarray(q, np.float32)
+
+
+# ---------------------------------------------------------------------------
+# device route: one jitted kernel, launched through the OOM lifecycle
+
+def _device_topk(store, pred: str, q: np.ndarray, k: int,
+                 shape_key) -> np.ndarray:
+    """Single-device top-k over the cached HBM stack. Raises
+    memgov.OomDegraded for the caller's host fallback."""
+    from dgraph_tpu.utils.jitcache import jit_call
+
+    subj_d, vecs_d = store.vec_device(pred)
+    n, d = int(vecs_d.shape[0]), int(vecs_d.shape[1])
+    key = ("vec.topk", n, d, min(k, n))
+
+    def _launch():
+        memgov.check_alloc_fault("vec.topk")
+        with jit_call("vec.topk", key):
+            out = _topk_kernel(subj_d, vecs_d,
+                               np.asarray(q, np.float32), min(k, n))
+        return np.asarray(out, np.int32)
+
+    return memgov.oom_retry("vec.topk", shape_key, _launch)
+
+
+@functools.lru_cache(maxsize=1)
+def _topk_jit():
+    import jax
+
+    def topk(subj, vecs, q, k):
+        import jax.numpy as jnp
+        scores = vecs @ q
+        order = jnp.lexsort((subj, -scores))
+        return jnp.sort(subj[order[:k]])
+
+    return jax.jit(topk, static_argnames=("k",))
+
+
+def _topk_kernel(subj, vecs, q, k: int):
+    return _topk_jit()(subj, vecs, q, k)
+
+
+# ---------------------------------------------------------------------------
+# mesh route: row-sharded scan + local top-k + all_gather merge
+
+def _mesh_topk(store, pred: str, q: np.ndarray, k: int,
+               mesh, shape_key) -> np.ndarray:
+    from dgraph_tpu.ops.uidalgebra import SENTINEL32
+
+    subj_s, vecs_s, rows = store.vec_sharded(pred, mesh)
+    # local cap: a shard contributes at most min(k, rows) candidates
+    # (the global top-k is a subset of the per-shard top-k unions);
+    # the merge then takes up to k across ALL shards' candidates
+    kk = min(k, rows)
+    k_out = min(k, kk * int(subj_s.shape[0]))
+
+    def _launch():
+        memgov.check_alloc_fault("vec.topk")
+        gr = _build_mesh_topk(mesh, rows, int(vecs_s.shape[-1]), kk,
+                              k_out)(
+            subj_s, vecs_s, np.asarray(q, np.float32))
+        return np.asarray(gr, np.int32)
+
+    gr = memgov.oom_retry("vec.topk", shape_key, _launch)
+    out = gr[gr != SENTINEL32]
+    return np.sort(out[:k]).astype(np.int32)
+
+
+@functools.lru_cache(maxsize=32)
+def _build_mesh_topk(mesh, rows: int, d: int, k: int, k_out: int):
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from dgraph_tpu.ops.uidalgebra import valid_mask
+    from dgraph_tpu.parallel.mesh import SHARD_AXIS
+    from dgraph_tpu.utils.jaxcompat import shard_map
+
+    def per_device(subj_b, vecs_b, q):
+        subj, vecs = subj_b[0], vecs_b[0]      # [rows], [rows, d]
+        scores = vecs @ q                       # per-row dot products
+        # padded rows (sentinel subj) must lose to every real row:
+        # +inf key sorts last in the -score-ascending order
+        key = jnp.where(valid_mask(subj), -scores, jnp.inf)
+        order = jnp.lexsort((subj, key))        # (score desc, rank asc)
+        top_r = subj[order[:k]]
+        top_v = key[order[:k]]
+        gr = lax.all_gather(top_r, SHARD_AXIS).reshape(-1)
+        gv = lax.all_gather(top_v, SHARD_AXIS).reshape(-1)
+        o2 = jnp.lexsort((gr, gv))              # k-way merge, one sort
+        return gr[o2[:k_out]]
+
+    fn = shard_map(per_device, mesh=mesh,
+                   in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P()),
+                   out_specs=P(), check_vma=False)
+    return jax.jit(fn)
+
+
+# ---------------------------------------------------------------------------
+# the routed entry point (Executor._leaf_set dispatches here)
+
+def _promoted(route: str, baseline: str) -> bool:
+    """Cost-prior promotion below the static threshold: take `route`
+    when its measured µs-per-1k-rows EMA beats `baseline` (the
+    Executor._mesh_promoted discipline, knn lanes)."""
+    from dgraph_tpu.utils import costprior
+    if not costprior.enabled():
+        return False
+    r = costprior.PRIORS.route_cost(route)
+    b = costprior.PRIORS.route_cost(baseline)
+    return r is not None and b is not None and r < b
+
+
+def similar_ranks(store, f, mesh=None,
+                  device_threshold: int = 512) -> np.ndarray:
+    """similar_to with route selection + accounting: mesh when one is
+    configured and the tablet clears the threshold (or the knn route
+    EMAs promote it), device on a big single-device tablet, host
+    otherwise — and host ALWAYS on OOM degradation, bit-identically."""
+    resolved = resolve_query(store, f)
+    if resolved is None:
+        return EMPTY.copy()
+    pred, k, q = resolved
+    t = store.vec_tablet(pred)
+    n = t.rows
+    shape_key = (pred, t.dim, k)
+    t0 = time.perf_counter()
+    route = "host"
+    try:
+        if mesh is not None and (n >= device_threshold
+                                 or _promoted("knn_mesh", "knn_host")):
+            route = "mesh"
+            out = _mesh_topk(store, pred, q, k, mesh, shape_key)
+        elif n >= device_threshold or _promoted("knn_device",
+                                                "knn_host"):
+            route = "device"
+            out = _device_topk(store, pred, q, k, shape_key)
+        else:
+            out = host_topk(t.subj, t.vecs, q, k)
+    except memgov.OomDegraded:
+        # allocation failure survived its evict-retry (or the shape is
+        # sticky-degraded): the host scan produces the identical set
+        route = "host"
+        out = host_topk(t.subj, t.vecs, q, k)
+    METRICS.inc("knn_route_total", route=route)
+    if n:
+        from dgraph_tpu.utils import costprior
+        costprior.PRIORS.learn_route(
+            "knn_" + route,
+            (time.perf_counter() - t0) * 1e6 / n * 1000.0)
+    return out

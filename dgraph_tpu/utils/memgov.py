@@ -69,6 +69,9 @@ GOVERNED_CACHES: dict[str, str] = {
     "timeseries.ring": "retained metrics history: the sampler daemon's "
                        "bounded ring of windowed points (PR 17) — under "
                        "pressure the oldest history is surrendered first",
+    "store.vec": "float32vector embedding stacks placed by "
+                 "Store.vec_device / vec_sharded — the k-NN seed "
+                 "tablets (PR 18); evicted stacks re-place on next use",
 }
 
 # watermark fractions of the configured budget: eviction starts above

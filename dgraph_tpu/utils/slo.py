@@ -56,6 +56,10 @@ SLO_SPECS: dict[str, str] = {
     "shed_rate": "fraction of admission arrivals shed "
                  "(shed_total over admission_requests_total) — load "
                  "shedding is budgeted, not free",
+    "graphrag_read_p99": "p99 latency objective for GraphRAG retrieval "
+                         "blocks — similar_to-seeded queries, any route "
+                         "(µs target over the graphrag_latency_us "
+                         "histogram; 1% may exceed it)",
 }
 
 # default objectives (overridable per-name via --slo_spec superflag):
@@ -65,6 +69,7 @@ DEFAULT_TARGETS: dict[str, float] = {
     "mutate_latency_p99_us": 250_000.0,
     "error_rate": 0.01,
     "shed_rate": 0.05,
+    "graphrag_read_p99": 150_000.0,
 }
 
 # a pN latency SLO tolerates (100-N)% of requests over target — the
@@ -141,11 +146,16 @@ def _eval_shed_rate(view, target: float):
             view.delta("admission_requests_total"))
 
 
+@_evaluator("graphrag_read_p99")
+def _eval_graphrag_latency(view, target: float):
+    return view.frac_above("graphrag_latency_us", target)
+
+
 def _budget_fraction(name: str, target: float) -> float:
     """The allowed bad fraction a burn of 1.0 consumes exactly: for
     latency SLOs the pN tail budget; for rate SLOs the target IS the
     budget."""
-    if name.endswith("_us"):
+    if name.endswith("_us") or name.endswith("_p99"):
         return _LATENCY_BUDGET
     return max(target, 1e-9)
 

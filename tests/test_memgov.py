@@ -161,9 +161,12 @@ def test_is_alloc_failure_classification():
 def _friend_store(n=256):
     rng = np.random.default_rng(7)
     b = StoreBuilder(parse_schema(
-        "name: string @index(exact) .\nfriend: [uid] @reverse ."))
+        "name: string @index(exact) .\nfriend: [uid] @reverse .\n"
+        "emb: float32vector @dim(4) ."))
     for i in range(1, n + 1):
         b.add_value(i, "name", f"p{i}")
+        b.add_value(i, "emb",
+                    [int(x) for x in rng.integers(0, 5, 4)])
         for j in rng.integers(1, n + 1, 4):
             b.add_edge(i, "friend", int(j))
     return b.finalize()
@@ -175,27 +178,37 @@ def test_degraded_route_is_bit_identical_to_device_route():
     degradation is a latency event, never a correctness event."""
     store = _friend_store()
     q = '{ q(func: uid(1)) { friend { friend { friend { uid } } } } }'
+    # the GraphRAG seed path rides the same contract: the k-NN top-k
+    # launch (site vec.topk) degrades to the host scan, identically
+    qv = ('{ q(func: similar_to(emb, 5, "[1, 0, 2, 1]")) '
+          '{ uid friend { uid } } }')
     dev = Engine(store, device_threshold=1)   # frontier ≥ 1 → device
     want = dev.query(q)
+    want_v = dev.query(qv)
     assert any(p in ("device", "fused") for p in _routes()), \
         "baseline must actually take a device-backed route"
 
-    # every device-backed launch (fused program, device hop, mesh hop)
-    # allocation-fails → evict-retry → sticky degrade → the staged /
-    # host walk serves
+    # every device-backed launch (fused program, device hop, mesh hop,
+    # k-NN top-k) allocation-fails → evict-retry → sticky degrade →
+    # the staged / host walk serves
     memgov.set_alloc_fault(lambda site: site.startswith(("fused.",
                                                          "hop.",
-                                                         "mesh.")))
+                                                         "mesh.",
+                                                         "vec.")))
     degraded = Engine(store, device_threshold=1)
     got = degraded.query(q)
     assert json.dumps(got, sort_keys=True) == \
         json.dumps(want, sort_keys=True)
+    assert json.dumps(degraded.query(qv), sort_keys=True) == \
+        json.dumps(want_v, sort_keys=True)
     assert GOVERNOR.oom_stats()["degraded"] >= 1
     # sticky: the SECOND query never re-attempts the device launch, so
     # it serves even with the hook gone
     memgov.set_alloc_fault(None)
     assert json.dumps(degraded.query(q), sort_keys=True) == \
         json.dumps(want, sort_keys=True)
+    assert json.dumps(degraded.query(qv), sort_keys=True) == \
+        json.dumps(want_v, sort_keys=True)
 
 
 def _routes():

@@ -1,0 +1,385 @@
+"""GraphRAG retrieval subsystem (ISSUE 18): float32vector tablets +
+`similar_to` k-NN seed selection.
+
+The contract under test: every route — host numpy (the reference),
+single-device jit, mesh shard_map, the fused knn stage, and the
+OOM-degraded fallback — returns the same SORTED seed rank set, bit for
+bit. Fixtures use small-integer-valued f32 components so the scored
+matmul is exactly representable and the identity claims are exact,
+not approximate.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import types
+
+import numpy as np
+import pytest
+
+from dgraph_tpu.engine import Engine, fused
+from dgraph_tpu.server.api import Alpha
+from dgraph_tpu.store import checkpoint, vec
+from dgraph_tpu.store.schema import parse_schema
+from dgraph_tpu.store.store import StoreBuilder
+from dgraph_tpu.utils import costprior, costprofile, memgov
+from dgraph_tpu.utils.metrics import METRICS
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DIM = 4
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    monkeypatch.setenv("DGRAPH_TPU_FUSED", "1")
+    fused.reset()
+    costprior.reset()
+    costprofile.reset()
+    memgov.set_alloc_fault(None)
+    memgov.GOVERNOR.reset()
+    yield
+    fused.reset()
+    costprior.reset()
+    costprofile.reset()
+    memgov.set_alloc_fault(None)
+    memgov.GOVERNOR.reset()
+
+
+def _vec_store(n=24, seed=3):
+    rng = np.random.default_rng(seed)
+    b = StoreBuilder(parse_schema(
+        "emb: float32vector @dim(%d) .\n"
+        "friend: [uid] @reverse .\n"
+        "name: string @index(exact) ." % DIM))
+    for i in range(1, n + 1):
+        b.add_value(i, "emb",
+                    [int(x) for x in rng.integers(0, 5, DIM)])
+        b.add_value(i, "name", f"p{i % 7}")
+        for j in rng.integers(1, n + 1, 3):
+            if i != int(j):
+                b.add_edge(i, "friend", int(j))
+    return b.finalize()
+
+
+def _func(k, arg, attr="emb"):
+    return types.SimpleNamespace(name="similar_to", attr=attr,
+                                 args=[k, arg])
+
+
+# ---------------------------------------------------------------------------
+# host reference semantics
+
+def test_host_topk_matches_independent_numpy_oracle():
+    """The total order: score descending, rank ascending on ties —
+    pinned against a python sort, not another lexsort."""
+    rng = np.random.default_rng(11)
+    subj = np.arange(40, dtype=np.int32)
+    vecs = rng.integers(0, 4, (40, DIM)).astype(np.float32)
+    q = np.array([2, 1, 0, 3], np.float32)
+    scores = vecs @ q
+    for k in (1, 5, 17, 40):
+        want = sorted(r for _, r in
+                      sorted(zip(-scores, subj.tolist()))[:k])
+        got = vec.host_topk(subj, vecs, q, k)
+        assert got.tolist() == want
+        assert got.dtype == np.int32
+
+
+def test_host_topk_tie_break_is_lowest_rank():
+    # every row scores identically: the tie-break alone decides
+    subj = np.array([3, 7, 9, 12, 20], np.int32)
+    vecs = np.ones((5, 2), np.float32)
+    got = vec.host_topk(subj, vecs, np.array([1, 1], np.float32), 3)
+    assert got.tolist() == [3, 7, 9]
+
+
+def test_host_topk_edge_cases():
+    subj = np.array([1, 2], np.int32)
+    vecs = np.array([[1, 0], [0, 1]], np.float32)
+    q = np.array([1, 0], np.float32)
+    # k > n clamps to n; k <= 0 and the empty tablet serve EMPTY
+    assert vec.host_topk(subj, vecs, q, 99).tolist() == [1, 2]
+    assert vec.host_topk(subj, vecs, q, 0).tolist() == []
+    assert vec.host_topk(np.zeros(0, np.int32),
+                         np.zeros((0, 2), np.float32), q, 3).tolist() \
+        == []
+
+
+# ---------------------------------------------------------------------------
+# schema/load-time refusals
+
+def test_vector_dim_mismatch_refused_at_load_time():
+    b = StoreBuilder(parse_schema("emb: float32vector @dim(4) ."))
+    b.add_value(1, "emb", [1, 2, 3, 4])
+    with pytest.raises(ValueError, match="does not match schema dim"):
+        b.add_value(2, "emb", [1, 2, 3])
+
+
+def test_first_vector_fixes_width_without_dim_directive():
+    b = StoreBuilder(parse_schema("emb: float32vector ."))
+    b.add_value(1, "emb", [1, 2])
+    with pytest.raises(ValueError, match="does not match schema dim"):
+        b.add_value(2, "emb", [1, 2, 3])
+
+
+def test_vector_list_form_refused_in_schema():
+    with pytest.raises(ValueError):
+        parse_schema("emb: [float32vector] .")
+
+
+def test_query_time_refusals():
+    st = _vec_store()
+    eng = Engine(st, device_threshold=10**9)
+    with pytest.raises(ValueError, match="must be positive"):
+        eng.query('{ q(func: similar_to(emb, 0, "[1, 1, 1, 1]")) '
+                  '{ uid } }')
+    with pytest.raises(ValueError, match="dim"):
+        eng.query('{ q(func: similar_to(emb, 3, "[1, 1]")) { uid } }')
+
+
+def test_empty_predicate_and_unknown_uid_serve_empty():
+    st = _vec_store()
+    eng = Engine(st, device_threshold=10**9)
+    # no tablet under this predicate name → empty seed set
+    b = StoreBuilder(parse_schema("emb: float32vector @dim(2) .\n"
+                                  "name: string ."))
+    b.add_value(1, "name", "x")
+    empty_eng = Engine(b.finalize(), device_threshold=10**9)
+    assert empty_eng.query(
+        '{ q(func: similar_to(emb, 3, "[1, 0]")) { uid } }') == {"q": []}
+    # unknown uid, and a uid that exists but carries no vector
+    assert eng.query(
+        '{ q(func: similar_to(emb, 3, 0x7fff)) { uid } }') == {"q": []}
+
+
+# ---------------------------------------------------------------------------
+# route identity: host ≡ device ≡ uid-form
+
+def test_device_route_bit_identical_to_host():
+    st = _vec_store(n=48)
+    t = st.vec_tablet("emb")
+    q = np.array([1, 3, 0, 2], np.float32)
+    want = vec.host_topk(t.subj, t.vecs, q, 7)
+    got = vec.similar_ranks(st, _func(7, q.tolist()),
+                            device_threshold=0)
+    assert got.tolist() == want.tolist()
+    assert METRICS.get("knn_route_total", route="device") >= 1
+
+
+def test_uid_form_uses_stored_vector_as_query():
+    st = _vec_store()
+    t = st.vec_tablet("emb")
+    rank = int(st.rank_of(np.array([5], np.int64))[0])
+    qv = t.vector_of(rank)
+    by_uid = vec.similar_ranks(st, _func(4, 5), device_threshold=10**9)
+    by_vec = vec.host_topk(t.subj, t.vecs, qv, 4)
+    assert by_uid.tolist() == by_vec.tolist()
+    assert rank in by_uid  # a node is its own nearest neighbour
+
+
+# ---------------------------------------------------------------------------
+# fused knn stage: one launch, bit-identical to staged and host
+
+def test_fused_knn_recurse_matches_staged_and_host():
+    """The flagship composite — knn seeds → @recurse expansion →
+    rendering — fused into ONE XLA program, byte-identical to the
+    staged device chain and the host walk."""
+    st = _vec_store(n=64, seed=9)
+    host = Engine(st, device_threshold=10**9)
+    a = Alpha(base=st, device_threshold=0)
+    q = ('{ q(func: similar_to(emb, 5, "[2, 0, 1, 3]")) '
+         '@recurse(depth: 3) { uid friend } }')
+    os.environ["DGRAPH_TPU_FUSED"] = "0"
+    try:
+        want_host = host.query(q)
+        staged = a.query(q)
+        rec_staged = costprofile.recent(1)[0]
+    finally:
+        os.environ["DGRAPH_TPU_FUSED"] = "1"
+    assert staged == want_host
+    a.query(q)           # first fused run may grow caps
+    assert a.query(q) == staged
+    rec_fused = costprofile.recent(1)[0]
+    # launch collapse: the staged chain launches per stage (knn top-k
+    # plus per-depth hops); the fused program is ONE dispatch
+    assert rec_staged["kernel_launches"] >= 2
+    assert rec_fused["kernel_launches"] == 1
+    assert "fused" in rec_fused["shape"]
+    assert METRICS.get("fused_route_total", route="fused") >= 1
+
+
+def test_fused_knn_plain_and_filtered_children_match_host():
+    st = _vec_store(n=64, seed=9)
+    host = Engine(st, device_threshold=10**9)
+    dev = Engine(st, device_threshold=0)
+    for q in [
+        '{ q(func: similar_to(emb, 4, "[1, 1, 2, 0]")) '
+        '{ uid name friend { uid } } }',
+        '{ q(func: similar_to(emb, 6, "[0, 2, 1, 1]")) '
+        '{ friend @filter(eq(name, "p3")) { name } } }',
+        '{ q(func: similar_to(emb, 3, 7)) '
+        '{ c as count(friend) } m() { max(val(c)) } }',
+    ]:
+        assert dev.query(q) == host.query(q), q
+    assert not [s for s, e in fused.status()["shapes"].items()
+                if e.get("disabled")]
+
+
+# ---------------------------------------------------------------------------
+# mesh route: 4 virtual devices, own subprocess
+
+_CHILD = textwrap.dedent("""\
+    import os
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ["DGRAPH_TPU_FUSED"] = "0"  # exercise the mesh knn route
+
+    import numpy as np
+    import jax
+    assert len(jax.devices()) == 4, jax.devices()
+
+    from dgraph_tpu.engine import Engine
+    from dgraph_tpu.parallel.mesh import make_mesh, reshard_count
+    from dgraph_tpu.store.schema import parse_schema
+    from dgraph_tpu.store.store import StoreBuilder
+    from dgraph_tpu.utils.metrics import METRICS
+
+    rng = np.random.default_rng(3)
+    b = StoreBuilder(parse_schema(
+        "emb: float32vector @dim(4) .\\nfriend: [uid] @reverse ."))
+    for i in range(1, 51):
+        b.add_value(i, "emb", [int(x) for x in rng.integers(0, 5, 4)])
+        for j in rng.integers(1, 51, 3):
+            if i != int(j):
+                b.add_edge(i, "friend", int(j))
+    st = b.finalize()
+
+    host = Engine(st, device_threshold=10**9)
+    mesh = Engine(st, device_threshold=0, mesh=make_mesh(4))
+    for q in [
+        '{ q(func: similar_to(emb, 6, "[1, 0, 2, 1]")) '
+        '{ uid friend { uid } } }',
+        '{ q(func: similar_to(emb, 3, 9)) '
+        '@recurse(depth: 3) { uid friend } }',
+        '{ q(func: similar_to(emb, 50, "[2, 2, 0, 1]")) { uid } }',
+    ]:
+        a, b_ = host.query(q), mesh.query(q)
+        assert a == b_, (q, a, b_)
+    assert METRICS.get("knn_route_total", route="mesh") >= 3
+    assert reshard_count() == 0, reshard_count()
+    print("PASS 4dev knn bit-identity reshard-free", flush=True)
+""")
+
+
+def test_mesh_knn_bit_identical_on_4_virtual_devices(tmp_path):
+    script = tmp_path / "vec_mesh_child.py"
+    script.write_text(_CHILD)
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = str(ROOT)
+    proc = subprocess.run([sys.executable, str(script)],
+                          capture_output=True, text=True,
+                          cwd=str(ROOT), env=env, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "PASS 4dev knn bit-identity reshard-free" in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# persistence: vec tablets round-trip the crc-verified manifest
+
+def test_checkpoint_roundtrip_preserves_vec_tablets(tmp_path):
+    st = _vec_store(n=30, seed=5)
+    checkpoint.save(st, str(tmp_path / "p"))
+    loaded, _ = checkpoint.load(str(tmp_path / "p"))
+    t0, t1 = st.vec_tablet("emb"), loaded.vec_tablet("emb")
+    assert t0.subj.tolist() == t1.subj.tolist()
+    assert t0.vecs.tobytes() == t1.vecs.tobytes()
+    assert loaded.schema.peek("emb").vector_dim == DIM
+    q = ('{ q(func: similar_to(emb, 5, "[1, 2, 0, 2]")) '
+         '{ uid friend { uid } } }')
+    assert Engine(loaded, device_threshold=10**9).query(q) == \
+        Engine(st, device_threshold=10**9).query(q)
+
+
+def test_query_json_renders_vector_values():
+    st = _vec_store(n=6)
+    out = Engine(st, device_threshold=10**9).query(
+        "{ q(func: uid(0x1)) { uid emb } }")
+    v = out["q"][0]["emb"]
+    assert isinstance(v, list) and len(v) == DIM
+    assert all(isinstance(x, float) for x in v)
+
+
+# ---------------------------------------------------------------------------
+# memory governance: eviction re-places, alloc faults degrade to host
+
+def test_evicted_vec_stack_replaces_on_next_use():
+    st = _vec_store(n=48)
+    f = _func(5, [1, 0, 2, 1])
+    want = vec.similar_ranks(st, f, device_threshold=0).tolist()
+    assert st._vec_dev  # the device route placed the stack
+    memgov.GOVERNOR.set_budgets(device_bytes=1)
+    try:
+        memgov.GOVERNOR.evict_to_low("device")
+    finally:
+        memgov.GOVERNOR.set_budgets()
+    assert not st._vec_dev  # governed as store.vec: evictable
+    assert METRICS.get("cache_evictions_total", cache="store.vec") >= 1
+    assert vec.similar_ranks(st, f, device_threshold=0).tolist() == want
+    assert st._vec_dev  # re-placed on next use
+
+
+def test_alloc_fault_evict_retry_is_bit_identical():
+    """The FaultSchedule(alloc=True) event at the k-NN launch site: one
+    injected allocation failure, absorbed by exactly one evict+retry,
+    result bit-identical (the fuzz harness's one-shot hook idiom)."""
+    st = _vec_store(n=48)
+    f = _func(6, [2, 1, 0, 1])
+    want = vec.similar_ranks(st, f, device_threshold=0).tolist()
+    armed = [True]
+
+    def hook(site):
+        if armed[0] and site.startswith("vec."):
+            armed[0] = False
+            return True
+        return False
+
+    memgov.set_alloc_fault(hook)
+    got = vec.similar_ranks(st, f, device_threshold=0)
+    assert got.tolist() == want
+    assert not armed[0], "the injected alloc fault never fired"
+    stats = memgov.GOVERNOR.oom_stats()
+    assert stats["events"] >= 1 and stats["retries"] >= 1
+
+
+def test_persistent_alloc_fault_degrades_to_host_bit_identically():
+    st = _vec_store(n=48)
+    f = _func(6, [2, 1, 0, 1])
+    want = vec.similar_ranks(st, f, device_threshold=0).tolist()
+    memgov.set_alloc_fault(lambda site: site.startswith("vec."))
+    host0 = METRICS.get("knn_route_total", route="host")
+    assert vec.similar_ranks(st, f, device_threshold=0).tolist() == want
+    assert METRICS.get("knn_route_total", route="host") == host0 + 1
+    assert memgov.GOVERNOR.oom_stats()["degraded"] >= 1
+    # sticky: with the hook gone the shape never re-attempts the
+    # device launch — the host route keeps serving, identically
+    memgov.set_alloc_fault(None)
+    assert vec.similar_ranks(st, f, device_threshold=0).tolist() == want
+    assert METRICS.get("knn_route_total", route="host") == host0 + 2
+
+
+def test_fused_knn_under_alloc_fault_serves_host_bit_identically():
+    """End-to-end degradation chain: the fused program's launch AND the
+    staged device top-k both allocation-fail — the query still serves,
+    byte-identical to the pure-host walk."""
+    st = _vec_store(n=64, seed=9)
+    q = ('{ q(func: similar_to(emb, 5, "[2, 0, 1, 3]")) '
+         '@recurse(depth: 2) { uid friend } }')
+    want = Engine(st, device_threshold=10**9).query(q)
+    memgov.set_alloc_fault(
+        lambda site: site.startswith(("fused.", "hop.", "vec.")))
+    degraded = Engine(st, device_threshold=0)
+    assert degraded.query(q) == want
+    assert memgov.GOVERNOR.oom_stats()["degraded"] >= 1
