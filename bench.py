@@ -60,7 +60,8 @@ METRIC = f"edges_traversed_per_sec_{DEPTH}hop_recurse_{B_DEV}q"
 GLOBAL_DEADLINE_S = 780
 STAGE_DEADLINES = {"stage0": 150.0, "stage1": 240.0, "stage2": 330.0,
                    "maintenance": 60.0, "pressure": 60.0,
-                   "sched": 240.0, "mesh": 300.0, "graphrag": 120.0}
+                   "sched": 240.0, "mesh": 300.0, "graphrag": 120.0,
+                   "featprop": 120.0}
 
 # graphrag stage (ISSUE 18): deadline-bound similar_to + @recurse
 # retrieval over a Zipfian hot set under admission, a background
@@ -70,6 +71,13 @@ STAGE_DEADLINES = {"stage0": 150.0, "stage1": 240.0, "stage2": 330.0,
 GRAPHRAG_N = 192
 GRAPHRAG_DIM = 8
 GRAPHRAG_REPS = 15
+
+# featprop stage (ISSUE 19): @msgpass feature traversal — the same
+# fixed-seed Zipfian graph discipline, measuring feature_bytes/s
+# alongside edges/s with a digest pinned across reps
+FEATPROP_N = 160
+FEATPROP_DIM = 8
+FEATPROP_REPS = 12
 
 # whole-query fusion A/B (ISSUE 15): the same fixed-seed small-query
 # template mix served with DGRAPH_TPU_FUSED toggled in a child each —
@@ -447,7 +455,8 @@ def child_main(platform: str, expect_path: str) -> None:
                      ("maintenance", maintenance_stage),
                      ("pressure", pressure_stage),
                      ("sched", sched_stage), ("mesh", mesh_stage),
-                     ("graphrag", graphrag_stage)):
+                     ("graphrag", graphrag_stage),
+                     ("featprop", featprop_stage)):
         _run_stage(flightrec, name, fn)
     os._exit(0)
 
@@ -1115,6 +1124,112 @@ def graphrag_stage() -> dict:
     }
 
 
+def _featprop_fixture():
+    """Fixed-seed feature-traversal store: every node carries an `emb`
+    vector (small integer components — sums exactly representable, so
+    host/device/mesh aggregate bit-identically) plus Zipfian `friend`
+    edges. Returns (alpha, query mix) where the mix covers all three
+    aggregators composed with @recurse and with similar_to seeds."""
+    from dgraph_tpu.server.api import Alpha
+
+    a = Alpha(device_threshold=0)  # device kernels at every level —
+    # the hop chain the fused featprop stage collapses is the claim
+    a.alter("emb: float32vector @dim(%d) .\n"
+            "friend: [uid] @reverse .\n"
+            "name: string @index(exact) ." % FEATPROP_DIM)
+    rng = np.random.default_rng(31)
+    lines = []
+    for i in range(1, FEATPROP_N + 1):
+        v = rng.integers(0, 7, FEATPROP_DIM)
+        lines.append('<%d> <emb> "[%s]" .'
+                     % (i, ", ".join(str(int(x)) for x in v)))
+        lines.append(f'<{i}> <name> "p{i % 13}" .')
+        for j in rng.zipf(1.4, 5):  # Zipf targets: low uids are hubs
+            t = int(min(j, FEATPROP_N))
+            if t != i:
+                lines.append(f"<{i}> <friend> <{t}> .")
+    a.mutate(set_nquads="\n".join(lines))
+    qs = []
+    for agg in ("sum", "mean", "max"):  # vector-literal seeds, each agg
+        for _ in range(3):
+            v = rng.integers(0, 7, FEATPROP_DIM)
+            lit = "[%s]" % ", ".join(str(int(x)) for x in v)
+            k = int(rng.integers(3, 9))
+            qs.append('{ q(func: similar_to(emb, %d, "%s")) '
+                      '@recurse(depth: 2) @msgpass(pred: emb, agg: %s) '
+                      '{ uid friend } }' % (k, lit, agg))
+    for _ in range(4):  # uid seeds over the Zipfian hot set, deeper
+        u = int(min(rng.zipf(1.5), FEATPROP_N))
+        agg = ("sum", "mean", "max")[u % 3]
+        qs.append('{ q(func: uid(%d)) @recurse(depth: 3) '
+                  '@msgpass(pred: emb, agg: %s) { uid friend } }'
+                  % (u, agg))
+    return a, qs
+
+
+def featprop_stage() -> dict:
+    """Feature-bearing traversal (ISSUE 19): the fixed-seed @msgpass
+    mix over similar_to/uid seeds — a digest pass pins bit-identity
+    across reps, launches/query shows the fused featprop collapse, and
+    the throughput pair the compare gate watches is feature_bytes/s
+    (aggregated neighbour-feature traffic) alongside edges/s."""
+    import hashlib
+
+    from dgraph_tpu.utils import costprofile
+    from dgraph_tpu.utils.metrics import METRICS
+
+    t0 = time.perf_counter()
+    a, qs = _featprop_fixture()
+    for q in qs:  # warm: parse caches + fused compiles stay out
+        a.query(q)
+        a.query(q)
+    costprofile.reset()
+    bytes0 = METRICS.get("feat_bytes_total")
+    edge_paths = ("numpy", "device", "mesh", "remote", "empty", "fused")
+    edges0 = sum(METRICS.get("edges_traversed_total", path=p)
+                 for p in edge_paths)
+    digest = hashlib.sha256()
+    rep_digests, lats = [], []
+    tm0 = time.perf_counter()
+    for _ in range(FEATPROP_REPS):
+        rep = hashlib.sha256()
+        for q in qs:
+            t = time.perf_counter()
+            raw = a.query_raw(q)
+            lats.append((time.perf_counter() - t) * 1e6)
+            digest.update(raw)
+            rep.update(raw)
+        rep_digests.append(rep.hexdigest())
+    elapsed = time.perf_counter() - tm0
+    lats.sort()
+    feat_bytes = METRICS.get("feat_bytes_total") - bytes0
+    edges = sum(METRICS.get("edges_traversed_total", path=p)
+                for p in edge_paths) - edges0
+    launches = w_n = 0.0
+    for st in costprofile.summary(top_n=64)["shapes"].values():
+        launches += st.get("features", {}).get(
+            "kernel_launches", 0) * st["count"]
+        w_n += st["count"]
+    n = len(lats)
+    return {
+        "stage": "featprop", "secs": round(time.perf_counter() - t0, 2),
+        "queries": n, "nodes": FEATPROP_N, "dim": FEATPROP_DIM,
+        "serve_p50_us": round(lats[n // 2]),
+        "serve_p99_us": round(lats[min(n - 1, int(n * 0.99))]),
+        "launches_per_query": round(launches / max(w_n, 1), 2),
+        # the watched throughput pair: aggregated feature traffic and
+        # the raw edge walk it rode on, over the same timed pass
+        "feature_bytes_per_s": round(feat_bytes / max(elapsed, 1e-9)),
+        "edges_per_s": round(edges / max(elapsed, 1e-9)),
+        "digest": digest.hexdigest(),
+        "identical_reps": len(set(rep_digests)) == 1,
+        "routes": {r: METRICS.get("feat_route_total", route=r)
+                   for r in ("host", "device", "mesh", "fused")},
+        "fused_routes": {r: METRICS.get("fused_route_total", route=r)
+                         for r in ("fused", "staged", "fallback")},
+    }
+
+
 def maintenance_stage() -> dict:
     """Pause-impact telemetry (ISSUE 3): serve a query mix against an
     out-of-core store while the background scheduler streams rollups +
@@ -1380,13 +1495,14 @@ def run_child_staged(platform: str, expect_path: str,
     t_start = time.perf_counter()
     try:
         for name in ("stage0", "stage1", "stage2", "maintenance",
-                     "pressure", "sched", "mesh", "graphrag"):
+                     "pressure", "sched", "mesh", "graphrag",
+                     "featprop"):
             remaining = budget_s - (time.perf_counter() - t_start)
             deadline = min(STAGE_DEADLINES[name], max(remaining, 1.0))
             line = _read_line(proc, deadline)
             if line is None:
                 if name in ("maintenance", "pressure", "sched", "mesh",
-                            "graphrag"):
+                            "graphrag", "featprop"):
                     break  # additive telemetry: absence is not an error
                 err = (f"{name} produced no output within {deadline:.0f}s "
                        f"(rc={proc.poll()})")
@@ -1575,6 +1691,17 @@ def main() -> None:
                             "launches_per_query", "digest",
                             "identical_reps", "routes")
                            if k in sg and sg[k] is not None}
+    # feature traversal (ISSUE 19): @msgpass propagation throughput —
+    # feature_bytes/s (higher-better watched key) alongside edges/s,
+    # the fused featprop launches/query, and the fixed-seed digest
+    sf = stages.get("featprop")
+    if sf is not None and "error" not in sf:
+        out["featprop"] = {k: sf[k] for k in
+                           ("serve_p50_us", "serve_p99_us",
+                            "feature_bytes_per_s", "edges_per_s",
+                            "launches_per_query", "digest",
+                            "identical_reps", "routes")
+                           if k in sf and sf[k] is not None}
     # cross-node trace health (ISSUE 14): per-node span counts +
     # propagated-trace fraction off the mesh/sched stages — the
     # chip-window run records fleet trace health for free

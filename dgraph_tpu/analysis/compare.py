@@ -25,7 +25,8 @@ import pathlib
 __all__ = ["flatten", "direction", "compare", "bench_compare_main"]
 
 # leaves where HIGHER is better (throughput / precision)
-_HIGHER = frozenset({"value", "shed_precision", "edges_per_s"})
+_HIGHER = frozenset({"value", "shed_precision", "edges_per_s",
+                     "feature_bytes_per_s"})
 # leaves where LOWER is better, beyond the `*_us` suffix rule
 _LOWER = frozenset({"mean_kernel_launches", "launches_per_query"})
 

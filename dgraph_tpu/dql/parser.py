@@ -24,7 +24,8 @@ from __future__ import annotations
 
 from dgraph_tpu.dql.lexer import Token, tokenize
 from dgraph_tpu.engine.ir import (
-    FilterNode, FuncNode, Order, RecurseArgs, ShortestArgs, SubGraph,
+    FilterNode, FuncNode, MsgPassArgs, Order, RecurseArgs, ShortestArgs,
+    SubGraph,
 )
 from dgraph_tpu.engine.mathexpr import BINOPS, UNOPS, MathTree
 
@@ -389,6 +390,8 @@ class Parser:
                 sg.filters = self.parse_filter()
             elif d == "recurse":
                 sg.recurse = self._parse_recurse_args()
+            elif d == "msgpass":
+                sg.msgpass = self._parse_msgpass_args()
             elif d == "cascade":
                 if self.accept("("):
                     fields = []
@@ -472,6 +475,31 @@ class Parser:
                 else:
                     raise ParseError(f"unknown recurse arg {key!r}")
                 self.accept(",")
+        return args
+
+    def _parse_msgpass_args(self) -> MsgPassArgs:
+        """@msgpass(pred: emb, agg: mean): neighbour-feature
+        aggregation bound at this level (engine/feat.py). `pred` is
+        required; `agg` defaults to mean."""
+        args = MsgPassArgs()
+        if self.accept("("):
+            while not self.accept(")"):
+                key = self.name()
+                self.expect(":")
+                val = str(self._subst(self.next().text))
+                if key == "pred":
+                    args.pred = val
+                elif key == "agg":
+                    if val not in ("sum", "mean", "max"):
+                        raise ParseError(
+                            f"msgpass agg must be sum|mean|max, "
+                            f"got {val!r}")
+                    args.agg = val
+                else:
+                    raise ParseError(f"unknown msgpass arg {key!r}")
+                self.accept(",")
+        if not args.pred:
+            raise ParseError("@msgpass requires a pred: argument")
         return args
 
     # -- fields -------------------------------------------------------------

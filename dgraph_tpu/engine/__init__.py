@@ -26,6 +26,8 @@ def shape_of(blocks) -> str:
         mods = ""
         if sg.recurse is not None:
             mods += f"~r{sg.recurse.depth or 0}"
+        if sg.msgpass is not None:
+            mods += "~m"
         if sg.shortest is not None:
             mods += "~sp"
         if sg.filters is not None:

@@ -55,6 +55,10 @@ class LevelNode:
     recurse_data: object | None = None     # engine.recurse.RecurseData
     path_data: object | None = None        # engine.shortest.PathData
     groups: object | None = None           # engine.groupby.GroupResult
+    # @msgpass binding (engine/feat.py): rank → f32[d] aggregate; None
+    # means the level carries no binding (key "" likewise)
+    feat_vals: dict | None = None
+    feat_key: str = ""
 
 
 def _bucket(n: int, lo: int = 64) -> int:
@@ -626,6 +630,11 @@ class Executor:
         from dgraph_tpu.engine.fused import try_fused
         fused_node = try_fused(self, sg)
         if fused_node is not None:
+            from dgraph_tpu.engine import feat
+            if feat.needs_msgpass(sg):
+                # the fused featprop stage binds recurse levels
+                # in-trace; anything it didn't claim aggregates here
+                feat.annotate_tree(self, fused_node)
             return fused_node
         display = self.root_display(sg)
         nodes = np.unique(display).astype(np.int32)
@@ -637,6 +646,9 @@ class Executor:
             node.groups = process_groupby(self, node)
             return node
         self._descend(node)
+        from dgraph_tpu.engine import feat
+        if feat.needs_msgpass(sg):
+            feat.annotate_tree(self, node)
         return node
 
     def root_display(self, sg: SubGraph) -> np.ndarray:

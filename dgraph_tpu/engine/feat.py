@@ -1,0 +1,351 @@
+"""Feature-bearing traversal: `@msgpass` neighbour aggregation.
+
+PR 18 made embeddings query-native but vectors could only *select*
+seeds (`similar_to`); nothing flowed along the expansion. This module
+is the propagation half — GNN-style message passing as a query
+primitive: `@msgpass(pred: emb, agg: mean)` on a block binds, for each
+node the level expands, the sum/mean/max of its traversal children's
+feature rows (a `store/vec.py` VecTablet). Composed with `@recurse`
+the features re-aggregate each hop — embedding propagation /
+personalized-PageRank-style scoring / the GraphRAG propagated-
+similarity scorer as ONE kernel family (ops/feat.py).
+
+Three routes, one contract — bit-identical `[k, d]` f32 bindings:
+
+* **host** — numpy `add.at`/`maximum.at` over the kept-edge lists.
+  This IS the reference the other routes are pinned against.
+* **device** — `ops.feat.combine_edges` under jax.jit, launched
+  through the memgov OOM lifecycle at site `feat.agg` (alloc failure
+  → evict-retry → sticky degrade to the host route).
+* **mesh** — the row-sharded stacks of `Store.vec_sharded` through the
+  `mesh.hop_input` zero-reshard guard, per-shard partial combine +
+  `psum`/`pmax` merge (each tablet row lives on exactly one shard, so
+  partial sums/maxima merge exactly).
+
+Route selection rides the PR-10 costprior route EMAs
+(`feat_host`/`feat_device`/`feat_mesh`); the fused `featprop` stage
+(engine/fused.py) claims the whole pipeline when the plan is eligible
+and reports itself as route `fused`.
+
+Aggregation is per-EDGE over each level's kept-edge lists (duplicates
+count; exactly the lists the renderer emits), so the staged host loop,
+the routed kernels, and the fused in-trace stage see identical index
+pairs — the digest-equality discipline.
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+
+import numpy as np
+
+from dgraph_tpu.utils import memgov
+from dgraph_tpu.utils.metrics import METRICS
+
+__all__ = ["AGGS", "host_combine", "aggregate", "annotate_tree",
+           "needs_msgpass", "feat_key"]
+
+AGGS = ("sum", "mean", "max")
+
+EMPTY = np.zeros(0, np.int32)
+
+
+def feat_key(args) -> str:
+    """JSON key of the bound value — the count-leaf naming discipline:
+    `mean(emb)` next to `count(friend)`."""
+    return f"{args.agg}({args.pred})"
+
+
+def _bucket(n: int, lo: int = 64) -> int:
+    b = lo
+    # graftlint: allow(hot-loop-checkpoint): O(log n) shift arithmetic
+    while b < n:
+        b <<= 1
+    return b
+
+
+# ---------------------------------------------------------------------------
+# host route: the bit-identity reference
+
+def host_combine(subj: np.ndarray, vecs: np.ndarray, nbrs: np.ndarray,
+                 seg: np.ndarray, n_seg: int, agg: str):
+    """Numpy reference combine. Same contract as
+    `ops.feat.segment_combine`: returns (out[n_seg, d] f32,
+    cnt[n_seg] i32, ecnt[n_seg] i32)."""
+    nbrs = np.asarray(nbrs, np.int32)
+    seg = np.asarray(seg, np.int64)
+    rows, d = int(subj.shape[0]), int(vecs.shape[1])
+    if rows:
+        idx = np.minimum(np.searchsorted(subj, nbrs), rows - 1)
+        has = subj[idx] == nbrs
+    else:
+        idx = np.zeros(len(nbrs), np.int64)
+        has = np.zeros(len(nbrs), bool)
+    cnt = np.bincount(seg[has], minlength=n_seg).astype(np.int32)
+    ecnt = np.bincount(seg, minlength=n_seg).astype(np.int32)
+    if agg == "max":
+        out = np.full((n_seg, d), -np.inf, np.float32)
+        np.maximum.at(out, seg[has], vecs[idx[has]])
+        out = np.where((cnt > 0)[:, None], out, np.float32(0))
+    else:
+        out = np.zeros((n_seg, d), np.float32)
+        np.add.at(out, seg[has], vecs[idx[has]])
+        if agg == "mean":
+            out = np.where(
+                (cnt > 0)[:, None],
+                out / np.maximum(cnt, 1)[:, None].astype(np.float32),
+                np.float32(0))
+    return out.astype(np.float32, copy=False), cnt, ecnt
+
+
+# ---------------------------------------------------------------------------
+# device route: one jitted kernel through the OOM lifecycle
+
+def _device_combine(store, pred: str, nbrs, seg, n_seg: int, agg: str,
+                    shape_key):
+    from dgraph_tpu.ops import feat as ops_feat
+    from dgraph_tpu.ops.uidalgebra import SENTINEL32
+    from dgraph_tpu.utils.jitcache import jit_call
+
+    subj_d, vecs_d = store.vec_device(pred)
+    rows, d = int(vecs_d.shape[0]), int(vecs_d.shape[1])
+    e_cap = _bucket(max(len(nbrs), 1))
+    n_cap = _bucket(max(n_seg, 1))
+    nb = np.full(e_cap, SENTINEL32, np.int32)
+    nb[:len(nbrs)] = nbrs
+    sg = np.zeros(e_cap, np.int32)
+    sg[:len(seg)] = seg
+    key = ops_feat.combine_key(rows, d, e_cap, n_cap, agg)
+
+    def _launch():
+        memgov.check_alloc_fault("feat.agg")
+        with jit_call("feat.agg", key):
+            out, cnt, ecnt = ops_feat.combine_edges(
+                subj_d, vecs_d, nb, sg, np.int32(len(nbrs)), n_cap, agg)
+        return (np.asarray(out, np.float32)[:n_seg],
+                np.asarray(cnt, np.int32)[:n_seg],
+                np.asarray(ecnt, np.int32)[:n_seg])
+
+    return memgov.oom_retry("feat.agg", shape_key, _launch)
+
+
+# ---------------------------------------------------------------------------
+# mesh route: per-shard partial combine + psum/pmax merge
+
+def _mesh_combine(store, pred: str, nbrs, seg, n_seg: int, agg: str,
+                  mesh, shape_key):
+    from jax.sharding import PartitionSpec as P
+
+    from dgraph_tpu.ops.uidalgebra import SENTINEL32
+    from dgraph_tpu.parallel.mesh import SHARD_AXIS, hop_input
+
+    subj_s, vecs_s, rows = store.vec_sharded(pred, mesh)
+    d = int(vecs_s.shape[-1])
+    e_cap = _bucket(max(len(nbrs), 1))
+    n_cap = _bucket(max(n_seg, 1))
+    nb = np.full(e_cap, SENTINEL32, np.int32)
+    nb[:len(nbrs)] = nbrs
+    sg = np.zeros(e_cap, np.int32)
+    sg[:len(seg)] = seg
+    fn = _build_mesh_combine(mesh, rows, d, e_cap, n_cap, agg)
+
+    def _launch():
+        memgov.check_alloc_fault("feat.agg")
+        out, cnt, ecnt = fn(
+            hop_input(subj_s, mesh, P(SHARD_AXIS)),
+            hop_input(vecs_s, mesh, P(SHARD_AXIS)),
+            nb, sg, np.int32(len(nbrs)))
+        return (np.asarray(out, np.float32)[:n_seg],
+                np.asarray(cnt, np.int32)[:n_seg],
+                np.asarray(ecnt, np.int32)[:n_seg])
+
+    return memgov.oom_retry("feat.agg", shape_key, _launch)
+
+
+@functools.lru_cache(maxsize=32)
+def _build_mesh_combine(mesh, rows: int, d: int, e_cap: int, n_cap: int,
+                        agg: str):
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from dgraph_tpu.ops.feat import segment_combine
+    from dgraph_tpu.parallel.mesh import SHARD_AXIS
+    from dgraph_tpu.utils.jaxcompat import shard_map
+
+    def per_device(subj_b, vecs_b, nbrs, seg, n_edges):
+        subj, vecs = subj_b[0], vecs_b[0]   # [rows], [rows, d]
+        valid = jnp.arange(e_cap, dtype=jnp.int32) < n_edges
+        # raw partials (mask_empty=False): each tablet row lives on
+        # exactly one shard, so psum of partial sums / pmax of partial
+        # maxima is the exact single-device result; the one global
+        # mask/division happens after the merge
+        out, cnt, ecnt = segment_combine(subj, vecs, nbrs, seg, valid,
+                                         n_cap, agg, mask_empty=False)
+        cnt = lax.psum(cnt, SHARD_AXIS)
+        if agg == "max":
+            out = lax.pmax(out, SHARD_AXIS)
+            out = jnp.where((cnt > 0)[:, None], out, jnp.float32(0))
+        else:
+            out = lax.psum(out, SHARD_AXIS)
+            if agg == "mean":
+                out = jnp.where(
+                    (cnt > 0)[:, None],
+                    out / jnp.maximum(cnt, 1)[:, None].astype(
+                        jnp.float32),
+                    jnp.float32(0))
+        # seg/valid are replicated, so the structural count already is
+        return out, cnt, ecnt
+
+    fn = shard_map(per_device, mesh=mesh,
+                   in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(), P(),
+                             P()),
+                   out_specs=(P(), P(), P()), check_vma=False)
+    return jax.jit(fn)
+
+
+# ---------------------------------------------------------------------------
+# the routed entry point
+
+def _promoted(route: str, baseline: str) -> bool:
+    """Cost-prior promotion below the static threshold (the
+    store/vec.py knn-lane discipline, feat lanes)."""
+    from dgraph_tpu.utils import costprior
+    if not costprior.enabled():
+        return False
+    r = costprior.PRIORS.route_cost(route)
+    b = costprior.PRIORS.route_cost(baseline)
+    return r is not None and b is not None and r < b
+
+
+def aggregate(store, pred: str, agg: str, nbrs, seg, n_seg: int,
+              mesh=None, device_threshold: int = 512):
+    """Combine one level's kept-edge feature rows with route selection
+    + accounting: mesh when one is configured and the work clears the
+    threshold (or the feat route EMAs promote it), device likewise on
+    a single device, host otherwise — and host ALWAYS on OOM
+    degradation, bit-identically. Returns (out[n_seg, d] f32,
+    cnt[n_seg] i32, ecnt[n_seg] i32)."""
+    t = store.vec_tablet(pred)
+    if t is None:
+        raise ValueError(
+            f"@msgpass(pred: {pred}): not a float32vector predicate")
+    work = len(nbrs)
+    big = work >= device_threshold or t.rows >= device_threshold
+    shape_key = (pred, t.dim, agg)
+    t0 = time.perf_counter()
+    route = "host"
+    try:
+        if mesh is not None and t.rows and (
+                big or _promoted("feat_mesh", "feat_host")):
+            route = "mesh"
+            out = _mesh_combine(store, pred, nbrs, seg, n_seg, agg,
+                                mesh, shape_key)
+        elif t.rows and (big or _promoted("feat_device", "feat_host")):
+            route = "device"
+            out = _device_combine(store, pred, nbrs, seg, n_seg, agg,
+                                  shape_key)
+        else:
+            out = host_combine(t.subj, t.vecs, nbrs, seg, n_seg, agg)
+    except memgov.OomDegraded:
+        # allocation failure survived its evict-retry (or the shape is
+        # sticky-degraded): the host combine is the identical binding
+        route = "host"
+        out = host_combine(t.subj, t.vecs, nbrs, seg, n_seg, agg)
+    us = (time.perf_counter() - t0) * 1e6
+    METRICS.inc("feat_route_total", route=route)
+    part = int(out[1].sum())
+    if part:
+        METRICS.inc("feat_bytes_total", float(part * t.dim * 4))
+    METRICS.observe("featprop_latency_us", us)
+    if work:
+        from dgraph_tpu.utils import costprior
+        costprior.PRIORS.learn_route("feat_" + route,
+                                     us / work * 1000.0)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the executor post-pass: bind features onto a finished level tree
+
+def needs_msgpass(sg) -> bool:
+    """True when any block in the subtree carries `@msgpass` — the
+    Executor's cheap gate before walking the level tree."""
+    if sg.msgpass is not None:
+        return True
+    return any(needs_msgpass(c) for c in sg.children)
+
+
+def annotate_tree(ex, node) -> None:
+    """Walk a finished LevelNode tree and bind `feat_vals` (rank →
+    f32[d]) wherever the block carries `@msgpass`. Levels the fused
+    `featprop` stage already bound are left untouched — the in-trace
+    aggregation and this pass see identical kept-edge lists, so either
+    binding renders identically."""
+    args = node.sg.msgpass
+    if args is not None:
+        if node.sg.recurse is not None and node.sg.recurse.loop:
+            raise ValueError(
+                "@msgpass composes with @recurse(loop: false) only: "
+                "visit-once expansion gives each node exactly one "
+                "aggregation hop")
+        if node.recurse_data is not None:
+            if getattr(node.recurse_data, "feat_vals", None) is None:
+                _annotate_recurse(ex, node, args)
+        elif node.feat_vals is None:
+            _annotate_level(ex, node, args)
+    for ch in node.children:
+        annotate_tree(ex, ch)
+
+
+def _annotate_level(ex, node, args) -> None:
+    """Plain (non-recurse) level: aggregate over the concatenated
+    kept-edge matrices of every child predicate."""
+    node.feat_key = feat_key(args)
+    n = len(node.nodes)
+    if not n:
+        node.feat_vals = {}
+        return
+    segs = [ch.matrix_seg for ch in node.children
+            if len(ch.matrix_seg)]
+    childs = [ch.matrix_child for ch in node.children
+              if len(ch.matrix_seg)]
+    nbrs = np.concatenate(childs) if childs else EMPTY
+    seg = np.concatenate(segs) if segs else EMPTY
+    vals, _cnt, ecnt = aggregate(
+        ex.store, args.pred, args.agg, nbrs, seg, n,
+        mesh=ex.mesh, device_threshold=ex.device_threshold)
+    nodes = np.asarray(node.nodes)
+    node.feat_vals = {
+        int(nodes[i]): np.asarray(vals[i], np.float32)
+        for i in np.nonzero(ecnt > 0)[0].tolist()}
+
+
+def _annotate_recurse(ex, node, args) -> None:
+    """@recurse level: aggregate over the full visit-once edge set
+    (every parent expands at exactly one hop, so the global combine
+    equals the fused stage's per-hop combine)."""
+    data = node.recurse_data
+    data.feat_key = feat_key(args)
+    parts_p, parts_c = [], []
+    for i in sorted(data.edges):
+        p, c = data.edges[i]
+        if len(p):
+            parts_p.append(np.asarray(p, np.int32))
+            parts_c.append(np.asarray(c, np.int32))
+    if not parts_p:
+        data.feat_vals = {}
+        return
+    parents = np.concatenate(parts_p)
+    childs = np.concatenate(parts_c)
+    uniq, seg = np.unique(parents, return_inverse=True)
+    vals, _cnt, _ecnt = aggregate(
+        ex.store, args.pred, args.agg, childs,
+        seg.astype(np.int32), len(uniq),
+        mesh=ex.mesh, device_threshold=ex.device_threshold)
+    # every unique parent has ≥ 1 kept edge by construction
+    data.feat_vals = {
+        int(r): np.asarray(vals[i], np.float32)
+        for i, r in enumerate(uniq.tolist())}

@@ -83,6 +83,11 @@ STAGE_KINDS: dict[str, str] = {
             "tablet + deterministic top-k (tie-break by uid) emitting "
             "the root frontier in-trace — the GraphRAG flagship shape "
             "(knn → recurse → filter → count) is ONE program"),
+    "featprop": ("@msgpass feature propagation over a scanned recurse "
+                 "stage: per-hop segment-combine (sum/mean/max) of the "
+                 "kept edges' neighbour feature rows against the "
+                 "resident vector tablet — GNN-style message passing "
+                 "inside the same single dispatch"),
 }
 
 # depth bound for the scanned recurse stage (shares the host guard)
@@ -106,10 +111,11 @@ class _Stage:
     has_filter: bool = False
     depth: int = 0       # recurse only
     k: int = 0           # knn only: requested seed count
+    agg: str = ""        # featprop only: sum | mean | max
 
     def sig(self) -> tuple:
         return (self.kind, self.attr, self.reverse, self.parent,
-                self.has_filter, self.depth, self.k)
+                self.has_filter, self.depth, self.k, self.agg)
 
 
 @dataclass
@@ -124,6 +130,7 @@ class FusedPlan:
     counts_of: dict[int, dict[int, int]] = field(default_factory=dict)
     recurse: bool = False
     knn: bool = False    # stage 0 is a knn seed stage
+    featprop: bool = False  # a @msgpass stage rides the recurse scan
 
     @property
     def sig(self) -> tuple:
@@ -158,6 +165,7 @@ def _stage_ok(c) -> bool:
     """Per-child eligibility for a hop stage: everything needing
     per-edge host logic mid-descent stays staged."""
     return not (c.recurse is not None or c.shortest is not None
+                or c.msgpass is not None
                 or c.groupby or c.is_expand_all
                 or c.orders or c.facet_orders or c.after
                 or c.facet_vars is not None or c.facet_filter is not None
@@ -184,6 +192,7 @@ def plan_block(store, sg) -> FusedPlan | None:
             return None
         e = edge[0]
         if (e.is_expand_all or e.facet_filter is not None
+                or e.msgpass is not None
                 or not _filter_fusable(e.filters)):
             return None
         plan = FusedPlan(recurse=True, knn=knn_stage is not None)
@@ -195,7 +204,20 @@ def plan_block(store, sg) -> FusedPlan | None:
                                   root_parent,
                                   e.filters is not None, a.depth))
         plan.stage_sgs.append(e)
+        mp = sg.msgpass
+        if mp is not None:
+            fp = _plan_featprop(store, mp, len(plan.stages) - 1)
+            if fp is None:
+                return None   # staged serves (and raises user errors)
+            plan.stages.append(fp)
+            plan.stage_sgs.append(sg)
+            plan.featprop = True
         return plan
+
+    if sg.msgpass is not None:
+        # plain-level @msgpass aggregates host-side after the staged
+        # descent (the post-pass routes it like any other level)
+        return None
 
     plan = FusedPlan(knn=knn_stage is not None)
     root_parent = -1
@@ -261,11 +283,27 @@ def _plan_knn(store, sg) -> _Stage | None:
     return _Stage("knn", f.attr, False, -1, False, 0, k)
 
 
+def _plan_featprop(store, mp, recurse_idx: int) -> _Stage | None:
+    """@msgpass on a fused recurse block compiles to a featprop stage
+    when the feature predicate really is a vector and the agg is one
+    the kernel family emits; anything else keeps the staged path
+    (which raises the user-facing errors)."""
+    from dgraph_tpu.store.types import Kind
+
+    if mp.agg not in ("sum", "mean", "max"):
+        return None
+    ps = store.schema.peek(mp.pred)
+    if ps is None or ps.kind != Kind.VECTOR:
+        return None
+    return _Stage("featprop", mp.pred, False, recurse_idx, False, 0, 0,
+                  mp.agg)
+
+
 # -- the program builder ------------------------------------------------------
 # one emitter per STAGE_KINDS entry; the registry IS the runtime half
 # of the inventory pin (tests/test_lint.py, both directions)
 
-def _emit_hop(st: _Stage, caps: tuple, arrays, frontier):
+def _emit_hop(st: _Stage, caps: tuple, arrays, frontier, parent_out):
     """Emit one hop stage into the open trace; returns (outputs,
     next_frontier). Pure — runs under jax.jit."""
     from dgraph_tpu.ops.hop import gather_edges
@@ -286,7 +324,7 @@ def _emit_hop(st: _Stage, caps: tuple, arrays, frontier):
     return (c_nbrs, c_seg, c_pos, n_kept, nxt, n_unique, total), nxt
 
 
-def _emit_recurse(st: _Stage, caps: tuple, arrays, frontier):
+def _emit_recurse(st: _Stage, caps: tuple, arrays, frontier, parent_out):
     """Emit the scanned visit-once @recurse stage: `depth` masked hops
     with the seen bitmap carried on device, per-hop edge matrices and
     input frontiers kept for host rendering."""
@@ -323,7 +361,7 @@ def _emit_recurse(st: _Stage, caps: tuple, arrays, frontier):
     return (nbrs_h, seg_h, kept_h, fr_h, tot_h, uniq_h), None
 
 
-def _emit_count(st: _Stage, caps: tuple, arrays, frontier):
+def _emit_count(st: _Stage, caps: tuple, arrays, frontier, parent_out):
     """Emit the terminal aggregation stage: per-parent-node degree of
     the counted predicate — a segment-reduce over indptr aligned to the
     parent's padded node array."""
@@ -333,7 +371,7 @@ def _emit_count(st: _Stage, caps: tuple, arrays, frontier):
     return (frontier_degrees(indptr, frontier),), None
 
 
-def _emit_knn(st: _Stage, caps: tuple, arrays, frontier):
+def _emit_knn(st: _Stage, caps: tuple, arrays, frontier, parent_out):
     """Emit the similar_to seed stage: scored matmul over the resident
     [n, d] stack, deterministic top-k (score desc, uid asc — the exact
     numpy-lexsort order of the host reference), emitted as a SORTED
@@ -356,11 +394,39 @@ def _emit_knn(st: _Stage, caps: tuple, arrays, frontier):
     return (nxt, jnp.int32(k)), nxt
 
 
+def _emit_featprop(st: _Stage, caps: tuple, arrays, frontier,
+                   parent_out):
+    """Emit the @msgpass stage: vmap the segment-combine kernel over
+    the recurse scan's per-hop kept-edge matrices. `parent_out` is the
+    recurse stage's output; each hop aggregates its kept edges'
+    neighbour feature rows per input-frontier position — visit-once
+    expansion puts every parent's whole edge set in exactly one hop,
+    so the per-hop combine equals the staged global combine."""
+    import jax
+    import jax.numpy as jnp
+
+    from dgraph_tpu.ops.feat import segment_combine
+
+    (subj, vecs), _allowed, _page = arrays
+    nbrs_h, seg_h, kept_h, fr_h, _tot, _uniq = parent_out
+    edge_cap = nbrs_h.shape[1]
+    out_cap = fr_h.shape[1]
+
+    def one(nbrs, seg, kept):
+        valid = jnp.arange(edge_cap, dtype=jnp.int32) < kept
+        return segment_combine(subj, vecs, nbrs, seg, valid, out_cap,
+                               st.agg)
+
+    feats, cnt, ecnt = jax.vmap(one)(nbrs_h, seg_h, kept_h)
+    return (feats, cnt, ecnt), None
+
+
 _STAGE_EMITTERS = {
     "hop": _emit_hop,
     "recurse": _emit_recurse,
     "count": _emit_count,
     "knn": _emit_knn,
+    "featprop": _emit_featprop,
 }
 
 
@@ -378,8 +444,10 @@ def _build_program(stages: tuple, caps: tuple):
         stage_frontier = [None] * len(stages)
         for i, st in enumerate(stages):
             fr = frontier if st.parent < 0 else stage_frontier[st.parent]
+            p_out = outs[st.parent] if st.parent >= 0 else None
             out, nxt = _STAGE_EMITTERS[st.kind](
-                st, caps[i], (rels[i], alloweds[i], pages[i]), fr)
+                st, caps[i], (rels[i], alloweds[i], pages[i]), fr,
+                p_out)
             stage_frontier[i] = nxt
             outs.append(out)
         return tuple(outs)
@@ -555,6 +623,17 @@ def _run_plan(ex, sg, plan: FusedPlan, shape: str):
             alloweds.append(resolved[2])   # f32 query vector
             pages.append((0, NO_LIMIT))
             continue
+        if st.kind == "featprop":
+            t = store.vec_tablet(st.attr)
+            if t is None or not t.rows:
+                return None   # empty tablet: the staged post-pass
+                # serves (all-zero participation) without a device stack
+            costprofile.note_max("tablet_rows", t.rows)
+            rels.append(t)
+            devs.append(store.vec_device(st.attr))
+            alloweds.append(np.zeros(0, np.int32))
+            pages.append((0, NO_LIMIT))
+            continue
         rel = store.rel(st.attr, st.reverse)
         if rel.nnz == 0:
             return None           # staged short-circuits empties
@@ -673,14 +752,25 @@ def _run_plan(ex, sg, plan: FusedPlan, shape: str):
         for st, rel, out in zip(plan.stages, rels, outs):
             if st.kind == "count":
                 continue
-            if st.kind == "knn":
-                n = rel.rows   # scored rows ≈ the scan's work
+            if st.kind in ("knn", "featprop"):
+                n = rel.rows   # scored/gathered rows ≈ the scan's work
             else:
                 n = (int(out[6]) if st.kind == "hop"
                      else int(out[4].sum()))
             # modeled per-tablet µs, the same ~16 edges/µs scale the
             # staged expand() charges (placement signal)
             costprofile.add_tablet_cost(st.attr, n // 16 + 1)
+        if plan.featprop:
+            # host-side route accounting for the in-trace aggregation
+            # (R13: no metrics inside the jitted program)
+            fi = next(i for i, st in enumerate(plan.stages)
+                      if st.kind == "featprop")
+            METRICS.inc("feat_route_total", route="fused")
+            part = int(outs[fi][1].sum())
+            if part:
+                METRICS.inc("feat_bytes_total",
+                            float(part * rels[fi].dim * 4))
+            METRICS.observe("featprop_latency_us", exec_us)
         if plan.knn:
             # bind the root set from the program's own seed output:
             # sorted ascending with sentinels trailing, first k_true
@@ -702,7 +792,9 @@ def _estimate_caps(plan: FusedPlan, rels, nodes) -> tuple:
     caps = []
     est_nodes = {-1: max(len(nodes), 1)}
     for i, (st, rel) in enumerate(zip(plan.stages, rels)):
-        if st.kind == "count":
+        if st.kind in ("count", "featprop"):
+            # capless: count reduces over the parent's frontier,
+            # featprop over the recurse scan's own static matrices
             caps.append(())
             continue
         if st.kind == "knn":
@@ -781,7 +873,7 @@ def _unpack(ex, sg, plan: FusedPlan, outs, display, nodes):
         ex.uid_vars[sg.var_name] = nodes
     root_idx = 0 if plan.knn else -1
     if plan.recurse:
-        _unpack_recurse(ex, root, plan, outs[1 if plan.knn else 0])
+        _unpack_recurse(ex, root, plan, outs)
         return root
     _attach(ex, plan, outs, root_idx, root)
     return root
@@ -824,13 +916,14 @@ def _attach(ex, plan: FusedPlan, outs, parent_idx: int, parent_node):
                 ex._record_leaf_vars(c, parent_node)
 
 
-def _unpack_recurse(ex, root, plan: FusedPlan, out) -> None:
+def _unpack_recurse(ex, root, plan: FusedPlan, outs) -> None:
     """RecurseData from the scanned stage's per-hop matrices — the host
     loop's visit-once first-visit-tree semantics, hop order preserved."""
     from dgraph_tpu.engine.recurse import (RecurseData, _bind_recurse_vars,
                                            split_children)
 
-    nbrs_h, seg_h, kept_h, fr_h, _need_e, _need_o = out
+    ri = 1 if plan.knn else 0
+    nbrs_h, seg_h, kept_h, fr_h, _need_e, _need_o = outs[ri]
     data = split_children(ex, root.sg, RecurseData(loop=False))
     parts_p, parts_c = [], []
     for h in range(nbrs_h.shape[0]):
@@ -846,5 +939,20 @@ def _unpack_recurse(ex, root, plan: FusedPlan, out) -> None:
             root.nodes, np.concatenate(parts_c)).astype(np.int32)
     else:
         data.all_nodes = root.nodes.copy()
+    if plan.featprop:
+        # bind the in-trace aggregation: per hop, every input-frontier
+        # position with ≥ 1 kept edge carries its [d] f32 combine —
+        # keyed by rank, the exact entries the staged post-pass builds
+        from dgraph_tpu.engine.feat import feat_key
+        feats, _cnt, ecnt = outs[ri + 1]
+        fv: dict = {}
+        for h in range(nbrs_h.shape[0]):
+            if not int(kept_h[h]):
+                continue
+            fr = fr_h[h]
+            for p in np.nonzero(ecnt[h] > 0)[0].tolist():
+                fv[int(fr[p])] = np.asarray(feats[h][p], np.float32)
+        data.feat_vals = fv
+        data.feat_key = feat_key(root.sg.msgpass)
     _bind_recurse_vars(ex, root, data, root.sg)
     root.recurse_data = data

@@ -51,6 +51,14 @@ class RecurseArgs:
 
 
 @dataclass
+class MsgPassArgs:
+    """@msgpass(pred: emb, agg: mean) — neighbour-feature aggregation
+    bound per traversal level (engine/feat.py)."""
+    pred: str = ""
+    agg: str = "mean"   # sum | mean | max
+
+
+@dataclass
 class ShortestArgs:
     from_uid: int = 0
     to_uid: int = 0
@@ -96,6 +104,7 @@ class SubGraph:
 
     # directives
     recurse: Optional[RecurseArgs] = None
+    msgpass: Optional[MsgPassArgs] = None
     shortest: Optional[ShortestArgs] = None
     cascade: list[str] = field(default_factory=list)  # ["__all__"] or fields
     normalize: bool = False

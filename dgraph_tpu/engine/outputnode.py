@@ -167,6 +167,8 @@ class _Renderer:
         domain = level.display if level.display is not None else level.nodes
         for leaf in level.leaf_sgs:
             self._render_leaf(leaf, rank, obj, aliased_only, domain)
+        if level.feat_vals is not None and rank in level.feat_vals:
+            obj[level.feat_key] = _json_val(level.feat_vals[rank])
         if level.recurse_data is not None:
             self._render_recurse_children(level.recurse_data, rank, obj,
                                           depth=0)
@@ -366,6 +368,8 @@ class _Renderer:
                                  depth: int) -> None:
         for leaf in data.leaf_sgs:
             self._render_leaf(leaf, rank, obj, domain=data.all_nodes)
+        if data.feat_vals is not None and rank in data.feat_vals:
+            obj[data.feat_key] = _json_val(data.feat_vals[rank])
         if data.loop:
             if depth >= len(data.by_depth):
                 return

@@ -50,6 +50,11 @@ class RecurseData:
     by_depth: list[dict[int, tuple[np.ndarray, np.ndarray]]] = field(default_factory=list)
     loop: bool = False
     all_nodes: np.ndarray = field(default_factory=lambda: np.zeros(0, np.int32))
+    # @msgpass binding (engine/feat.py): rank → f32[d] aggregate over
+    # the visit-once edge set; None = unbound (the fused featprop
+    # stage binds in-trace, the staged post-pass binds host-side)
+    feat_vals: dict | None = None
+    feat_key: str = ""
 
 
 def split_children(ex, sg: SubGraph, data: RecurseData) -> RecurseData:

@@ -1,0 +1,95 @@
+"""Segment-combine kernels: per-hop neighbour-feature aggregation.
+
+FeatGraph's thesis (PAPERS: "FeatGraph") is that the gather/segment
+machinery behind a hop generalizes when every node carries a dense
+feature vector — the hop's `(neighbors, seg)` edge slots become the
+index pairs of a sparse-dense row aggregation, the regime where dense
+hardware wins widest ("Fast Training of Sparse GNNs on Dense
+Hardware"). This module is that kernel family: given the flat edge
+slots of one traversal level and a sorted embedding stack (a
+`store/vec.py` VecTablet), combine each frontier position's in-edge
+feature rows with sum / mean / max.
+
+Contract (the bit-identity discipline every route is pinned against):
+
+* An edge *participates* when its neighbour has a row in the stack;
+  edges are aggregated per-EDGE (a neighbour reached twice counts
+  twice — the kept-edge lists, not the unique node sets, define the
+  combine).
+* `mean` is the exact f32 sum divided by the f32 participant count —
+  one IEEE division, identical on every route for exactly
+  representable inputs (small-integer-valued fixtures).
+* Segments with zero participating edges produce the zero vector; the
+  caller distinguishes "no kept edges at all" via the structural edge
+  count (`ecnt`) and omits those segments entirely.
+
+Shapes are static (`n_seg`, `edge_cap` compile-time; `agg` selects the
+program) with a validity mask carrying the dynamic edge count — the
+same no-retrace discipline as ops/hop.py.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+AGGS = ("sum", "mean", "max")
+
+
+def segment_combine(subj, vecs, nbrs, seg, valid, n_seg: int, agg: str,
+                    mask_empty: bool = True):
+    """Pure traceable core: combine feature rows of `nbrs[j]` into
+    segment `seg[j]` for every valid edge slot.
+
+    `subj` [rows] sorted unique int32 ranks, `vecs` [rows, d] f32 —
+    a VecTablet's arrays (rows ≥ 1; the caller owns the empty-tablet
+    case). Returns `(out[n_seg, d] f32, cnt[n_seg] i32, ecnt[n_seg]
+    i32)`: the aggregate, the participating-edge count, and the
+    structural kept-edge count per segment.
+
+    `mask_empty=False` keeps the raw partials for cross-shard merges:
+    `max` returns -inf rows and `mean` returns the undivided sum, so a
+    pmax/psum over shards followed by one global mask/division stays
+    bit-identical to the single-device program.
+    """
+    rows = subj.shape[0]
+    idx = jnp.clip(jnp.searchsorted(subj, nbrs), 0, rows - 1)
+    has = valid & (jnp.take(subj, idx) == nbrs)
+    got = jnp.take(vecs, idx, axis=0)                       # [e, d]
+    cnt = jnp.zeros((n_seg,), jnp.int32).at[seg].add(
+        has.astype(jnp.int32), mode="drop")
+    ecnt = jnp.zeros((n_seg,), jnp.int32).at[seg].add(
+        valid.astype(jnp.int32), mode="drop")
+    if agg == "max":
+        neg = jnp.float32(-jnp.inf)
+        out = jnp.full((n_seg, got.shape[1]), neg, jnp.float32).at[
+            seg].max(jnp.where(has[:, None], got, neg), mode="drop")
+        if mask_empty:
+            out = jnp.where((cnt > 0)[:, None], out, jnp.float32(0))
+    else:
+        out = jnp.zeros((n_seg, got.shape[1]), jnp.float32).at[
+            seg].add(jnp.where(has[:, None], got, jnp.float32(0)),
+                     mode="drop")
+        if agg == "mean" and mask_empty:
+            out = jnp.where(
+                (cnt > 0)[:, None],
+                out / jnp.maximum(cnt, 1)[:, None].astype(jnp.float32),
+                jnp.float32(0))
+    return out, cnt, ecnt
+
+
+@functools.partial(jax.jit, static_argnames=("n_seg", "agg"))
+def combine_edges(subj, vecs, nbrs, seg, n_edges, n_seg: int, agg: str):
+    """Jitted single-level entry: `nbrs`/`seg` are padded to a static
+    edge bucket, `n_edges` (traced scalar) masks the live prefix."""
+    valid = jnp.arange(nbrs.shape[0], dtype=jnp.int32) < n_edges
+    return segment_combine(subj, vecs, nbrs, seg, valid, n_seg, agg)
+
+
+def combine_key(rows: int, d: int, edge_cap: int, n_seg: int,
+                agg: str) -> tuple:
+    """The static configuration that forces a distinct XLA program for
+    a segment-combine launch (the ops/hop.py `launch_key` discipline)."""
+    return ("feat.agg", rows, d, edge_cap, n_seg, agg)

@@ -211,12 +211,34 @@ def _register_device_caches(store) -> None:
 
         return nbytes, evict_one
 
+    def vec_detail():
+        """Resident vector stacks with their dims — the /debug/memory
+        rows that make eviction thrash on `store.vec` visible."""
+        s = ref()
+        if s is None:
+            return []
+        out = []
+        for (pred, kind), v in sorted(getattr(s, "_vec_dev", {}).items()):
+            if kind == "mesh":
+                _subj, vecs, rows = v
+                out.append({"pred": pred, "placement": "mesh",
+                            "shards": int(vecs.shape[0]),
+                            "rows": int(rows),
+                            "dim": int(vecs.shape[-1])})
+            else:
+                _subj, vecs = v
+                out.append({"pred": pred, "placement": "device",
+                            "rows": int(vecs.shape[0]),
+                            "dim": int(vecs.shape[1])})
+        return out
+
     for attr, name in (("_device", "store.device"),
                        ("_sharded", "store.sharded"),
                        ("_vec_dev", "store.vec")):
         nbytes, evict_one = make_cbs(attr)
-        memgov.GOVERNOR.register(name, "device", nbytes, evict_one,
-                                 owner=store)
+        memgov.GOVERNOR.register(
+            name, "device", nbytes, evict_one, owner=store,
+            detail_cb=vec_detail if name == "store.vec" else None)
 
 
 class Store:
@@ -236,6 +258,10 @@ class Store:
         self._vec_tab: dict = {}
         self._vec_dev: dict = {}
         self._vec_mesh = None
+        # keys ever placed: a rebuild of one of these is a RE-placement
+        # (memgov evicted it, or the mesh changed) — metered so
+        # eviction thrash on the vector stacks is visible
+        self._vec_placed: set = set()
         self._empty_rel = EdgeRel(np.zeros(self.n_nodes + 1, np.int32),
                                   np.zeros(0, np.int32))
         _register_device_caches(self)
@@ -360,6 +386,10 @@ class Store:
             t = self.vec_tablet(pred)
             out = self._vec_dev[key] = (jax.device_put(t.subj),
                                         jax.device_put(t.vecs))
+            if key in self._vec_placed:
+                from dgraph_tpu.utils.metrics import METRICS
+                METRICS.inc("vec_replacements_total", kind="device")
+            self._vec_placed.add(key)
             from dgraph_tpu.utils import memgov
             memgov.GOVERNOR.maybe_evict("device")
         return out
@@ -393,6 +423,10 @@ class Store:
                 jax.device_put(subj.reshape(d, rows), sh),
                 jax.device_put(vecs.reshape(d, rows, t.dim), sh),
                 rows)
+            if key in self._vec_placed:
+                from dgraph_tpu.utils.metrics import METRICS
+                METRICS.inc("vec_replacements_total", kind="mesh")
+            self._vec_placed.add(key)
             from dgraph_tpu.utils import memgov
             memgov.GOVERNOR.maybe_evict("device")
         return out

@@ -46,10 +46,19 @@ from dgraph_tpu.store.types import parse_vector
 from dgraph_tpu.utils import memgov
 from dgraph_tpu.utils.metrics import METRICS
 
-__all__ = ["VecTablet", "build_tablet", "host_topk", "host_similar",
-           "similar_ranks", "resolve_query"]
+__all__ = ["VecQueryError", "VecTablet", "build_tablet", "host_topk",
+           "host_similar", "similar_ranks", "resolve_query"]
 
 EMPTY = np.zeros(0, np.int32)
+
+
+class VecQueryError(ValueError):
+    """Typed user error for malformed `similar_to` arguments — a
+    REQUEST refusal, never a route failure: the fused planner's
+    `except ValueError` treats it as "serve staged" (non-sticky), the
+    staged route raises it to the caller, and a structurally-empty
+    seed (uid without an embedding row) is NOT an error at all — it
+    returns the empty sorted rank set on every route."""
 
 
 @dataclass
@@ -124,18 +133,22 @@ def resolve_query(store, f):
     refusal on every route."""
     pred = f.attr
     if len(f.args) != 2:
-        raise ValueError("similar_to(pred, k, <vector|uid>) takes "
-                         "exactly two arguments after the predicate")
+        raise VecQueryError(
+            "similar_to(pred, k, <vector|uid>) takes exactly two "
+            "arguments after the predicate")
     k = int(f.args[0])
     if k <= 0:
-        raise ValueError(f"similar_to k must be positive, got {k}")
+        raise VecQueryError(f"similar_to k must be positive, got {k}")
     t = store.vec_tablet(pred)
     if t is None or not t.rows:
         return None
     arg = f.args[1]
     if isinstance(arg, (list, tuple, np.ndarray, str)):
         # str: the quoted literal form `"[1, 0, ...]"` from DQL
-        q = parse_vector(arg)
+        try:
+            q = parse_vector(arg)
+        except ValueError as e:
+            raise VecQueryError(str(e)) from e
     elif isinstance(arg, (int, np.integer)):
         rank = int(store.rank_of(np.array([int(arg)], np.int64))[0])
         if rank < 0:
@@ -144,11 +157,11 @@ def resolve_query(store, f):
         if q is None:
             return None
     else:
-        raise ValueError(
+        raise VecQueryError(
             f"similar_to query must be a vector literal or a uid, "
             f"got {arg!r}")
     if len(q) != t.dim:
-        raise ValueError(
+        raise VecQueryError(
             f"similar_to({pred}): query vector has dim {len(q)}, "
             f"tablet has dim {t.dim}")
     return pred, k, np.asarray(q, np.float32)

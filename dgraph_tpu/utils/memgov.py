@@ -136,15 +136,16 @@ def check_alloc_fault(site: str) -> None:
 
 class _Entry:
     __slots__ = ("name", "kind", "bytes_cb", "evict_one_cb", "value_cb",
-                 "owner_ref")
+                 "detail_cb", "owner_ref")
 
     def __init__(self, name, kind, bytes_cb, evict_one_cb, value_cb,
-                 owner):
+                 owner, detail_cb=None):
         self.name = name
         self.kind = kind
         self.bytes_cb = bytes_cb
         self.evict_one_cb = evict_one_cb
         self.value_cb = value_cb
+        self.detail_cb = detail_cb
         self.owner_ref = weakref.ref(owner) if owner is not None else None
 
     def alive(self) -> bool:
@@ -168,6 +169,17 @@ class _Entry:
             return 0.0
         return 0.0 if v is None else float(v)
 
+    def detail(self) -> list:
+        """Per-resident rows for /debug/memory (e.g. a vec cache's
+        placed stacks with their dims); [] when the cache has no
+        detail callback or it fails."""
+        if self.detail_cb is None:
+            return []
+        try:
+            return list(self.detail_cb())
+        except Exception:
+            return []
+
 
 class Governor:
     """The process-wide cache registry + budget enforcer. Callbacks are
@@ -190,7 +202,7 @@ class Governor:
     # -- registration -----------------------------------------------------
 
     def register(self, name: str, kind: str, bytes_cb, evict_one_cb,
-                 value_cb=None, owner=None) -> int:
+                 value_cb=None, owner=None, detail_cb=None) -> int:
         """Join the registry. `name` must appear in GOVERNED_CACHES and
         `kind` is the budget it draws from ("device" | "host").
         `bytes_cb()` returns resident bytes; `evict_one_cb()` drops the
@@ -203,7 +215,8 @@ class Governor:
                              f"to memgov.GOVERNED_CACHES")
         if kind not in ("device", "host"):
             raise ValueError(f"bad cache kind {kind!r}")
-        e = _Entry(name, kind, bytes_cb, evict_one_cb, value_cb, owner)
+        e = _Entry(name, kind, bytes_cb, evict_one_cb, value_cb, owner,
+                   detail_cb)
         with self._lock:
             self._next_id += 1
             rid = self._next_id
@@ -367,6 +380,9 @@ class Governor:
                                            "registrants": 0})
             c["bytes"] += b
             c["registrants"] += 1
+            d = e.detail()
+            if d:
+                c.setdefault("detail", []).extend(d)
         with self._lock:
             ev = dict(self._evictions)
             budgets = dict(self._budgets)
